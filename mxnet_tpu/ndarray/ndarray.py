@@ -1,0 +1,632 @@
+"""NDArray — the imperative tensor handle.
+
+Reference surface: ``python/mxnet/ndarray/ndarray.py`` + ``src/ndarray/``
+(SURVEY.md §3.1 "NDArray": async tensor handle over an engine-scheduled
+chunk; ``WaitToRead``, ``CopyFromTo``, autograd entry, in-place ops).
+
+TPU-native redesign (SURVEY.md §7 "Arrays"): an ``NDArray`` is a thin handle
+over a ``jax.Array`` — async *by construction* (JAX dispatch returns
+futures), so the reference's dependency engine disappears:
+``WaitToRead == block_until_ready``.  In-place operations rebind the handle
+to a fresh functional value (``x += y`` => ``x._data = x._data + y``): user
+code keeps MXNet's mutable-looking semantics while every underlying value
+stays immutable for XLA (this is SURVEY.md §7 "hard part 1").  The autograd
+entry (``_autograd_node/_autograd_idx``) points into the tape exactly like
+the reference NDArray's ``autograd_entry_``.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, integer_types, numeric_types
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "_wrap_like", "waitall", "from_jax", "empty"]
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_autograd_node",
+                 "_autograd_idx", "_weakref", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_node = None
+        self._autograd_idx = 0
+        self._weakref = None
+
+    # ------------------------------------------------------------------ #
+    # identity / metadata
+    # ------------------------------------------------------------------ #
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype) if not _is_tracer(self._data) \
+            else self._data.dtype
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        if _is_tracer(self._data):
+            return current_context()
+        try:
+            dev = list(self._data.devices())[0]
+            if dev.platform == "cpu":
+                return Context("cpu", dev.id)
+            return Context("tpu", dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<NDArray tracer {self._data.shape} @{self.context}>"
+        return f"{onp.asarray(self._data)!r}\n<NDArray {('x'.join(map(str, self.shape)) or 'scalar')} @{self.context}>"
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("ambiguous truth value of multi-element NDArray")
+        return bool(onp.asarray(self._data))
+
+    def __float__(self):
+        return float(onp.asarray(self._data))
+
+    def __int__(self):
+        return int(onp.asarray(self._data))
+
+    def __index__(self):
+        return int(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def _weak(self):
+        if self._weakref is None:
+            self._weakref = weakref.ref(self)
+        return self._weakref
+
+    # ------------------------------------------------------------------ #
+    # engine analogs
+    # ------------------------------------------------------------------ #
+    def wait_to_read(self):
+        """Reference ``NDArray::WaitToRead`` -> ``block_until_ready``."""
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> onp.ndarray:
+        # XLA may expose transposed (F-order) buffers; reference asnumpy
+        # always returns C-order
+        return onp.ascontiguousarray(onp.asarray(self._data))
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not a scalar")
+        return self.asnumpy().item()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # jax interop -------------------------------------------------------- #
+    def asjax(self):
+        return self._data
+
+    def __jax_array__(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        a = onp.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # ------------------------------------------------------------------ #
+    # mutation-as-rebind
+    # ------------------------------------------------------------------ #
+    def _rebind(self, data, node=None, idx=0):
+        self._data = data
+        self._autograd_node = node
+        self._autograd_idx = idx
+        return self
+
+    # ------------------------------------------------------------------ #
+    # autograd surface
+    # ------------------------------------------------------------------ #
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate a zero gradient buffer (reference
+        ``NDArray.attach_grad`` -> ``MXAutogradMarkVariables``)."""
+        if grad_req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {grad_req}")
+        self._grad = NDArray(jnp.zeros(self.shape, _grad_dtype(self._data.dtype)),
+                             self._ctx)
+        self._grad_req = grad_req
+        # detach from any recorded graph: it becomes a leaf
+        self._autograd_node = None
+        self._autograd_idx = 0
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._rebind(jnp.zeros_like(self._grad._data))
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self) -> "NDArray":
+        return NDArray(self._data, self._ctx)
+
+    # ------------------------------------------------------------------ #
+    # conversion / movement
+    # ------------------------------------------------------------------ #
+    def astype(self, dtype, copy=True):
+        from ..ops import defs as _ops
+        return _ops.cast(self, dtype=onp.dtype(dtype).name)
+
+    def copy(self) -> "NDArray":
+        return NDArray(jnp.asarray(self._data), self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise MXNetError(f"copyto shape mismatch {self.shape} vs {other.shape}")
+            data = self._data
+            if not _is_tracer(data):
+                data = jax.device_put(data, other.context.jax_device())
+            other._rebind(jnp.asarray(data, other._data.dtype))
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        """Reference ``as_in_context``: cross-device copy via engine
+        ``CopyFromTo``; here ``jax.device_put`` (async, like FnProperty
+        kCopyFromGPU ops)."""
+        if _is_tracer(self._data):
+            return NDArray(self._data, ctx)
+        return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def as_np_ndarray(self):
+        from ..numpy.multiarray import ndarray as np_ndarray
+        out = np_ndarray(self._data, self._ctx)
+        out._grad = self._grad
+        out._grad_req = self._grad_req
+        out._autograd_node = self._autograd_node
+        out._autograd_idx = self._autograd_idx
+        return out
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import tostype as _tostype
+        return _tostype(self, stype)
+
+    # ------------------------------------------------------------------ #
+    # shape ops (delegate to the op registry so autograd flows)
+    # ------------------------------------------------------------------ #
+    def reshape(self, *shape, **kwargs):
+        from ..ops import defs as _ops
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _ops.reshape(self, shape=tuple(shape))
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        from ..ops import defs as _ops
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _ops.transpose(self, axes=tuple(axes) if axes else None)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def expand_dims(self, axis):
+        from ..ops import defs as _ops
+        return _ops.expand_dims(self, axis=axis)
+
+    def squeeze(self, axis=None):
+        from ..ops import defs as _ops
+        return _ops.squeeze(self, axis=axis)
+
+    def flatten(self):
+        from ..ops import defs as _ops
+        return _ops.flatten(self)
+
+    def broadcast_to(self, shape):
+        from ..ops import defs as _ops
+        return _ops.broadcast_to(self, shape=tuple(shape))
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def swapaxes(self, dim1, dim2):
+        from ..ops import defs as _ops
+        return _ops.swapaxes(self, dim1=dim1, dim2=dim2)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        from ..ops import defs as _ops
+        return _ops.split(self, num_outputs=num_outputs, axis=axis,
+                          squeeze_axis=squeeze_axis)
+
+    def slice(self, begin, end, step=None):
+        from ..ops import defs as _ops
+        return _ops.slice(self, begin=tuple(begin), end=tuple(end),
+                          step=tuple(step) if step else None)
+
+    def slice_axis(self, axis, begin, end):
+        from ..ops import defs as _ops
+        return _ops.slice_axis(self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from ..ops import defs as _ops
+        return _ops.take(self, indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        from ..ops import defs as _ops
+        return _ops.one_hot(self, depth=depth, on_value=on_value,
+                            off_value=off_value, dtype=dtype)
+
+    def tile(self, reps):
+        from ..ops import defs as _ops
+        return _ops.tile(self, reps=tuple(reps))
+
+    def repeat(self, repeats, axis=None):
+        from ..ops import defs as _ops
+        return _ops.repeat(self, repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        from ..ops import defs as _ops
+        return _ops.flip(self, axis=axis)
+
+    def pad(self, mode="constant", pad_width=None, constant_value=0):
+        from ..ops import defs as _ops
+        return _ops.pad(self, mode=mode, pad_width=tuple(pad_width),
+                        constant_value=constant_value)
+
+    def diag(self, k=0):
+        from ..ops import defs as _ops
+        return _ops.diag(self, k=k)
+
+    # reductions --------------------------------------------------------- #
+    def sum(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.sum(self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.mean(self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.max(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.min(self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.prod(self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.norm(self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.argmax(self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        from ..ops import defs as _ops
+        return _ops.argmin(self, axis=axis, keepdims=keepdims)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        from ..ops import defs as _ops
+        return _ops.topk(self, axis=axis, k=k, ret_typ=ret_typ,
+                         is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        from ..ops import defs as _ops
+        return _ops.sort(self, axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True, dtype="float32"):
+        from ..ops import defs as _ops
+        return _ops.argsort(self, axis=axis, is_ascend=is_ascend, dtype=dtype)
+
+    # elementwise methods ------------------------------------------------ #
+    def abs(self):
+        from ..ops import defs as _ops
+        return _ops.abs(self)
+
+    def exp(self):
+        from ..ops import defs as _ops
+        return _ops.exp(self)
+
+    def log(self):
+        from ..ops import defs as _ops
+        return _ops.log(self)
+
+    def sqrt(self):
+        from ..ops import defs as _ops
+        return _ops.sqrt(self)
+
+    def square(self):
+        from ..ops import defs as _ops
+        return _ops.square(self)
+
+    def relu(self):
+        from ..ops import defs as _ops
+        return _ops.relu(self)
+
+    def sigmoid(self):
+        from ..ops import defs as _ops
+        return _ops.sigmoid(self)
+
+    def tanh(self):
+        from ..ops import defs as _ops
+        return _ops.tanh(self)
+
+    def softmax(self, axis=-1):
+        from ..ops import defs as _ops
+        return _ops.softmax(self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        from ..ops import defs as _ops
+        return _ops.log_softmax(self, axis=axis)
+
+    def clip(self, a_min, a_max):
+        from ..ops import defs as _ops
+        return _ops.clip(self, a_min=a_min, a_max=a_max)
+
+    def round(self):
+        from ..ops import defs as _ops
+        return _ops.round(self)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        from ..ops import defs as _ops
+        return _ops.dot(self, other, transpose_a=transpose_a,
+                        transpose_b=transpose_b)
+
+    # ------------------------------------------------------------------ #
+    # python operators
+    # ------------------------------------------------------------------ #
+    def _binop(self, other, name, reverse=False):
+        from ..ops import defs as _ops
+        fn = getattr(_ops, name)
+        if reverse:
+            return fn(_coerce(other, self), self)
+        return fn(self, _coerce(other, self))
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod")
+
+    def __rmod__(self, o):
+        return self._binop(o, "broadcast_mod", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", reverse=True)
+
+    def __matmul__(self, o):
+        from ..ops import defs as _ops
+        return _ops.matmul(self, o)
+
+    def __neg__(self):
+        from ..ops import defs as _ops
+        return _ops.negative(self)
+
+    def __abs__(self):
+        return self.abs()
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal")
+
+    def __ne__(self, o):
+        return self._binop(o, "broadcast_not_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: rebind (tape-visible when recording) --------------------- #
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        return self._rebind(r._data, r._autograd_node, r._autograd_idx)
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        return self._rebind(r._data, r._autograd_node, r._autograd_idx)
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        return self._rebind(r._data, r._autograd_node, r._autograd_idx)
+
+    def __itruediv__(self, o):
+        r = self.__truediv__(o)
+        return self._rebind(r._data, r._autograd_node, r._autograd_idx)
+
+    # ------------------------------------------------------------------ #
+    # indexing
+    # ------------------------------------------------------------------ #
+    def __getitem__(self, key):
+        from ..ops import defs as _ops
+        key = _index_key(key)
+        return _ops._index(self, key=key)
+
+    def __setitem__(self, key, value):
+        if self._autograd_node is not None:
+            from .. import autograd
+            if autograd.is_recording():
+                raise MXNetError(
+                    "in-place assignment to an array produced inside "
+                    "autograd.record() is not differentiable; use "
+                    "concat/where instead")
+        key = _index_key(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data = self._data.at[key].set(value)
+
+    def begin_state(self, *a, **k):  # pragma: no cover
+        raise AttributeError("begin_state")
+
+
+def _grad_dtype(dtype):
+    d = onp.dtype(dtype) if not isinstance(dtype, onp.dtype) else dtype
+    try:
+        if onp.issubdtype(d, onp.floating):
+            return d
+    except TypeError:
+        return dtype  # bfloat16 etc.
+    return onp.float32
+
+
+def _index_key(key):
+    """Normalize an index: NDArray indices -> jax arrays; tuples recurse."""
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(_index_key(k) for k in key)
+    return key
+
+
+def _coerce(x, like: "NDArray"):
+    if isinstance(x, NDArray):
+        return x
+    if isinstance(x, numeric_types):
+        return NDArray(jnp.asarray(x, like._data.dtype), like._ctx)
+    if isinstance(x, (onp.ndarray, list, tuple)):
+        return NDArray(jnp.asarray(x), like._ctx)
+    raise TypeError(f"cannot coerce {type(x)} to NDArray")
+
+
+def _wrap_like(data, ref: Optional[NDArray]) -> NDArray:
+    return NDArray(data, ref._ctx if ref is not None else None)
+
+
+# ---------------------------------------------------------------------- #
+# creation
+# ---------------------------------------------------------------------- #
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """``mx.nd.array`` — create from numpy/list/NDArray."""
+    if isinstance(source, NDArray):
+        data = source._data
+    else:
+        data = source
+    if dtype is None and not isinstance(source, NDArray):
+        # MXNet defaults python/np-float64 input to float32
+        try:
+            if onp.asarray(source).dtype == onp.float64:
+                dtype = onp.float32
+        except Exception:
+            pass
+    arr = jnp.asarray(data, dtype=dtype)
+    if ctx is not None:
+        arr = jax.device_put(arr, ctx.jax_device())
+    return NDArray(arr, ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return array(onp.zeros(shape, dtype or onp.float32), ctx=ctx)
+
+
+def from_jax(x, ctx=None) -> NDArray:
+    return NDArray(x, ctx)
+
+
+def waitall():
+    """Reference ``mx.nd.waitall`` -> block on all pending work."""
+    try:
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:
+        pass
+    jax.effects_barrier()

@@ -1,0 +1,100 @@
+"""NDArray binary serialization — the ``.params`` file format.
+
+Reference surface: ``NDArray::Save/Load`` in ``src/ndarray/ndarray.cc``
+(SURVEY.md §5.4a: "magic-tagged list/dict of tensors; this underlies
+``.params`` files").  Layout implemented here (from the public apache/mxnet
+format; the reference tree was empty at survey time, so cross-loading with
+actual reference files is best-effort — see PARITY.md):
+
+  file := uint64 kMXAPINDArrayListMagic(0x112) | uint64 reserved(0)
+        | uint64 n_arrays | n * ndarray_blob
+        | uint64 n_names  | n * (uint64 len | bytes)  (names; 0 for list)
+  ndarray_blob := uint32 NDARRAY_V2_MAGIC(0xF993FAC9) | int32 stype(-1 dense)
+        | uint32 ndim | int64 dims[ndim]
+        | int32 devtype | int32 devid | int32 type_flag | raw data
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+
+from ..base import MXNetError, dtype_np_to_mx, dtype_mx_to_np
+from .ndarray import NDArray, array
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC = 0xF993FAC9
+
+
+def _write_nd(f, nd: NDArray):
+    data = onp.ascontiguousarray(nd.asnumpy())
+    f.write(struct.pack("<I", _ND_MAGIC))
+    f.write(struct.pack("<i", -1))  # dense stype
+    f.write(struct.pack("<I", data.ndim))
+    for d in data.shape:
+        f.write(struct.pack("<q", d))
+    f.write(struct.pack("<ii", 1, 0))  # saved context: cpu(0)
+    f.write(struct.pack("<i", dtype_np_to_mx(data.dtype)))
+    f.write(data.tobytes())
+
+
+def _read_nd(f) -> NDArray:
+    magic, = struct.unpack("<I", f.read(4))
+    if magic != _ND_MAGIC:
+        raise MXNetError(f"bad ndarray magic {magic:#x}")
+    stype, = struct.unpack("<i", f.read(4))
+    if stype != -1:
+        raise MXNetError("sparse load not supported")
+    ndim, = struct.unpack("<I", f.read(4))
+    shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+    _devt, _devid = struct.unpack("<ii", f.read(8))
+    tf, = struct.unpack("<i", f.read(4))
+    dtype = dtype_mx_to_np(tf)
+    n = 1
+    for d in shape:
+        n *= d
+    buf = f.read(n * onp.dtype(dtype).itemsize)
+    return array(onp.frombuffer(buf, dtype=dtype).reshape(shape).copy())
+
+
+def save(fname: str, data):
+    """``mx.nd.save(fname, list_or_dict_of_NDArray)``."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names, arrays = [], list(data)
+    else:
+        raise MXNetError("save: need NDArray, list, or dict")
+    for a in arrays:
+        if not isinstance(a, NDArray):
+            raise MXNetError("save: all values must be NDArray")
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_nd(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nm in names:
+            b = nm.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        magic, _res = struct.unpack("<QQ", f.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError(f"bad file magic {magic:#x}")
+        n, = struct.unpack("<Q", f.read(8))
+        arrays = [_read_nd(f) for _ in range(n)]
+        n_names, = struct.unpack("<Q", f.read(8))
+        if n_names == 0:
+            return arrays
+        names = []
+        for _ in range(n_names):
+            ln, = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode())
+        return dict(zip(names, arrays))

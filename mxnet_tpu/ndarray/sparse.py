@@ -1,0 +1,109 @@
+"""Sparse storage types (row_sparse / csr).
+
+Reference surface: ``python/mxnet/ndarray/sparse.py`` + sparse kernels in
+``src/operator/tensor`` (SURVEY.md §3.1 NDArray storage types, §3.3 "Sparse
+/ large embedding DP").
+
+TPU-native stance: XLA is dense-only; ``row_sparse`` is represented as
+(indices, values) pairs materialized to dense on op boundaries, which keeps
+the API (``tostype``, ``row_sparse_array``, ``retain``) working while the
+performant path is sharded dense embedding tables + gather (see
+parallel/).  This mirrors SURVEY.md §7 Phase 5 "row_sparse emulation +
+documented descopes"."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+
+class BaseSparseNDArray(NDArray):
+    pass
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, data) pair; dense shape known."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        dense = jnp.zeros(shape, data.dtype).at[
+            jnp.asarray(indices, jnp.int32)].set(jnp.asarray(data))
+        super().__init__(dense, ctx)
+        self._rs_data = jnp.asarray(data)
+        self._rs_indices = jnp.asarray(indices, jnp.int32)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._rs_indices)
+
+    @property
+    def data(self):
+        return NDArray(self._rs_data)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"unsupported stype {stype}")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        dense = onp.zeros(shape, onp.asarray(data).dtype)
+        d, ip, ix = map(onp.asarray, (data, indptr, indices))
+        for r in range(shape[0]):
+            for j in range(ip[r], ip[r + 1]):
+                dense[r, ix[j]] = d[j]
+        super().__init__(jnp.asarray(dense), ctx)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        return self
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(jnp.asarray(data, dtype), indices, shape, ctx)
+    dense = array(arg1, ctx=ctx, dtype=dtype)
+    return tostype(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(data, dtype), indptr, indices, shape, ctx)
+    raise MXNetError("csr_matrix: pass (data, indices, indptr)")
+
+
+def tostype(nd: NDArray, stype: str):
+    if stype == "default":
+        return NDArray(nd._data, nd._ctx)
+    if stype == "row_sparse":
+        dense = onp.asarray(nd._data)
+        nz = onp.where(onp.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(dense[nz], nz, dense.shape)
+    if stype == "csr":
+        import scipy.sparse as sp  # available via numpy stack
+        m = sp.csr_matrix(onp.asarray(nd._data))
+        return CSRNDArray(m.data, m.indptr, m.indices, m.shape)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def retain(rs: RowSparseNDArray, indices):
+    idx = onp.asarray(indices._data if isinstance(indices, NDArray) else indices,
+                      onp.int32)
+    keep = onp.isin(onp.asarray(rs._rs_indices), idx)
+    return RowSparseNDArray(onp.asarray(rs._rs_data)[keep],
+                            onp.asarray(rs._rs_indices)[keep], rs.shape)

@@ -1,0 +1,5 @@
+"""Op registry + corpus. Importing this package registers all core ops."""
+from . import registry
+from .registry import Op, get_op, list_ops, invoke, register
+from . import defs
+from . import nn

@@ -1,0 +1,806 @@
+"""Core operator corpus (tensor/math ops).
+
+Reference surface: ``src/operator/tensor/**`` (SURVEY.md §3.1 "Operator
+corpus": elemwise unary/binary with mshadow functors, broadcast/reduce,
+dot/batch_dot, matrix_op, indexing, ordering, init ops).  Here every op is a
+pure jax function registered via ``@op`` (see registry.py); gradients come
+from jax.vjp, kernels from XLA — there is no mshadow/cuDNN analog to write.
+
+Naming follows the reference ``mx.nd.*`` API so user code ports unchanged.
+NN ops (Convolution, BatchNorm, ...) live in ops/nn.py.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import op, register, invoke, alias, get_op
+
+_abs = builtins.abs
+_sum = builtins.sum
+_max = builtins.max
+_min = builtins.min
+_round = builtins.round
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+# ======================================================================= #
+# elementwise unary
+# ======================================================================= #
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "round": jnp.round, "rint": jnp.rint,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt, "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log,
+    "log10": jnp.log10, "log2": jnp.log2, "log1p": jnp.log1p,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos, "arctan": jnp.arctan, "sinh": jnp.sinh,
+    "cosh": jnp.cosh, "tanh": jnp.tanh, "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "negative": jnp.negative, "reciprocal": lambda x: 1.0 / x,
+    "rsqrt": lax.rsqrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    def _make(f):
+        def impl(data):
+            return f(data)
+        return impl
+    _impl = _make(_fn)
+    _impl.__name__ = _name
+    _g[_name] = op(_name)(_impl)
+
+alias("_copy", "identity")
+abs = _g["abs"]  # noqa: A001
+round = _g["round"]  # noqa: A001
+
+
+@op("softrelu")
+def softrelu(data):
+    return jax.nn.softplus(data)
+
+
+@op("BlockGrad", differentiable=True)
+def BlockGrad(data):
+    return lax.stop_gradient(data)
+
+
+def stop_gradient(data):
+    return BlockGrad(data)
+
+
+@op("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, jnp.int32)
+
+
+@op("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], jnp.int32)
+
+
+@op("cast")
+def cast(data, *, dtype):
+    return data.astype(jnp.dtype(dtype))
+
+
+alias("Cast", "cast")
+
+
+@op("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    s2 = scalar * scalar
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data, a - 0.5 / s2)
+
+
+# ======================================================================= #
+# elementwise binary (broadcasting); MXNet has both elemwise_* (no
+# broadcast) and broadcast_* families — jnp broadcasts, so they share impls
+# ======================================================================= #
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+}
+for _name, _fn in _BINARY.items():
+    def _makeb(f):
+        def impl(lhs, rhs):
+            return f(lhs, rhs)
+        return impl
+    _impl = _makeb(_fn)
+    _impl.__name__ = _name
+    _g[_name] = op(_name)(_impl)
+
+for _short, _long in [("add", "broadcast_add"), ("subtract", "broadcast_sub"),
+                      ("multiply", "broadcast_mul"), ("divide", "broadcast_div"),
+                      ("modulo", "broadcast_mod"), ("power", "broadcast_power"),
+                      ("maximum", "broadcast_maximum"),
+                      ("minimum", "broadcast_minimum"),
+                      ("elemwise_add", "broadcast_add"),
+                      ("elemwise_sub", "broadcast_sub"),
+                      ("elemwise_mul", "broadcast_mul"),
+                      ("elemwise_div", "broadcast_div")]:
+    alias(_short, _long)
+    _g[_short] = _g[_long]
+
+_CMP = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+for _name, _fn in _CMP.items():
+    def _makec(f):
+        def impl(lhs, rhs):
+            out = f(lhs, rhs)
+            # MXNet comparison ops return the input float dtype (1.0/0.0)
+            dt = jnp.result_type(lhs, rhs)
+            if jnp.issubdtype(dt, jnp.bool_):
+                dt = jnp.float32
+            return out.astype(dt)
+        return impl
+    _impl = _makec(_fn)
+    _impl.__name__ = _name
+    _g[_name] = op(_name, differentiable=False)(_impl)
+
+for _short, _long in [("equal", "broadcast_equal"),
+                      ("not_equal", "broadcast_not_equal"),
+                      ("greater", "broadcast_greater"),
+                      ("greater_equal", "broadcast_greater_equal"),
+                      ("lesser", "broadcast_lesser"),
+                      ("lesser_equal", "broadcast_lesser_equal"),
+                      ("logical_and", "broadcast_logical_and"),
+                      ("logical_or", "broadcast_logical_or"),
+                      ("logical_xor", "broadcast_logical_xor")]:
+    alias(_short, _long)
+    _g[_short] = _g[_long]
+
+
+@op("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@op("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool) if condition.dtype != bool
+                     else condition, x, y)
+
+
+@op("clip")
+def clip(data, *, a_min, a_max):
+    return jnp.clip(data, a_min, a_max)
+
+
+@op("add_n", variadic=True)
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+alias("ElementWiseSum", "add_n")
+
+
+# ======================================================================= #
+# reductions
+# ======================================================================= #
+
+@op("sum")
+def sum(data, *, axis=None, keepdims=False, exclude=False):  # noqa: A001
+    return jnp.sum(data, axis=_excl(_norm_axis(axis), data.ndim, exclude),
+                   keepdims=keepdims)
+
+
+def _excl(axis, ndim, exclude):
+    if not exclude or axis is None:
+        return axis
+    ax = (axis,) if isinstance(axis, int) else axis
+    ax = tuple(a % ndim for a in ax)
+    return tuple(i for i in range(ndim) if i not in ax)
+
+
+@op("mean")
+def mean(data, *, axis=None, keepdims=False, exclude=False):
+    return jnp.mean(data, axis=_excl(_norm_axis(axis), data.ndim, exclude),
+                    keepdims=keepdims)
+
+
+@op("prod")
+def prod(data, *, axis=None, keepdims=False):
+    return jnp.prod(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("nansum")
+def nansum(data, *, axis=None, keepdims=False):
+    return jnp.nansum(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("nanprod")
+def nanprod(data, *, axis=None, keepdims=False):
+    return jnp.nanprod(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("max")
+def max(data, *, axis=None, keepdims=False):  # noqa: A001
+    return jnp.max(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("min")
+def min(data, *, axis=None, keepdims=False):  # noqa: A001
+    return jnp.min(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("norm")
+def norm(data, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    if ord != 2:
+        raise MXNetError(f"norm: only ord=1 or 2 supported, got {ord}")
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@op("argmax", differentiable=False)
+def argmax(data, *, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@op("argmin", differentiable=False)
+def argmin(data, *, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@op("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ======================================================================= #
+# ordering
+# ======================================================================= #
+
+@op("topk", differentiable=False)
+def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    x = data if not is_ascend else -data
+    x = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx.astype(jnp.dtype(dtype)))
+    if ret_typ == "mask":
+        mask = jnp.zeros(jnp.moveaxis(data, axis, -1).shape, data.dtype)
+        mask = jnp.put_along_axis(
+            mask, jnp.moveaxis(idx, axis, -1), 1.0, axis=-1,
+            inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
+    return idx.astype(jnp.dtype(dtype))
+
+
+@op("sort")
+def sort(data, *, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@op("argsort", differentiable=False)
+def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+# ======================================================================= #
+# linalg
+# ======================================================================= #
+
+@op("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    """MXNet dot: contract lhs's last axis with rhs's first (reference
+    ``src/operator/tensor/dot.cc``); transpose flags flip which axis is
+    contracted.  The 2-D case is the MXU matmul hot path."""
+    a, b = lhs, rhs
+    if transpose_a and a.ndim > 1:
+        a = jnp.transpose(a)  # full axis reversal, per reference semantics
+    if transpose_b and b.ndim > 1:
+        b = jnp.transpose(b)
+    if a.ndim == 0 or b.ndim == 0:
+        return a * b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([-1], [0]))
+
+
+@op("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@op("matmul")
+def matmul(lhs, rhs):
+    return jnp.matmul(lhs, rhs)
+
+
+@op("linalg_gemm2")
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@op("linalg_syrk")
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@op("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@op("linalg_trsm")
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    x = jax.scipy.linalg.solve_triangular(
+        A, B * alpha, trans=1 if transpose else 0, lower=lower,
+        left=not rightside)
+    return x
+
+
+@op("L2Normalization")
+def L2Normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / n
+
+
+# ======================================================================= #
+# shape manipulation
+# ======================================================================= #
+
+@op("reshape")
+def reshape(data, *, shape):
+    return jnp.reshape(data, _mx_reshape(data.shape, shape))
+
+
+def _mx_reshape(ishape, shape):
+    """Support MXNet special codes: 0 (keep dim), -1 (infer), -2 (copy rest),
+    -3 (merge two dims), -4 (split dim)."""
+    if all(isinstance(s, int) and s > 0 or s == -1 for s in shape):
+        return tuple(shape)
+    out = []
+    i = 0
+    it = iter(range(len(shape)))
+    k = 0
+    shape = list(shape)
+    while k < len(shape):
+        s = shape[k]
+        if s > 0:
+            out.append(s)
+            i += 1
+        elif s == 0:
+            out.append(ishape[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.extend(ishape[i:])
+            i = len(ishape)
+        elif s == -3:
+            out.append(ishape[i] * ishape[i + 1])
+            i += 2
+        elif s == -4:
+            a, b = shape[k + 1], shape[k + 2]
+            if a == -1:
+                a = ishape[i] // b
+            if b == -1:
+                b = ishape[i] // a
+            out.extend([a, b])
+            i += 1
+            k += 2
+        else:
+            raise MXNetError(f"bad reshape code {s}")
+        k += 1
+    return tuple(out)
+
+
+alias("Reshape", "reshape")
+
+
+@op("transpose")
+def transpose(data, *, axes=None):
+    return jnp.transpose(data, axes=axes if axes else None)
+
+
+@op("expand_dims")
+def expand_dims(data, *, axis):
+    return jnp.expand_dims(data, axis)
+
+
+@op("squeeze")
+def squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@op("flatten")
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+alias("Flatten", "flatten")
+
+
+@op("broadcast_to")
+def broadcast_to(data, *, shape):
+    tgt = tuple(o if s == 0 else s for s, o in zip(shape, data.shape)) \
+        if len(shape) == data.ndim else tuple(shape)
+    return jnp.broadcast_to(data, tgt)
+
+
+@op("broadcast_axis")
+def broadcast_axis(data, *, axis, size):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@op("swapaxes")
+def swapaxes(data, *, dim1=0, dim2=1):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+alias("SwapAxis", "swapaxes")
+
+
+@op("concat", variadic=True)
+def concat(*data, dim=1):
+    return jnp.concatenate(data, axis=dim)
+
+
+alias("Concat", "concat")
+
+
+@op("stack", variadic=True)
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@op("split")
+def split(data, *, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+alias("SliceChannel", "split")
+
+
+@op("slice")
+def slice(data, *, begin, end, step=None):  # noqa: A001
+    nd = data.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = (tuple(step) + (None,) * (nd - len(step))) if step else (None,) * nd
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@op("slice_axis")
+def slice_axis(data, *, axis, begin, end):
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@op("slice_like")
+def slice_like(data, shape_like, *, axes=None):
+    axes = axes or tuple(range(data.ndim))
+    idx = [builtins.slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = builtins.slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@op("tile")
+def tile(data, *, reps):
+    return jnp.tile(data, reps)
+
+
+@op("repeat")
+def repeat(data, *, repeats, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@op("flip")
+def flip(data, *, axis):
+    return jnp.flip(data, axis=axis)
+
+
+alias("reverse", "flip")
+
+
+@op("pad")
+def pad(data, *, mode="constant", pad_width=(), constant_value=0):
+    pw = list(zip(pad_width[::2], pad_width[1::2]))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode="constant",
+                       constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+alias("Pad", "pad")
+
+
+@op("diag")
+def diag(data, *, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@op("depth_to_space")
+def depth_to_space(data, *, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@op("space_to_depth")
+def space_to_depth(data, *, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+# ======================================================================= #
+# indexing
+# ======================================================================= #
+
+@op("take")
+def take(a, indices, *, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@op("pick")
+def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    idx = jnp.clip(idx, 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@op("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@op("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[idx].add(data)
+
+
+@op("one_hot", differentiable=False)
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(jnp.dtype(dtype))
+
+
+@op("boolean_mask")
+def boolean_mask(data, index, *, axis=0):
+    # dynamic shape: materialize on host path only (documented XLA limit);
+    # inside jit use where/compress patterns instead
+    mask = index.astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+@op("sequence_mask")
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    # data: (seq, batch, ...) if axis==0 else (batch, seq, ...)
+    L = data.shape[axis]
+    pos = jnp.arange(L)
+    if axis == 0:
+        pos = pos.reshape((L,) + (1,) * (data.ndim - 1))
+        sl = sequence_length.reshape((1, -1) + (1,) * (data.ndim - 2))
+    else:
+        pos = pos.reshape((1, L) + (1,) * (data.ndim - 2))
+        sl = sequence_length.reshape((-1, 1) + (1,) * (data.ndim - 2))
+    return jnp.where(pos < sl, data, jnp.asarray(value, data.dtype))
+
+
+alias("SequenceMask", "sequence_mask")
+
+
+@op("sequence_last")
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [builtins.slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        batch = jnp.arange(data.shape[1])
+        return data[last, batch]
+    batch = jnp.arange(data.shape[0])
+    return data[batch, last]
+
+
+alias("SequenceLast", "sequence_last")
+
+
+@op("sequence_reverse")
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    # reverse only the first sequence_length elements along axis 0
+    L = data.shape[0]
+    pos = jnp.arange(L).reshape((L,) + (1,) * (data.ndim - 1))
+    sl = sequence_length.astype(jnp.int32).reshape(
+        (1, -1) + (1,) * (data.ndim - 2))
+    src = jnp.where(pos < sl, sl - 1 - pos, pos)
+    return jnp.take_along_axis(data, jnp.broadcast_to(src.astype(jnp.int32),
+                                                      data.shape), axis=0)
+
+
+alias("SequenceReverse", "sequence_reverse")
+
+
+# __getitem__ support: static parts of the key are closed over; advanced
+# (array) indices are passed as primals so gradients flow through gathers.
+_INDEX_SENTINEL = "__arr__"
+
+
+def _index(data, key):
+    import jax as _jax
+    from ..ndarray.ndarray import NDArray
+
+    arrays = []
+    def strip(k):
+        if isinstance(k, (_jax.Array, jnp.ndarray)) or hasattr(k, "aval"):
+            arrays.append(k)
+            return (_INDEX_SENTINEL, len(arrays) - 1)
+        if isinstance(k, tuple):
+            return tuple(strip(x) for x in k)
+        return k
+    skey = strip(key)
+
+    def fill(k, arrs):
+        if isinstance(k, tuple):
+            if len(k) == 2 and k[0] == _INDEX_SENTINEL:
+                return arrs[k[1]]
+            return tuple(fill(x, arrs) for x in k)
+        return k
+
+    def impl(d, *idx_arrays):
+        return d[fill(skey, idx_arrays)]
+
+    from .registry import Op
+    tmp = Op(name="_index", fn=impl)
+    return invoke(tmp, [data] + arrays, {})
+
+
+# ======================================================================= #
+# creation ops (no tensor inputs -> plain functions, not @op)
+# ======================================================================= #
+
+def _ctx_put(arr, ctx):
+    from ..ndarray.ndarray import NDArray
+    if ctx is not None:
+        arr = jax.device_put(arr, ctx.jax_device())
+    return NDArray(arr, ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32"):
+    return _ctx_put(jnp.zeros(shape, jnp.dtype(dtype or "float32")), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32"):
+    return _ctx_put(jnp.ones(shape, jnp.dtype(dtype or "float32")), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    return _ctx_put(jnp.full(shape, val, jnp.dtype(dtype or "float32")), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, jnp.dtype(dtype or "float32"))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return _ctx_put(out, ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return _ctx_put(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                 dtype=jnp.dtype(dtype or "float32")), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return _ctx_put(jnp.eye(N, M or N, k=k, dtype=jnp.dtype(dtype or "float32")),
+                    ctx)
+
+
+@op("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@op("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@op("full_like")
+def full_like(data, *, fill_value=0.0):
+    return jnp.full_like(data, fill_value)

@@ -1,0 +1,510 @@
+"""Neural-network operators.
+
+Reference surface: ``src/operator/nn/**`` (SURVEY.md §3.1 "Operator corpus"
+nn/ family: Convolution + cuDNN autotuned paths, FullyConnected, BatchNorm,
+LayerNorm, Pooling, Activation, Softmax, Dropout, Embedding, ...).
+
+TPU-native: every op lowers to XLA HLO that tiles onto the MXU
+(``lax.conv_general_dilated``, ``jnp.matmul``) or fuses into neighbors
+(norms, activations).  There is no autotune knob — XLA picks conv
+algorithms — and no cuDNN analog to manage.  Layouts follow the reference
+(NCHW default) but every conv/pool accepts ``layout=NHWC`` which is
+preferred on TPU.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import op, alias
+
+
+# ----------------------------------------------------------------------- #
+# activations
+# ----------------------------------------------------------------------- #
+
+@op("Activation")
+def Activation(data, *, act_type="relu"):
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+        "log_sigmoid": jax.nn.log_sigmoid,
+        "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+        "gelu": jax.nn.gelu,
+        "erf_gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "swish": jax.nn.silu,
+    }
+    if act_type not in fns:
+        raise MXNetError(f"unknown act_type {act_type}")
+    return fns[act_type](data)
+
+
+@op("LeakyReLU")
+def LeakyReLU(data, gamma=None, *, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and data.ndim > 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":  # eval mode: use mean slope
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, s * data)
+    raise MXNetError(f"unknown LeakyReLU act_type {act_type}")
+
+
+@op("softmax")
+def softmax(data, length=None, *, axis=-1, temperature=None,
+            use_length=False):
+    x = data / temperature if temperature else data
+    if use_length and length is not None:
+        L = data.shape[axis]
+        pos = jnp.arange(L)
+        shape = [1] * data.ndim
+        shape[axis] = L
+        pos = pos.reshape(shape)
+        ln = length.reshape(length.shape + (1,) * (data.ndim - length.ndim))
+        ln = jnp.moveaxis(ln, -1, axis) if axis != -1 and axis != data.ndim - 1 else ln
+        mask = pos < ln
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax")
+def log_softmax(data, *, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("softmin")
+def softmin(data, *, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@op("SoftmaxActivation")
+def SoftmaxActivation(data, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(
+        data.shape)
+
+
+# ----------------------------------------------------------------------- #
+# dense / conv / pooling
+# ----------------------------------------------------------------------- #
+
+@op("FullyConnected")
+def FullyConnected(data, weight, bias=None, *, num_hidden=0, no_bias=False,
+                   flatten=True):
+    """Reference anchor ``FullyConnected``: y = x W^T + b.  The matmul is
+    the MXU hot path; keep inputs bf16-friendly and batched."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    y = jnp.matmul(x, weight.T)
+    if not no_bias and bias is not None:
+        y = y + bias
+    return y
+
+
+def _pair(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v + (v[-1],) * (n - len(v)) if len(v) < n else v
+
+
+@op("Convolution")
+def Convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                layout=None, cudnn_tune=None, cudnn_off=False,
+                workspace=1024):
+    """Reference anchor ``Convolution`` (+ ``nn/cudnn/`` autotuned paths).
+    Lowers to one ``lax.conv_general_dilated`` — XLA chooses the algorithm
+    (cudnn_tune/workspace accepted for API compat, ignored)."""
+    ndim = len(kernel)
+    stride = _pair(stride or 1, ndim)
+    dilate = _pair(dilate or 1, ndim)
+    pad = _pair(pad or 0, ndim)
+    spatial = "DHW"[-ndim:]
+    if layout is None or layout.startswith("NC"):
+        dn_in = "NC" + spatial
+        dn_ker = "OI" + spatial
+        dn_out = "NC" + spatial
+        feat_axis = 1
+    else:  # NHWC-style (TPU-preferred)
+        dn_in = "N" + spatial + "C"
+        dn_ker = spatial + "IO"
+        dn_out = "N" + spatial + "C"
+        feat_axis = data.ndim - 1
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (dn_in, dn_ker, dn_out))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=None)
+    if not no_bias and bias is not None:
+        bshape = [1] * out.ndim
+        bshape[feat_axis] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@op("Deconvolution")
+def Deconvolution(data, weight, bias=None, *, kernel=(), stride=(),
+                  dilate=(), pad=(), adj=(), num_filter=0, num_group=1,
+                  no_bias=True, layout=None, target_shape=None,
+                  cudnn_tune=None, cudnn_off=False, workspace=512):
+    ndim = len(kernel)
+    stride = _pair(stride or 1, ndim)
+    pad = _pair(pad or 0, ndim)
+    dilate = _pair(dilate or 1, ndim)
+    adj = _pair(adj or 0, ndim)
+    spatial = "DHW"[-ndim:]
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape, ("NC" + spatial, "IO" + spatial,
+                                   "NC" + spatial))
+    pads = []
+    for k, s, p, d, a in zip(kernel, stride, pad, dilate, adj):
+        ke = (k - 1) * d + 1
+        pads.append((ke - 1 - p, ke - 1 - p + a))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=(1,) * ndim, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+@op("Pooling")
+def Pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
+            global_pool=False, pooling_convention="valid",
+            count_include_pad=True, layout=None, cudnn_off=False):
+    ndim = len(kernel) if kernel else data.ndim - 2
+    channels_last = layout is not None and layout[1] != "C"
+    sp = tuple(range(2, 2 + ndim)) if not channels_last else \
+        tuple(range(1, 1 + ndim))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(data, axis=sp, keepdims=True)
+        return jnp.mean(data, axis=sp, keepdims=True)
+    stride = _pair(stride or kernel, ndim)
+    pad = _pair(pad or 0, ndim)
+    if channels_last:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: pad extra on the high side so the last window fits
+        newpads = list(pads)
+        off = 2 if not channels_last else 1
+        for i in range(ndim):
+            size = data.shape[off + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+            lo, hi = newpads[off + i]
+            newpads[off + i] = (lo, hi + extra)
+        pads = tuple(newpads)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        p = 2.0
+        s = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add, window,
+                              strides, pads)
+        return s ** (1.0 / p)
+    raise MXNetError(f"unknown pool_type {pool_type}")
+
+
+# ----------------------------------------------------------------------- #
+# normalization — multi-output ops return (out, mean, var) so the Gluon
+# layer can commit moving stats functionally (SURVEY.md §7: no aux-state
+# mutation inside traced code)
+# ----------------------------------------------------------------------- #
+
+@op("_BatchNormStats")
+def _BatchNormStats(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    axis=1, training=True):
+    """Internal: returns ``(out, new_moving_mean, new_moving_var, batch_mean,
+    batch_var)``.  The Gluon layer commits the new moving stats functionally
+    (no aux-state mutation inside traced code, SURVEY.md §7)."""
+    red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+        + beta.reshape(bshape)
+    return (out.astype(data.dtype),
+            lax.stop_gradient(new_mm), lax.stop_gradient(new_mv),
+            lax.stop_gradient(mean), lax.stop_gradient(var))
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, cudnn_off=False, **_ignored):
+    """Reference anchor ``BatchNorm`` — public surface: one output by
+    default, ``(out, batch_mean, batch_var)`` with ``output_mean_var``.
+    Training behavior follows ``autograd.is_training()`` like the
+    reference."""
+    from .. import autograd
+    outs = _BatchNormStats(
+        data, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats, axis=axis,
+        training=autograd.is_training())
+    out, _mm, _mv, mean, var = outs
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@op("LayerNorm")
+def LayerNorm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    """Reference anchor ``LayerNorm`` (fused CUDA kernel there; XLA fuses
+    the reduction+scale chain here)."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@op("InstanceNorm")
+def InstanceNorm(data, gamma, beta, *, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + \
+        beta.reshape(shape)
+
+
+@op("GroupNorm")
+def GroupNorm(data, gamma, beta, *, num_groups=1, eps=1e-5):
+    n, c = data.shape[0], data.shape[1]
+    x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@op("RMSNorm")
+def RMSNorm(data, gamma, *, axis=-1, eps=1e-6):
+    """TPU-native addition (no reference analog; used by Llama-family
+    models)."""
+    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    return data * lax.rsqrt(ms + eps) * gamma
+
+
+# ----------------------------------------------------------------------- #
+# dropout / embedding
+# ----------------------------------------------------------------------- #
+
+@op("_DropoutImpl")
+def _DropoutImpl(data, key, *, p=0.5, axes=()):
+    """Pure dropout given an explicit uint32 PRNG key (randomness must be an
+    input to stay pure under jit)."""
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape)
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+def Dropout(data, key=None, *, p=0.5, mode="training", axes=(),
+            cudnn_off=False, training=None):
+    """Reference anchor ``Dropout`` (cudnn path there).  Applies in training
+    mode (``autograd.is_training()``) or when ``mode='always'``; a fresh key
+    is drawn from ``mxnet_tpu.random`` unless one is threaded explicitly
+    (hybridize does that)."""
+    from .. import autograd, random as mxrandom
+    if training is None:
+        training = autograd.is_training()
+    if (not training and mode != "always") or p <= 0.0:
+        return data
+    if key is None:
+        key = mxrandom.next_key()
+    return _DropoutImpl(data, key, p=p, axes=tuple(axes))
+
+
+@op("Embedding")
+def Embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    """Reference anchor ``Embedding``: gather rows.  On TPU this is a
+    ``take`` that XLA lowers to a dynamic-gather; sharded tables come from
+    GSPMD annotations (SURVEY.md §3.3 sparse/EP row)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ----------------------------------------------------------------------- #
+# losses shipped as ops in the reference
+# ----------------------------------------------------------------------- #
+
+@op("SoftmaxOutput")
+def SoftmaxOutput(data, label, *, grad_scale=1.0, ignore_label=-1,
+                  multi_output=False, use_ignore=False, preserve_shape=False,
+                  normalization="null", out_grad=False, smooth_alpha=0.0):
+    # forward = softmax; the custom gradient of the reference is modeled by
+    # the loss layers instead (gluon.loss.SoftmaxCrossEntropyLoss)
+    return jax.nn.softmax(data, axis=-1)
+
+
+@op("CTCLoss")
+def CTCLoss(data, label, data_lengths=None, label_lengths=None, *,
+            use_data_lengths=False, use_label_lengths=False,
+            blank_label="first"):
+    """CTC via the standard alpha recursion in log space with lax.scan
+    (reference: warp-ctc / native kernel).  data: (T, B, V) logits."""
+    T, B, V = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else V - 1
+    lab = label.astype(jnp.int32)
+    Lmax = lab.shape[1]
+    if label_lengths is not None and use_label_lengths:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        # count non-(-1|0) entries per reference convention (-1 padding)
+        lab_len = jnp.sum((lab >= 0) & (lab != -1), axis=1).astype(jnp.int32)
+        lab_len = jnp.where(lab_len == 0, Lmax, lab_len)
+    if data_lengths is not None and use_data_lengths:
+        t_len = data_lengths.astype(jnp.int32)
+    else:
+        t_len = jnp.full((B,), T, jnp.int32)
+
+    S = 2 * Lmax + 1
+    # extended label seq: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(lab == -1, blank, lab))
+    neg_inf = -1e30
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), blank])
+    first_lab = ext[:, 1]
+    alpha0 = alpha0.at[:, 1].set(logp[0, jnp.arange(B), first_lab])
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+        return jnp.where((a <= neg_inf) & (b <= neg_inf), neg_inf,
+                         m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m)))
+
+    same = jnp.concatenate(
+        [jnp.ones((B, 2), bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        shifted1 = jnp.concatenate([jnp.full((B, 1), neg_inf),
+                                    alpha[:, :-1]], axis=1)
+        shifted2 = jnp.concatenate([jnp.full((B, 2), neg_inf),
+                                    alpha[:, :-2]], axis=1)
+        a = lse(alpha, shifted1)
+        a = jnp.where(same, a, lse(a, shifted2))
+        emit = logp[t, jnp.arange(B)[:, None], ext]
+        new = a + emit
+        new = jnp.where((t < t_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = 2 * lab_len
+    end2 = 2 * lab_len - 1
+    br = jnp.arange(B)
+    ll = lse(alpha[br, end1], alpha[br, jnp.maximum(end2, 0)])
+    return -ll
+
+
+@op("MakeLoss")
+def MakeLoss(data, *, grad_scale=1.0, valid_thresh=0.0,
+             normalization="null"):
+    return data
+
+
+# ----------------------------------------------------------------------- #
+# attention (reference: contrib interleaved matmul selfatt ops, BERT path)
+# ----------------------------------------------------------------------- #
+
+@op("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, *, heads=1):
+    """(L, B, 3*E) interleaved qkv -> (B*heads, L, L) scores (reference
+    anchor ``_contrib_interleaved_matmul_selfatt_qk``)."""
+    L, B, E3 = queries_keys_values.shape
+    E = E3 // 3
+    x = queries_keys_values.reshape(L, B, heads, 3 * (E // heads))
+    hd = E // heads
+    q = x[..., :hd]
+    k = x[..., hd:2 * hd]
+    q = jnp.transpose(q, (1, 2, 0, 3)).reshape(B * heads, L, hd)
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(B * heads, L, hd)
+    return jnp.matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+
+
+@op("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *,
+                                      heads=1):
+    L, B, E3 = queries_keys_values.shape
+    E = E3 // 3
+    hd = E // heads
+    x = queries_keys_values.reshape(L, B, heads, 3 * hd)
+    v = x[..., 2 * hd:]
+    v = jnp.transpose(v, (1, 2, 0, 3)).reshape(B * heads, L, hd)
+    out = jnp.matmul(attention, v)  # (B*heads, L, hd)
+    out = out.reshape(B, heads, L, hd)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(L, B, E)

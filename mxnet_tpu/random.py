@@ -1,0 +1,184 @@
+"""Random number generation.
+
+Reference surface: ``mx.random.*`` / ``mx.nd.random_*`` ops backed by
+per-device RNG resources (SURVEY.md §3.1 "Resource manager": RNG streams via
+``FResourceRequest``).
+
+TPU-native: JAX randomness is functional — a uint32 key is an explicit
+input.  A process-global ``RandomState`` owns the root key and splits it per
+draw (imperative path); when tracing a hybridized block, the cached
+executable takes a fresh key *argument* per call and ops split from it via a
+trace-key stack (so compiled dropout still differs per step — the analog of
+the reference's per-invocation RNG resource)."""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError
+from .ops.registry import Op, invoke
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
+           "exponential", "poisson", "multinomial", "shuffle", "bernoulli",
+           "next_key", "current_seed"]
+
+_state = threading.local()
+
+
+def _root():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(onp.random.randint(0, 2**31 - 1))
+        _state.seed_val = None
+    return _state
+
+
+def seed(seed_state, ctx="all"):
+    """``mx.random.seed`` — reset the root key."""
+    st = _root()
+    st.key = jax.random.PRNGKey(int(seed_state))
+    st.seed_val = int(seed_state)
+
+
+def current_seed():
+    return _root().seed_val
+
+
+# trace-key stack: pushed by CachedOp while tracing/executing jit code
+def push_trace_key(key):
+    st = _root()
+    if not hasattr(st, "trace_stack"):
+        st.trace_stack = []
+    st.trace_stack.append(key)
+
+
+def pop_trace_key():
+    _root().trace_stack.pop()
+
+
+def next_key():
+    """Get a fresh PRNG key; splits trace key under jit, global key eagerly."""
+    st = _root()
+    stack = getattr(st, "trace_stack", None)
+    if stack:
+        k, sub = jax.random.split(stack[-1])
+        stack[-1] = k
+        return sub
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def _sample(name, fn, shape, dtype, ctx, extra_arrays=(), **params):
+    from .ndarray.ndarray import NDArray
+    key = next_key()
+
+    def impl(k, *arrs):
+        return fn(k, *arrs, **params).astype(jnp.dtype(dtype or "float32"))
+
+    o = Op(name=name, fn=impl, differentiable=False)
+    out = invoke(o, [NDArray(key)] + list(extra_arrays), {})
+    if ctx is not None:
+        out = out.as_in_context(ctx)
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    r = _sample("_random_uniform",
+                lambda k: jax.random.uniform(k, tuple(_shape(shape)),
+                                             minval=low, maxval=high),
+                shape, dtype, ctx)
+    return _out(r, out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    r = _sample("_random_normal",
+                lambda k: jax.random.normal(k, tuple(_shape(shape))) * scale
+                + loc, shape, dtype, ctx)
+    return _out(r, out)
+
+
+def randn(*shape, dtype="float32", ctx=None):
+    return normal(0.0, 1.0, shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    r = _sample("_random_randint",
+                lambda k: jax.random.randint(k, tuple(_shape(shape)), low,
+                                             high), shape, dtype, ctx)
+    return _out(r, out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    r = _sample("_random_gamma",
+                lambda k: jax.random.gamma(k, alpha, tuple(_shape(shape)))
+                * beta, shape, dtype, ctx)
+    return _out(r, out)
+
+
+def exponential(scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    r = _sample("_random_exponential",
+                lambda k: jax.random.exponential(k, tuple(_shape(shape)))
+                * scale, shape, dtype, ctx)
+    return _out(r, out)
+
+
+def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    r = _sample("_random_poisson",
+                lambda k: jax.random.poisson(k, lam, tuple(_shape(shape))),
+                shape, dtype, ctx)
+    return _out(r, out)
+
+
+def bernoulli(prob=0.5, shape=(1,), dtype="float32", ctx=None, out=None):
+    r = _sample("_random_bernoulli",
+                lambda k: jax.random.bernoulli(k, prob, tuple(_shape(shape))),
+                shape, dtype, ctx)
+    return _out(r, out)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    """Sample from categorical distribution(s) given probabilities."""
+    from .ndarray.ndarray import NDArray
+    n = shape if isinstance(shape, int) else int(onp.prod(shape))
+    key = next_key()
+
+    def impl(k, p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        s = jax.random.categorical(k, logits, axis=-1,
+                                   shape=(n,) + logits.shape[:-1])
+        s = jnp.moveaxis(s, 0, -1)
+        if s.shape[-1] == 1 and shape == 1:
+            s = s[..., 0]
+        return s.astype(jnp.dtype(dtype))
+
+    o = Op(name="_sample_multinomial", fn=impl, differentiable=False)
+    samp = invoke(o, [NDArray(key), data], {})
+    if get_prob:
+        from .ops import defs as _ops
+        logp = _ops.log(_ops.pick(data, samp.astype("float32"), axis=-1))
+        return samp, logp
+    return samp
+
+
+def shuffle(data, **kwargs):
+    from .ndarray.ndarray import NDArray
+    key = next_key()
+
+    def impl(k, x):
+        return jax.random.permutation(k, x, axis=0)
+
+    o = Op(name="_shuffle", fn=impl, differentiable=False)
+    return invoke(o, [NDArray(key), data], {})
+
+
+def _shape(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _out(r, out):
+    if out is not None:
+        out._rebind(r._data)
+        return out
+    return r

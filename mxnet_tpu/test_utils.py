@@ -1,0 +1,168 @@
+"""Testing utilities.
+
+Reference surface: ``python/mxnet/test_utils.py`` (SURVEY.md §3.2
+"test_utils": ``assert_almost_equal`` with per-dtype tolerance,
+``check_numeric_gradient`` finite differences vs autograd,
+``check_consistency`` across contexts/dtypes, ``rand_ndarray``,
+``with_seed``)."""
+from __future__ import annotations
+
+import functools
+import random as pyrandom
+
+import numpy as onp
+
+from .base import MXNetError
+from .context import cpu, current_context
+from .ndarray.ndarray import NDArray, array
+
+_DTYPE_TOL = {
+    onp.dtype(onp.float16): (1e-2, 1e-2),
+    onp.dtype(onp.float32): (1e-4, 1e-5),
+    onp.dtype(onp.float64): (1e-6, 1e-8),
+}
+
+
+def default_rtol_atol(*arrays):
+    rtol, atol = 1e-5, 1e-8
+    for a in arrays:
+        d = onp.dtype(getattr(a, "dtype", onp.float32))
+        if d in _DTYPE_TOL:
+            r, t = _DTYPE_TOL[d]
+            rtol, atol = max(rtol, r), max(atol, t)
+    return rtol, atol
+
+
+def _np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_, b_ = _np(a), _np(b)
+    if rtol is None or atol is None:
+        r, t = default_rtol_atol(a_, b_)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    onp.testing.assert_allclose(a_, b_, rtol=rtol, atol=atol,
+                                err_msg=f"{names[0]} vs {names[1]}")
+
+
+def same(a, b):
+    return onp.array_equal(_np(a), _np(b))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 ctx=None):
+    data = onp.random.uniform(-1, 1, size=shape).astype(dtype)
+    nd = array(data, ctx=ctx)
+    if stype != "default":
+        return nd.tostype(stype)
+    return nd
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite differences vs the autograd tape (reference anchor
+    ``check_numeric_gradient``).  ``fn`` maps NDArrays -> scalar NDArray."""
+    from . import autograd
+
+    nds = [array(onp.asarray(x, onp.float32)) if not isinstance(x, NDArray)
+           else x for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+    out.backward()
+
+    for i, x in enumerate(nds):
+        base = onp.ascontiguousarray(x.asnumpy().astype(onp.float64))
+        num = onp.zeros(base.shape, onp.float64)
+        flat = base.reshape(-1)
+        gflat = num.reshape(-1)
+        for j in range(flat.size):
+            pp, pm = flat.copy(), flat.copy()
+            pp[j] += eps
+            pm[j] -= eps
+            def val(v):
+                args = []
+                for k, y in enumerate(nds):
+                    if k == i:
+                        args.append(array(v.reshape(x.shape).astype(onp.float32)))
+                    else:
+                        args.append(y.detach())
+                with autograd.pause():
+                    return float(fn(*args).asnumpy())
+            gflat[j] = (val(pp) - val(pm)) / (2 * eps)
+        assert_almost_equal(num, x.grad.asnumpy(), rtol=rtol, atol=atol,
+                            names=(f"numeric_grad[{i}]", f"autograd[{i}]"))
+
+
+def check_consistency(fn, inputs, dtypes=("float32",), rtol=None, atol=None):
+    """Run ``fn`` under each dtype and compare results against the first
+    (reference anchor ``check_consistency`` across ctx/dtype)."""
+    ref = None
+    for dt in dtypes:
+        args = [array(_np(x).astype(dt)) for x in inputs]
+        out = _np(fn(*args)).astype(onp.float64)
+        if ref is None:
+            ref = out
+        else:
+            r, t = default_rtol_atol(onp.zeros(1, dt))
+            assert_almost_equal(out, ref, rtol=rtol or r, atol=atol or t)
+
+
+def with_seed(seed=None):
+    """Decorator: seed numpy/python/mx per test, report on failure
+    (reference anchor ``with_seed``)."""
+
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            from . import random as mxrandom
+            s = seed if seed is not None else onp.random.randint(0, 2**31)
+            onp.random.seed(s)
+            pyrandom.seed(s)
+            mxrandom.seed(s)
+            try:
+                return f(*args, **kwargs)
+            except Exception:
+                print(f"Test failed with seed {s} (set with_seed({s}) to "
+                      f"reproduce)")
+                raise
+        return wrapper
+
+    return deco
+
+
+class environment:
+    """Temporarily set environment variables (reference
+    ``mx.util.environment`` test helper)."""
+
+    def __init__(self, *args):
+        import os
+        self._os = os
+        if len(args) == 2:
+            self._vals = {args[0]: args[1]}
+        else:
+            self._vals = dict(args[0])
+
+    def __enter__(self):
+        self._old = {k: self._os.environ.get(k) for k in self._vals}
+        for k, v in self._vals.items():
+            if v is None:
+                self._os.environ.pop(k, None)
+            else:
+                self._os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *a):
+        for k, v in self._old.items():
+            if v is None:
+                self._os.environ.pop(k, None)
+            else:
+                self._os.environ[k] = v

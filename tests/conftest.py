@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU platform so collective /
+sharding tests run without TPU hardware (mirrors the reference's
+multi-process-on-localhost nightly pattern, SURVEY.md §7 test strategy).
+Must set XLA flags before jax initializes."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as onp
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import mxnet_tpu as mx
+    onp.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,202 @@
+"""Autograd tape tests (mirrors reference
+``tests/python/unittest/test_autograd.py``)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_record_flags():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert autograd.is_recording()
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([2.0, 4.0]))
+    assert_almost_equal(x.grad, [6.0, 12.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad, [4.0, 4.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    assert_almost_equal(x.grad, [5.0])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    w = nd.array([2.0])
+    w.attach_grad()
+    with autograd.record():
+        y = x * w
+    y.backward()
+    assert_almost_equal(w.grad, [1.0])
+    assert_almost_equal(x.grad, [0.0])
+
+
+def test_multi_path_accumulation():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3  # dy/dx = 2x + 3 = 7
+    y.backward()
+    assert_almost_equal(x.grad, [7.0])
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # z = const(4) * x
+    z.backward()
+    assert_almost_equal(x.grad, [4.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    (gx,) = autograd.grad(y, [x])
+    assert_almost_equal(gx, [6.0])
+    # .grad untouched
+    assert_almost_equal(x.grad, [0.0])
+
+
+def test_grad_wrt_intermediate():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * y  # z = x^4, dz/dy = 2y = 8
+    (gy,) = autograd.grad(z, [y])
+    assert_almost_equal(gy, [8.0])
+
+
+def test_backward_twice_raises_without_retain():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert_almost_equal(x.grad, [8.0])
+
+
+def test_training_flag_dropout():
+    x = nd.ones((100,))
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables(x, g)
+    with autograd.record():
+        y = (x * 4).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [4.0, 4.0])
+
+
+def test_custom_function():
+    class MySigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = MySigmoid()
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5, atol=1e-6)
+
+
+def test_inplace_rebind_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y += 1  # rebind; grad still flows through the mul
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, [2.0, 2.0])
+
+
+def test_setitem_inside_record_raises():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            y[0] = 5.0
+
+
+def test_multi_output_op_grad():
+    x = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=3, axis=1)
+        z = (parts[0] * 1 + parts[2] * 3).sum()
+    z.backward()
+    assert_almost_equal(x.grad, [[1, 0, 3], [1, 0, 3]])
