@@ -106,9 +106,9 @@ class TapeNode:
     input cotangents (closing over XLA-resident residuals)."""
 
     __slots__ = ("name", "vjp_fn", "parents", "outputs", "out_avals",
-                 "__weakref__")
+                 "multi", "__weakref__")
 
-    def __init__(self, name, vjp_fn, parents, out_avals):
+    def __init__(self, name, vjp_fn, parents, out_avals, multi=None):
         self.name = name
         self.vjp_fn = vjp_fn
         # parents[i] corresponds to primal input i:
@@ -116,6 +116,8 @@ class TapeNode:
         self.parents = parents
         self.outputs = []  # weakrefs, set by invoke()
         self.out_avals = out_avals
+        # whether vjp_fn expects a tuple cotangent (fn returned tuple/list)
+        self.multi = len(out_avals) > 1 if multi is None else multi
 
 
 class _FreedGraph:
@@ -178,7 +180,7 @@ def _record_invoke(opref, primals, kwargs, array_args):
     multi = isinstance(results, (tuple, list))
     outs = list(results) if multi else [results]
     node = TapeNode(opref.name, vjp_fn, parents,
-                    [jax.typeof(o) for o in outs])
+                    [jax.typeof(o) for o in outs], multi=multi)
     return results, node
 
 
@@ -290,7 +292,7 @@ def _backward_walk(heads, head_grads, targets=None, retain_graph=False):
             raise MXNetError(
                 "graph already freed: call backward(retain_graph=True) to "
                 "backprop through the same graph twice")
-        arg = tuple(filled) if len(filled) > 1 or _node_multi(node) else filled[0]
+        arg = tuple(filled) if node.multi else filled[0]
         in_cots = node.vjp_fn(arg)
         if not retain_graph:
             node.vjp_fn = None  # free residuals
@@ -339,10 +341,6 @@ def _backward_walk(heads, head_grads, targets=None, retain_graph=False):
             arr._grad._rebind(jnp.asarray(c, arr._grad._data.dtype)
                               if c.dtype != arr._grad._data.dtype else c)
     return None
-
-
-def _node_multi(node) -> bool:
-    return len(node.out_avals) > 1
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
@@ -449,7 +447,7 @@ class Function:
             else:
                 parents.append(None)
         node = TapeNode(type(self).__name__, vjp_fn, parents,
-                        [jax.typeof(o._data) for o in outs])
+                        [jax.typeof(o._data) for o in outs], multi=multi)
         for i, o in enumerate(outs):
             o._autograd_node = node
             o._autograd_idx = i

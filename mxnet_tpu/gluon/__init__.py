@@ -1,0 +1,27 @@
+"""Gluon — the high-level training API (reference:
+``python/mxnet/gluon/``, SURVEY.md §3.2 / L9)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+
+from importlib import import_module as _imp
+
+
+def __getattr__(name):
+    _lazy = {
+        "rnn": ".rnn",
+        "data": ".data",
+        "model_zoo": ".model_zoo",
+        "contrib": ".contrib",
+        "utils": ".utils",
+    }
+    if name == "Trainer":
+        from .trainer import Trainer
+        return Trainer
+    if name in _lazy:
+        mod = _imp(_lazy[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

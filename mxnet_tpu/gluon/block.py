@@ -1,0 +1,601 @@
+"""Gluon Block / HybridBlock — define-by-run modules with trace-to-XLA
+hybridization.
+
+Reference surface: ``python/mxnet/gluon/block.py`` (SURVEY.md §3.2 "Gluon
+core"; §4.2 call stack): ``Block`` (child registry, collect_params,
+save/load_parameters, hooks), ``HybridBlock.hybridize()`` builds a
+``CachedOp`` — the reference's hybridization engine
+(``src/imperative/cached_op.cc``) that traces ``hybrid_forward`` once per
+input signature and replays the cached graph.
+
+TPU-native redesign (SURVEY.md §7 "Hybridize/CachedOp"): hybridize traces the
+block's imperative forward into ONE pure jax function
+``fn(key, *params, *inputs) -> (*outputs, *aux_updates)`` and wraps it in
+``jax.jit`` — jit's shape/dtype-keyed trace cache plays the role of the
+reference's per-(shape,dtype,ctx) ``GraphInfo`` cache, and XLA fusion plays
+op bulking.  When autograd is recording, the jitted function is invoked
+through the op registry so the tape records ONE CachedOp node (exactly like
+the reference records one CachedOp node, §4.2).  Mutable state (BatchNorm
+moving stats) is returned functionally as aux outputs and committed after
+execution — no tracer ever leaks into a Parameter.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "_TraceState"]
+
+
+# --------------------------------------------------------------------------- #
+# naming scope (reference anchor ``_BlockScope`` in gluon/block.py)
+# --------------------------------------------------------------------------- #
+
+class _BlockScope:
+    _current = threading.local()
+    _global_counter: dict = {}
+
+    def __init__(self, block):
+        self._block = block
+        self._counter: dict = {}
+        self._old = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Return (prefix, ParameterDict) for a new Block."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                count = _BlockScope._global_counter.get(hint, 0)
+                _BlockScope._global_counter[hint] = count + 1
+                prefix = f"{hint}{count}_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        full_prefix = current._block.prefix + prefix
+        if params is None:
+            params = ParameterDict(full_prefix)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return full_prefix, params
+
+    def __enter__(self):
+        self._old = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        _BlockScope._current.value = self._old
+
+
+# --------------------------------------------------------------------------- #
+# trace state: set while a CachedOp traces/executes; layers consult it to
+# stage aux-state updates instead of mutating Parameters (tracer-leak guard)
+# --------------------------------------------------------------------------- #
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.stack = []  # list of OrderedDict{id(param): (param, value)}
+        self.no_hybrid = 0  # >0: force imperative forward (inline children)
+
+    @property
+    def active(self):
+        return bool(self.stack)
+
+    def stage(self, param, value):
+        self.stack[-1][id(param)] = (param, value)
+
+
+_trace_state = _TraceState()
+
+
+def commit_aux(param: Parameter, value):
+    """Commit an aux-state update (e.g. BN moving stats).  Inside a trace:
+    staged as a functional output; imperatively: set_data under pause."""
+    from .. import autograd
+
+    data = value._data if isinstance(value, NDArray) else value
+    if _trace_state.active:
+        _trace_state.stage(param, data)
+    else:
+        with autograd.pause():
+            param.set_data(NDArray(data))
+
+
+# --------------------------------------------------------------------------- #
+# Block
+# --------------------------------------------------------------------------- #
+
+class Block:
+    """Base container (reference anchor ``class Block``)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        hint = _classname_hint(type(self).__name__)
+        self._prefix, self._params = _BlockScope.create(prefix, params, hint)
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks: "OrderedDict[int, callable]" = OrderedDict()
+        self._forward_pre_hooks: "OrderedDict[int, callable]" = OrderedDict()
+
+    # -- naming ----------------------------------------------------------- #
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """``with self.name_scope():`` — children/params created inside get
+        hierarchical names."""
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    # -- registration ----------------------------------------------------- #
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+        return block
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks, hook)
+        return handle
+
+    def register_forward_pre_hook(self, hook):
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    # -- parameter management --------------------------------------------- #
+    def collect_params(self, select=None) -> ParameterDict:
+        """All params in the subtree, optionally regex-filtered (reference
+        ``collect_params('.*weight')``)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items()
+                        if pat.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structure-based names ('features.0.weight') used by
+        save_parameters (reference ``_collect_params_with_prefix``)."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + n: p for n, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+        return self
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Reference format: dict of structured-name -> array (``.params``
+        binary, ndarray/serialization.py)."""
+        from ..ndarray import serialization
+        params = self._collect_params_with_prefix()
+        arrays = {}
+        seen = {}
+        for name, p in params.items():
+            if deduplicate and id(p) in seen:
+                continue
+            seen[id(p)] = name
+            arrays[name] = p.data()
+        serialization.save(filename, arrays)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import serialization
+        loaded = serialization.load(filename)
+        params = self._collect_params_with_prefix()
+        if not any("." in k for k in loaded) and any("." in k for k in params):
+            # file uses flat parameter names (ParameterDict.save) — remap
+            byname = {p.name: p for p in params.values()}
+            for k, v in loaded.items():
+                if k in byname:
+                    _load_one(byname[k], v, ctx)
+                elif not ignore_extra:
+                    raise MXNetError(f"extra parameter {k} in {filename}")
+            return
+        for name, p in params.items():
+            if name in loaded:
+                _load_one(p, loaded[name], ctx)
+            elif not allow_missing:
+                raise MXNetError(f"missing parameter {name} in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)}")
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self.params.values():
+            p.cast(dtype)
+        return self
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    def hybridize(self, active=True, **kwargs):
+        """Recursive; plain Blocks only forward to children (reference
+        behavior)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print a per-layer summary table (reference ``Block.summary``)."""
+        rows = []
+
+        def walk(block, depth):
+            n_params = sum(int(onp.prod(p.shape)) for p in
+                           block._reg_params.values()
+                           if p.shape and all(s > 0 for s in p.shape))
+            rows.append(("  " * depth + type(block).__name__,
+                         block.name, n_params))
+            for c in block._children.values():
+                walk(c, depth + 1)
+
+        walk(self, 0)
+        total = sum(r[2] for r in rows)
+        lines = [f"{'Layer':<40}{'Name':<30}{'Params':>12}", "-" * 82]
+        lines += [f"{r[0]:<40}{r[1]:<30}{r[2]:>12}" for r in rows]
+        lines.append("-" * 82)
+        lines.append(f"{'Total params:':<70}{total:>12}")
+        print("\n".join(lines))
+
+    # -- forward ----------------------------------------------------------- #
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __repr__(self):
+        lines = []
+        for name, child in self._children.items():
+            mod = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {mod}")
+        body = "\n".join(lines)
+        return f"{type(self).__name__}(\n{body}\n)" if body else \
+            f"{type(self).__name__}()"
+
+
+def _load_one(p: Parameter, src: NDArray, ctx):
+    p.shape = tuple(src.shape)
+    if p._deferred_init is not None:
+        p._finish_deferred_init()
+    if p._data is None:
+        init, c, default = (None, [ctx] if isinstance(ctx, Context)
+                            else ctx, None)
+        p._set_data_arr(NDArray(jnp.asarray(src._data, jnp.dtype(p.dtype))))
+    else:
+        p.set_data(src)
+
+
+def _classname_hint(name):
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0 and not name[i - 1].isupper():
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out).replace("_", "")
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        hooks[self._id] = hook
+
+    def detach(self):
+        self._hooks.pop(self._id, None)
+
+
+# --------------------------------------------------------------------------- #
+# HybridBlock + CachedOp
+# --------------------------------------------------------------------------- #
+
+class HybridBlock(Block):
+    """Block whose forward is expressed as ``hybrid_forward(F, x, *args,
+    **params)`` and can be traced to one compiled XLA computation."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from concrete inputs.  Builtin
+        layers override this; custom blocks with deferred params must too
+        (the reference solves this with symbolic shape inference; here
+        inference is layer-local because execution is define-by-run)."""
+        raise MXNetError(
+            f"{type(self).__name__} has deferred-init parameters but no "
+            "infer_shape; give explicit in_units/in_channels or override "
+            "infer_shape")
+
+    def cast(self, dtype):
+        self._cached_op = None
+        return super().cast(dtype)
+
+    # -- forward dispatch -------------------------------------------------- #
+    def __call__(self, *args, **kwargs):
+        if self._active and not _trace_state.no_hybrid:
+            for hook in self._forward_pre_hooks.values():
+                hook(self, args)
+            out = self._call_cached_op(*args, **kwargs)
+            for hook in self._forward_hooks.values():
+                hook(self, args, out)
+            return out
+        return super().__call__(*args, **kwargs)
+
+    def forward(self, x, *args, **kwargs):
+        from .. import ndarray as F
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer_shape(x, *args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, x, *args, **params, **kwargs)
+
+    def _deferred_infer_shape(self, *args):
+        self.infer_shape(*args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- cached-op path ---------------------------------------------------- #
+    def _call_cached_op(self, *args, **kwargs):
+        if self._cached_op is None:
+            self._cached_op = _CachedOp(self, self._flags)
+        return self._cached_op(args, kwargs)
+
+    def export(self, path, epoch=0):
+        """Save params in the reference's export layout
+        (``path-symbol.json`` stub + ``path-%04d.params``); see
+        SURVEY.md §5.4(b)."""
+        import json
+        params = self._collect_params_with_prefix()
+        meta = {
+            "format": "mxnet_tpu-hybrid-v1",
+            "block": type(self).__name__,
+            "params": {n: {"shape": list(p.shape), "dtype":
+                           onp.dtype(p.dtype).name}
+                       for n, p in params.items()},
+        }
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        from ..ndarray import serialization
+        serialization.save(f"{path}-{epoch:04d}.params",
+                           {n: p.data() for n, p in params.items()})
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        """Reference ``optimize_for(backend)``: partition/compile for a
+        backend.  XLA is the only backend; equivalent to hybridize + warmup
+        call."""
+        self.hybridize()
+        return self(x, *args)
+
+
+class _CachedOp:
+    """Traced, jitted executable for one HybridBlock (the reference's
+    ``CachedOp``, src/imperative/cached_op.cc).
+
+    Pure function layout::
+
+        fn(key, *param_arrays, *input_arrays; training) ->
+            (*outputs, *aux_updates)
+
+    jax.jit caches per shape/dtype signature (== reference GraphInfo cache);
+    ``training`` is a static argument (two traces, train/eval, like the
+    reference's separate fwd graphs).  When autograd records, the jitted fn
+    goes through ``ops.registry.invoke`` so the tape holds ONE node whose vjp
+    is the compiled backward (== "record ONE CachedOp node", SURVEY.md §4.2).
+    """
+
+    def __init__(self, block: HybridBlock, flags):
+        self._block = block
+        self._flags = flags
+        self._param_list = None   # ordered [(name, Parameter)]
+        # per-training-mode output structure, set at that mode's first trace:
+        # training -> (out_count, out_is_seq, [aux Parameters])
+        self._structure = {}
+        self._jitted = {}         # training flag -> jitted fn
+
+    def _ensure_params(self, args, kwargs):
+        if self._param_list is not None:
+            return
+        # materialize deferred params with one imperative forward
+        params = self._block.collect_params()
+        needs_init = any(p._data is None for p in params.values())
+        if needs_init:
+            with _no_hybrid():
+                self._block(*args, **kwargs)
+            params = self._block.collect_params()
+        self._param_list = [(n, p) for n, p in params.items()
+                            if p._data is not None]
+
+    def _make_fn(self, training):
+        block = self._block
+        names = [n for n, _ in self._param_list]
+        param_objs = [p for _, p in self._param_list]
+
+        def fn(key, *arrays):
+            from .. import autograd, random as mxrandom
+            n = len(param_objs)
+            param_vals, inputs = arrays[:n], arrays[n:]
+            saved = [p._data._data for p in param_objs]
+            saved_nodes = [(p._data._autograd_node, p._data._autograd_idx)
+                           for p in param_objs]
+            aux: OrderedDict = OrderedDict()
+            _trace_state.stack.append(aux)
+            mxrandom.push_trace_key(key)
+            try:
+                for p, v in zip(param_objs, param_vals):
+                    p._data._data = v
+                    p._data._autograd_node = None
+                nd_inputs = [NDArray(x) if not isinstance(x, NDArray) else x
+                             for x in inputs]
+                with autograd.pause(train_mode=training):
+                    with _no_hybrid():
+                        out = block.forward(*nd_inputs)
+            finally:
+                for p, v, (node, idx) in zip(param_objs, saved, saved_nodes):
+                    p._data._data = v
+                    p._data._autograd_node = node
+                    p._data._autograd_idx = idx
+                mxrandom.pop_trace_key()
+                _trace_state.stack.pop()
+
+            is_seq = isinstance(out, (tuple, list))
+            outs = list(out) if is_seq else [out]
+            out_arrays = [o._data if isinstance(o, NDArray) else o
+                          for o in outs]
+            aux_params = [p for (p, _v) in aux.values()]
+            aux_values = [jax.lax.stop_gradient(v) for (_p, v) in aux.values()]
+            # record structure at this mode's first trace
+            if training not in self._structure:
+                self._structure[training] = (len(out_arrays), is_seq,
+                                             aux_params)
+            return tuple(out_arrays) + tuple(aux_values)
+
+        return fn
+
+    def _get_jitted(self, training):
+        if training not in self._jitted:
+            raw = self._make_fn(training)
+            self._jitted[training] = jax.jit(raw)
+        return self._jitted[training]
+
+    def __call__(self, args, kwargs):
+        from .. import autograd, random as mxrandom
+        from ..ops.registry import Op, invoke
+
+        if kwargs:
+            raise MXNetError(
+                "hybridized blocks accept positional arguments only "
+                "(reference CachedOp semantics); pass extra tensors "
+                "positionally or un-hybridize")
+        self._ensure_params(args, kwargs)
+        training = autograd.is_training()
+        fn = self._get_jitted(training)
+        if training not in self._structure:
+            # prime structure info with an eval_shape trace (no device work)
+            key0 = jax.random.PRNGKey(0)
+            param_vals = [p._data._data for _, p in self._param_list]
+            in_vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                       for a in args]
+            jax.eval_shape(fn, key0, *param_vals, *in_vals)
+
+        key = mxrandom.next_key()
+        param_nds = [p._data for _, p in self._param_list]
+        input_nds = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+                     for a in args]
+        opref = Op(name=f"CachedOp_{self._block.name}", fn=fn)
+        result = invoke(opref, [NDArray(key)] + param_nds + input_nds, {})
+        outs = result if isinstance(result, list) else [result]
+        n_out, out_is_seq, aux_params = self._structure[training]
+        primary, aux_vals = outs[:n_out], outs[n_out:]
+        # commit aux updates (concrete arrays — safe)
+        for p, v in zip(aux_params, aux_vals):
+            with autograd.pause():
+                p.set_data(v)
+        if out_is_seq:
+            return list(primary)
+        return primary[0]
+
+
+class _no_hybrid:
+    """Temporarily force imperative forward for all HybridBlocks on this
+    thread (used while tracing so nested CachedOps inline, like the
+    reference inlines child graphs into the parent CachedOp)."""
+
+    def __enter__(self):
+        _trace_state.no_hybrid += 1
+        return self
+
+    def __exit__(self, *a):
+        _trace_state.no_hybrid -= 1
+
+
+class SymbolBlock(HybridBlock):
+    """Load an exported graph as a Block (reference anchor
+    ``SymbolBlock.imports``).  Until the symbolic IR lands, imports restores
+    architecture-less parameter bundles and raises on forward."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        raise MXNetError(
+            "SymbolBlock.imports requires the symbol IR (planned phase 5, "
+            "SURVEY.md §7); use Block.load_parameters with the original "
+            "model class instead")

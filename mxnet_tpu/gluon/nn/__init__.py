@@ -1,0 +1,4 @@
+"""Gluon neural-network layers (reference: ``python/mxnet/gluon/nn/``)."""
+from .basic_layers import *
+from .conv_layers import *
+from . import basic_layers, conv_layers

@@ -1,0 +1,402 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference surface: ``python/mxnet/gluon/parameter.py`` (SURVEY.md §3.2
+"Gluon core": Parameter with deferred shape inference at first forward,
+per-ctx replicated ``data()/grad()`` copies, ``grad_req`` write/add/null,
+``ParameterDict`` prefix scoping + sharing, ``Constant``).
+
+TPU-native redesign: a Parameter owns ONE canonical NDArray (optionally with
+a ``NamedSharding`` laying it out over a device mesh) instead of per-GPU
+replicas — replication/sharding is a GSPMD property of the array, not N
+copies.  ``list_data()/list_ctx()`` keep the reference API for porting; with
+a single-device context they return singleton lists.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from .. import initializer as init_mod
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when ``data()`` is called before shapes are known (reference
+    anchor: "deferred initialization" error string)."""
+
+
+def _shape_is_known(shape) -> bool:
+    if shape is None:
+        return False
+    return all(s is not None and s > 0 for s in shape)
+
+
+class Parameter:
+    """A trainable tensor held by Blocks.
+
+    ``grad_req``: 'write' (overwrite each backward), 'add' (accumulate;
+    caller zero-grads), 'null' (no gradient — aux states like BN moving
+    stats)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=onp.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = None
+        self._data: Optional[NDArray] = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self.grad_req = grad_req
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data: Optional[NDArray] = None
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._trainer = None
+        self._sharding = None  # jax.sharding.NamedSharding when meshed
+
+    # ------------------------------------------------------------------ #
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req}")
+        if not self._differentiable:
+            req = "null"
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if new_shape is None:
+            return
+        new_shape = tuple(new_shape)
+        if self._shape is not None:
+            if len(self._shape) != len(new_shape) or any(
+                    s not in (0, None) and s != n
+                    for s, n in zip(self._shape, new_shape)):
+                raise MXNetError(
+                    f"shape mismatch for {self.name}: {self._shape} vs "
+                    f"{new_shape}")
+        self._shape = new_shape
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={onp.dtype(self.dtype).name})")
+
+    # ------------------------------------------------------------------ #
+    # initialization
+    # ------------------------------------------------------------------ #
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Create and fill the canonical array.  Deferred when the shape has
+        unknown (0) dims (reference deferred-init mechanism)."""
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if not _shape_is_known(self._shape):
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize {self.name}: shape {self._shape} "
+                    "unknown and allow_deferred_init=False")
+            self._deferred_init = (init, ctx, default_init)
+            return
+        self._init_impl(init, ctx, default_init)
+
+    def _init_impl(self, init, ctx_list, default_init):
+        from .. import random as mxrandom
+
+        ini = init_mod.create(init) if init is not None else \
+            (init_mod.create(self.init) if self.init is not None
+             else default_init)
+        ctx = ctx_list[0]
+        arr = NDArray(jnp.zeros(self._shape, jnp.dtype(self.dtype)), ctx)
+        desc = init_mod.InitDesc(self.name)
+        ini(desc, arr)
+        if self._sharding is not None:
+            arr._rebind(jax.device_put(arr._data, self._sharding))
+        elif ctx is not None:
+            arr._rebind(jax.device_put(arr._data, ctx.jax_device()))
+        self._set_data_arr(arr)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not _shape_is_known(self._shape):
+            raise DeferredInitializationError(
+                f"parameter {self.name} has unknown shape {self._shape}; "
+                "run a forward pass to infer it or set the shape explicitly")
+        init, ctx, default_init = self._deferred_init
+        self._init_impl(init, ctx, default_init)
+
+    def _set_data_arr(self, arr: NDArray):
+        self._data = arr
+        if self._grad_req != "null":
+            arr.attach_grad(self._grad_req)
+
+    # ------------------------------------------------------------------ #
+    # access
+    # ------------------------------------------------------------------ #
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} deferred; forward once to infer "
+                "shapes")
+        raise MXNetError(
+            f"parameter {self.name} not initialized; call "
+            ".initialize() first")
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        return self._data
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad_req == "null" or self._data._grad is None:
+            raise MXNetError(
+                f"cannot get grad for {self.name}: grad_req is 'null'")
+        return self._data._grad
+
+    def list_data(self):
+        return [self.data()]
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def set_data(self, data):
+        """Replace the value, preserving the grad buffer (reference
+        ``Parameter.set_data``)."""
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            if self._deferred_init is not None:
+                init, ctx, default_init = self._deferred_init
+                self._deferred_init = None
+                arr = data if isinstance(data, NDArray) else NDArray(
+                    jnp.asarray(data, jnp.dtype(self.dtype)))
+                self._set_data_arr(
+                    NDArray(jnp.asarray(arr._data, jnp.dtype(self.dtype)),
+                            ctx[0] if ctx else None))
+                return
+            raise MXNetError(f"parameter {self.name} not initialized")
+        src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        if self._sharding is not None:
+            src = jax.device_put(src, self._sharding)
+        self._data._rebind(jnp.asarray(src, self._data._data.dtype))
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data._rebind(
+                jax.device_put(self._data._data, ctx.jax_device())
+                if isinstance(ctx, Context) else self._data._data)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data._rebind(self._data._data.astype(jnp.dtype(dtype)))
+            if had_grad:
+                self._data.attach_grad(self._grad_req)
+
+    # -- sharding (TPU-native extension) -------------------------------- #
+    def set_sharding(self, sharding):
+        """Attach a ``jax.sharding.NamedSharding`` — the GSPMD analog of the
+        reference's per-device replica lists (SURVEY.md §3.3 TP row)."""
+        self._sharding = sharding
+        if self._data is not None and sharding is not None:
+            self._data._rebind(jax.device_put(self._data._data, sharding))
+
+    # -- symbol-compat ---------------------------------------------------- #
+    def var(self):
+        return self.data()
+
+
+class Constant(Parameter):
+    """Non-trainable parameter with a fixed value (reference anchor
+    ``Constant``)."""
+
+    def __init__(self, name, value):
+        if isinstance(value, NDArray):
+            arr = value.asnumpy()
+        else:
+            arr = onp.asarray(value, onp.float32)
+        self.value = arr
+        super().__init__(name, grad_req="null", shape=arr.shape,
+                         dtype=arr.dtype,
+                         init=init_mod.Constant(arr))
+
+
+class ParameterDict:
+    """Ordered name->Parameter mapping with prefix scoping and sharing
+    (reference anchor ``ParameterDict``)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p!r}" for p in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{lines}\n)"
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Get-or-create ``prefix+name`` (shared dict consulted first)."""
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape":
+                    param.shape = v
+                elif k == "init" and v is not None and param.init is None:
+                    param.init = v
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"no constant {full} and no value given")
+            param = Constant(full, value)
+            self._params[full] = param
+        return param
+
+    def _get_impl(self, full):
+        if full in self._params:
+            return self._params[full]
+        if self._shared is not None and full in self._shared:
+            self._params[full] = self._shared[full]
+            return self._params[full]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter {k}")
+            self._params[k] = v
+
+    # -- bulk ops --------------------------------------------------------- #
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        default = init_mod.create(init) if init is not None else \
+            init_mod.Uniform()
+        for p in self._params.values():
+            p.initialize(None, ctx, default, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import serialization
+        arrays = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arrays[name] = p.data()
+        serialization.save(filename, arrays)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import serialization
+        loaded = serialization.load(filename)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.shape = tuple(loaded[name].shape)
+                p._finish_deferred_init() if p._deferred_init else None
+                if p._data is None:
+                    p._set_data_arr(NDArray(
+                        jnp.asarray(loaded[name]._data,
+                                    jnp.dtype(p.dtype))))
+                else:
+                    p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"missing parameter {name} in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"extra parameters in file: {sorted(extra)}")

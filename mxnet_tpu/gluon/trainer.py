@@ -1,0 +1,204 @@
+"""Gluon Trainer — params ↔ KVStore ↔ Optimizer bridge.
+
+Reference surface: ``python/mxnet/gluon/trainer.py`` (SURVEY.md §3.2 "Gluon
+Trainer"; §4.2 call stack): ``step(batch_size)`` = allreduce grads →
+rescale → per-param optimizer update; split ``allreduce_grads()``/
+``update()`` API for gradient clipping; ``update_on_kvstore`` runs the
+update inside the store (the reference's optimizer-on-PS-server).
+
+TPU-native: with a single chip or a GSPMD-sharded step the allreduce is
+either identity or already inside the compiled step, so ``step`` reduces to
+the fused optimizer update; the kvstore path is kept bit-compatible for
+ported code.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a (Parameter)Dict or list")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+            p._trainer = self
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_type = kvstore
+        self._compression_params = compression_params
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states = [None] * len(self._params)
+        self._states_created = [False] * len(self._params)
+        self._optimizer_registered_on_kv = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        # kvstore keys are strings — register both forms so per-param
+        # lr_mult/wd_mult hold in the update_on_kvstore path too
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        param_dict.update({str(i): p for i, p in enumerate(self._params)})
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+
+    # -- kvstore ----------------------------------------------------------- #
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        if self._kv_type is None or self._kv_type == "":
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            from .. import kvstore as kv_mod
+            self._kvstore = kv_mod.create(
+                self._kv_type if isinstance(self._kv_type, str)
+                else "device") if not hasattr(self._kv_type, "push") \
+                else self._kv_type
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            if self._update_on_kvstore is None:
+                # reference default: update on kvstore for dist, local
+                # update otherwise (single-process TPU: local fused update)
+                self._update_on_kvstore = str(self._kv_type).startswith(
+                    "dist")
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- core step --------------------------------------------------------- #
+    def _check_initialized(self):
+        for p in self._params:
+            if p._data is None and p._deferred_init is None:
+                raise MXNetError(
+                    f"parameter {p.name} is not initialized; call "
+                    "initialize() and run a forward pass first")
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + rescale(1/batch_size) + update (reference
+        ``Trainer.step``)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Explicit allreduce for the clip-then-update pattern."""
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() is not supported with update_on_kvstore")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if self._update_on_kvstore:
+                # push grad; server-side optimizer updates weight; pull it
+                self._kvstore.push(i, p.list_grad())
+                self._kvstore.pull(i, p.list_data())
+            else:
+                self._kvstore.pushpull(i, p.list_grad(), out=p.list_grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Update-only half of step (after manual allreduce + clipping)."""
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("update() is not supported with "
+                             "update_on_kvstore")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            return  # the push already applied the optimizer server-side
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(f"parameter {p.name} not initialized")
+            if not self._states_created[i]:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(i, p.data())
+                self._states_created[i] = True
+            self._states[i] = self._optimizer.update_multi_precision(
+                i, p.data(), p.grad(), self._states[i])
+
+    # -- state checkpointing (SURVEY.md §5.4 d) --------------------------- #
+    def save_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
+        payload = {
+            "num_update": self._optimizer.num_update,
+            "index_update_count": self._optimizer._index_update_count,
+            "states": [jax.tree.map(lambda a: jax.device_get(a), s)
+                       for s, created in zip(self._states,
+                                             self._states_created)
+                       ],
+            "created": self._states_created,
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_update_count"]
+        self._states = payload["states"]
+        self._states_created = payload["created"]

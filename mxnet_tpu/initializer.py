@@ -1,0 +1,327 @@
+"""Weight initializers.
+
+Reference surface: ``python/mxnet/initializer.py`` (SURVEY.md §3.2
+"initializer": Xavier/MSRAPrelu/Normal/Uniform/Orthogonal/Bilinear/LSTMBias/
+Constant/Load/Mixed; string-serialized init in param files).
+
+TPU-native: each initializer is a pure function of ``(key, shape, dtype)``
+using ``jax.random`` so parameter init composes with jit/sharded init later;
+the imperative surface (``init(name, arr)``) matches the reference.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = [
+    "Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+    "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Load",
+    "Mixed", "register", "create",
+]
+
+_REGISTRY: dict = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercase class name (reference
+    anchor ``@mx.init.register``)."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs):
+    if init is None:
+        return None
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        if name not in _REGISTRY:
+            raise MXNetError(f"unknown initializer {init}")
+        return _REGISTRY[name](**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Parameter name string carrying init attrs (reference ``InitDesc``)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base class.  Subclasses implement ``_init_weight(name, key, shape,
+    dtype) -> jax array``; pattern-dispatch on the parameter name mirrors the
+    reference (`_init_bias`, `_init_gamma`, ...)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        """Serialize as ``[name, kwargs]`` JSON (stored in .params files by
+        the reference)."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    # -- keyed functional surface (TPU-native) ---------------------------- #
+    def generate(self, name: str, key, shape, dtype=jnp.float32):
+        """Pure: produce the initialized array for parameter ``name``."""
+        name = name.lower()
+        if name.endswith("gamma"):
+            return self._init_one(key, shape, dtype)
+        if name.endswith("beta") or name.endswith("bias"):
+            return self._init_zero(key, shape, dtype)
+        if "running_mean" in name or "moving_mean" in name:
+            return self._init_zero(key, shape, dtype)
+        if ("running_var" in name or "moving_var" in name
+                or "moving_avg" in name):
+            return self._init_one(key, shape, dtype)
+        if name.endswith("min") or name.endswith("max"):
+            return self._init_zero(key, shape, dtype)
+        if name.endswith("weight") or True:
+            return self._init_weight(name, key, shape, dtype)
+
+    def __call__(self, desc, arr):
+        """Imperative surface: initialize NDArray ``arr`` in place."""
+        from .ndarray.ndarray import NDArray
+        from . import random as mxrandom
+
+        name = str(desc)
+        init_override = getattr(desc, "attrs", {}).get("__init__", "")
+        if init_override:
+            ini = create(json.loads(init_override)[0],
+                         **json.loads(init_override)[1]) \
+                if init_override.startswith("[") else create(init_override)
+            val = ini.generate(name, mxrandom.next_key(), arr.shape,
+                               arr._data.dtype)
+        else:
+            val = self.generate(name, mxrandom.next_key(), arr.shape,
+                                arr._data.dtype)
+        arr._rebind(jnp.asarray(val, arr._data.dtype))
+
+    init_weight = __call__
+
+    # -- primitive fills -------------------------------------------------- #
+    def _init_zero(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+    def _init_one(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+    def _init_weight(self, name, key, shape, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, key, shape, dtype):
+        v = self.value
+        if hasattr(v, "asnumpy"):
+            v = v.asnumpy()
+        return jnp.broadcast_to(jnp.asarray(v, dtype), shape)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, key, shape, dtype):
+        return jax.random.uniform(key, shape, jnp.float32, -self.scale,
+                                  self.scale).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, key, shape, dtype):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * self.sigma).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, key, shape, dtype):
+        nout = shape[0]
+        nin = int(onp.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+@register
+class Xavier(Initializer):
+    """Reference anchor ``Xavier``: factor from fan-in/out, uniform /
+    gaussian variants."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, key, shape, dtype):
+        if len(shape) < 2:
+            raise MXNetError(
+                f"Xavier requires ndim>=2 param, got shape {shape} for {name}")
+        hw_scale = float(onp.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = float(onp.sqrt(self.magnitude / factor))
+        if self.rnd_type == "uniform":
+            return jax.random.uniform(key, shape, jnp.float32, -scale,
+                                      scale).astype(dtype)
+        if self.rnd_type == "gaussian":
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * scale).astype(dtype)
+        raise MXNetError(f"bad rnd_type {self.rnd_type}")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init (reference anchor ``MSRAPrelu``)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for Deconvolution."""
+
+    def _init_weight(self, name, key, shape, dtype):
+        weight = onp.zeros(int(onp.prod(shape)), onp.float32)
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - _abs(x / f - c)) * (1 - _abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype)
+
+
+def _abs(x):
+    return x if x >= 0 else -x
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = ``forget_bias``, others 0 (reference anchor
+    ``LSTMBias``); layout i,f,c,o in 4 equal chunks."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, key, shape, dtype):
+        b = onp.zeros(shape, onp.float32)
+        num_hidden = shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        return jnp.asarray(b, dtype)
+
+
+@register
+class Load(Initializer):
+    """Init from a dict of arrays, falling back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        self.param = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                      for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            data = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
+            if tuple(data.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load: shape mismatch for {name}: {data.shape} vs "
+                    f"{arr.shape}")
+            arr._rebind(jnp.asarray(data, arr._data.dtype))
+        elif self.default_init is not None:
+            self.default_init(desc, arr)
+        else:
+            raise MXNetError(f"Load: no init for {name}")
+
+
+@register
+class Mixed(Initializer):
+    """Pattern-dispatched initializer list (reference anchor ``Mixed``)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns/initializers length mismatch")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        for pat, ini in self.map:
+            if pat.match(str(desc)):
+                ini(desc, arr)
+                return
+        raise MXNetError(
+            f"Mixed: no pattern matched {desc}; add a '.*' catch-all")

@@ -1,0 +1,23 @@
+"""KVStore — the parameter synchronization facade.
+
+Reference surface: ``src/kvstore/`` + ``python/mxnet/kvstore/`` (SURVEY.md
+§3.1 "KVStore family", §5.8): uniform Init/Push/Pull/PushPull over arrays
+keyed by int/str; ``local`` (CPU merge), ``device`` (GPU P2P trees),
+``nccl`` (ring allreduce), ``dist_sync``/``dist_async`` (parameter server
+with server-side optimizer).
+
+TPU-native redesign (SURVEY.md §7 "KVStore"): on TPU the gradient
+all-reduce is an XLA collective that GSPMD inserts *inside* the compiled
+step (riding ICI), so the single-process kvstore ('local'/'device'/'nccl'/
+'tpu') is a thin aggregation facade: push sums the per-device values (one
+engine-free jnp.add chain — or nothing when there is one chip), pull
+broadcasts.  ``dist_sync`` maps to a multi-host mesh over DCN via
+``jax.distributed`` (see mxnet_tpu.parallel); the optimizer-on-server
+semantics are preserved by running the updater at push time exactly like
+``KVStoreDistServer::DataHandleEx``.  ``dist_async`` is accepted and
+documented as executing synchronously (async PS is anti-idiomatic on TPU,
+SURVEY.md §3.3).
+"""
+from .kvstore import KVStore, create
+
+__all__ = ["KVStore", "create"]

@@ -1,0 +1,208 @@
+"""KVStore implementation (see package docstring for the design map)."""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+_KNOWN_TYPES = ("local", "device", "nccl", "tpu", "dist_sync", "dist_async",
+                "dist_device_sync", "dist")
+
+
+def create(name="local"):
+    if name not in _KNOWN_TYPES:
+        raise MXNetError(f"unknown kvstore type {name}")
+    return KVStore(name)
+
+
+class KVStore:
+    """Single-process store; multi-host coordination builds on
+    ``jax.distributed`` (mxnet_tpu.parallel.init_distributed)."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store: dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_states: dict = {}
+        self._compression_params = None
+
+    # -- identity ---------------------------------------------------------- #
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    # -- core API ---------------------------------------------------------- #
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        key = str(key)
+        if key in self._store:
+            return
+        v = value[0] if isinstance(value, (list, tuple)) else value
+        self._store[key] = NDArray(jnp.asarray(v._data))
+        if self._optimizer is not None:
+            self._opt_states[key] = \
+                self._optimizer.create_state_multi_precision(
+                    key, self._store[key])
+
+    def _merge(self, value):
+        """Sum a per-device value list (reference: CommDevice tree-reduce /
+        NCCL ring; here one fused add chain — on one chip it's identity)."""
+        if not isinstance(value, (list, tuple)):
+            return value._data
+        if len(value) == 1:
+            return value[0]._data
+        acc = value[0]._data
+        for v in value[1:]:
+            acc = acc + v._data
+        return acc
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        key = str(key)
+        if key not in self._store:
+            raise MXNetError(f"kvstore key {key} not initialized")
+        merged = self._merge(value)
+        if self._optimizer is not None:
+            # optimizer-on-server semantics (KVStoreDistServer)
+            w = self._store[key]
+            self._opt_states[key] = self._optimizer.update_multi_precision(
+                key, w, NDArray(merged), self._opt_states[key])
+        elif self._updater is not None:
+            self._updater(key, NDArray(merged), self._store[key])
+        else:
+            self._store[key]._rebind(self._store[key]._data + merged)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)) and isinstance(out, (list, tuple)) \
+                and len(key) == len(out) and isinstance(key[0], (str, int)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        key = str(key)
+        if key not in self._store:
+            raise MXNetError(f"kvstore key {key} not initialized")
+        src = self._store[key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._rebind(jnp.asarray(src._data, o._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference ``MXKVStorePushPull``).  With no
+        updater this is a pure allreduce: out = sum(values)."""
+        if isinstance(key, (list, tuple)) and not isinstance(key, str):
+            vals = value
+            outs = out if out is not None else [None] * len(key)
+            for k, v, o in zip(key, vals, outs):
+                self.pushpull(k, v, o, priority)
+            return
+        key = str(key)
+        if self._optimizer is not None or self._updater is not None:
+            self.push(key, value, priority)
+            if out is not None:
+                self.pull(key, out, priority)
+            return
+        # pure allreduce path (Trainer update_on_kvstore=False)
+        merged = self._merge(value)
+        if out is None:
+            if key not in self._store:
+                raise MXNetError(f"kvstore key {key} not initialized")
+            self._store[key]._rebind(merged)
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._rebind(jnp.asarray(merged, o._data.dtype))
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Reference ``PullRowSparse``: pull only touched rows.  Dense
+        emulation documented in SURVEY.md §3.3: gather the requested rows."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        key = str(key)
+        src = self._store[key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for o, r in zip(outs, rids):
+            rows = jnp.take(src._data, r._data.astype(jnp.int32), axis=0)
+            full = jnp.zeros_like(src._data)
+            full = full.at[r._data.astype(jnp.int32)].set(rows)
+            o._rebind(jnp.asarray(full, o._data.dtype))
+
+    # -- updater / optimizer ----------------------------------------------- #
+    def set_updater(self, updater):
+        """updater(key, recv, stored) — local update fn (reference
+        ``KVStore::set_updater``)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run the optimizer inside the store at push time (reference: the
+        worker pickles the optimizer to the PS server via
+        ``SendCommandToServers``; here the 'server' is this process)."""
+        self._optimizer = optimizer
+        for key, w in self._store.items():
+            self._opt_states[key] = \
+                optimizer.create_state_multi_precision(key, w)
+
+    @property
+    def is_capable(self):
+        return {"optimizer": True}
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        payload = {"states": {k: jax.tree.map(
+            lambda a: jax.device_get(a), v)
+            for k, v in self._opt_states.items()}}
+        if dump_optimizer:
+            payload["optimizer"] = self._optimizer
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._opt_states = payload["states"]
+        if "optimizer" in payload:
+            self._optimizer = payload["optimizer"]
+
+    def set_gradient_compression(self, compression_params):
+        """Accepted for API parity; on-wire compression maps to bf16/int8
+        cast before DCN allreduce (SURVEY.md §3.3) — applied in the
+        dist path."""
+        self._compression_params = compression_params
+
+    def barrier(self):
+        from ..ndarray.ndarray import waitall
+        waitall()
+
+    def _wait(self, keys):
+        for k in (keys if isinstance(keys, (list, tuple)) else [keys]):
+            self._store[str(k)].wait_to_read()

@@ -1,0 +1,8 @@
+"""Optimizers + LR schedulers (reference: ``python/mxnet/optimizer/``)."""
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, Nadam, LAMB, LARS,
+                        RMSProp, AdaGrad, AdaDelta, Ftrl, FTML, Signum, SGLD,
+                        register, create)
+from . import lr_scheduler
+from .lr_scheduler import (LRScheduler, FactorScheduler,
+                           MultiFactorScheduler, PolyScheduler,
+                           CosineScheduler)

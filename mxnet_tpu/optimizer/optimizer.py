@@ -1,0 +1,517 @@
+"""Optimizers.
+
+Reference surface: ``python/mxnet/optimizer/optimizer.py`` (SURVEY.md §3.2
+"Optimizers": registry @register; SGD/NAG/Adam/AdamW/LAMB/LARS/RMSProp/
+Adagrad/Adadelta/Ftrl/FTML/Signum/SGLD; multi-precision via mp_* ops; fused
+aggregated updates; anchor ``update_multi_precision``).
+
+TPU-native redesign: every optimizer defines ONE pure jax update rule
+``_update_rule(weight, grad, state, lr, wd) -> (new_weight, new_state)``.
+The imperative ``update(index, weight, grad, state)`` surface matches the
+reference; the same rule is consumed by the fully-jitted train step
+(Trainer/fit path) so the whole optimizer fuses into the backward XLA
+program — the analog of the reference's fused ``multi_sgd_mom_update``
+kernels, supplied by XLA fusion instead of hand-written CUDA.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = [
+    "Optimizer", "SGD", "NAG", "Adam", "AdamW", "Nadam", "LAMB", "LARS",
+    "RMSProp", "AdaGrad", "AdaDelta", "Ftrl", "FTML", "Signum", "SGLD",
+    "register", "create",
+]
+
+_REGISTRY: dict = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    if name.lower() not in _REGISTRY:
+        raise MXNetError(f"unknown optimizer {name}")
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+def _as_jax(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class Optimizer:
+    """Base optimizer (reference anchor ``class Optimizer``)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0,
+                 aggregate_num=None, use_fused_step=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: dict = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = dict(param_dict or {})
+        self.lr_mult: dict = {}
+        self.wd_mult: dict = {}
+        self.aggregate_num = aggregate_num
+
+    # -- lr/wd plumbing ---------------------------------------------------- #
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is "
+                             "active")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            lr *= p.lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _update_count(self, index):
+        self._index_update_count.setdefault(index, self.begin_num_update)
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    # -- state ------------------------------------------------------------- #
+    def create_state(self, index, weight):
+        """Return the pytree of state arrays for one parameter (pure)."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        w = _as_jax(weight)
+        if self.multi_precision and w.dtype in (jnp.float16, jnp.bfloat16):
+            master = w.astype(jnp.float32)
+            return (master, self.create_state(index, NDArray(master)))
+        return self.create_state(index, weight)
+
+    # -- update ------------------------------------------------------------ #
+    def _preprocess_grad(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _update_rule(self, weight, grad, state, lr, wd, t):
+        """Pure: (w, g, state, lr, wd, step) -> (new_w, new_state)."""
+        raise NotImplementedError
+
+    def update(self, index, weight, grad, state):
+        """Imperative in-place update of one parameter (reference
+        ``Optimizer.update``).  Accepts lists for the fused multi-tensor
+        surface."""
+        if isinstance(index, (list, tuple)):
+            return [self.update(i, w_, g_, s_)
+                    for i, w_, g_, s_ in zip(index, weight, grad, state)]
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        w = _as_jax(weight)
+        g = self._preprocess_grad(_as_jax(grad).astype(w.dtype))
+        new_w, new_state = self._update_rule(w, g, state, lr, wd, t)
+        weight._rebind(new_w)
+        return new_state
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """fp16/bf16 weights with fp32 master copy (reference anchor
+        ``update_multi_precision`` / ``mp_*`` ops)."""
+        if isinstance(index, (list, tuple)):
+            return [self.update_multi_precision(i, w_, g_, s_)
+                    for i, w_, g_, s_ in zip(index, weight, grad, state)]
+        w = _as_jax(weight)
+        use_mp = self.multi_precision and \
+            w.dtype in (jnp.float16, jnp.bfloat16) and \
+            isinstance(state, tuple) and len(state) == 2 and \
+            getattr(state[0], "dtype", None) == jnp.float32
+        if not use_mp:
+            return self.update(index, weight, grad, state)
+        master, inner = state
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(_as_jax(grad).astype(jnp.float32))
+        new_master, new_inner = self._update_rule(master, g, inner, lr, wd, t)
+        weight._rebind(new_master.astype(w.dtype))
+        return (new_master, new_inner)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference anchors ``sgd_update`` /
+    ``sgd_mom_update``)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        w = _as_jax(weight)
+        return jnp.zeros_like(w)
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum == 0.0:
+            return w - lr * g, None
+        mom = state * self.momentum - lr * g
+        return w + mom, mom
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference anchor ``nag_mom_update``)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         **kwargs)
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum == 0.0:
+            return w - lr * g, None
+        mom = state * self.momentum + g
+        return w - lr * (g + self.momentum * mom), mom
+
+
+@register
+class Adam(Optimizer):
+    """Reference anchor ``adam_update``."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))  # mean, var
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        coef1 = 1 - self.beta1 ** t
+        coef2 = 1 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        return w - lr_t * m / (jnp.sqrt(v) + self.epsilon), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference contrib ``adamw_update``)."""
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        coef1 = 1 - self.beta1 ** t
+        coef2 = 1 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        return w - lr_t * (m / (jnp.sqrt(v) + self.epsilon) + wd * w), (m, v)
+
+
+@register
+class Nadam(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g + wd * w
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t1 = self.beta1 * (1 - 0.5 * 0.96 **
+                                    ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t1
+        g_prime = g / (1 - self.m_schedule)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        m_prime = m / (1 - m_schedule_next)
+        v_prime = v / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
+        return w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon), (m, v)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference anchors
+    ``lamb_update_phase1/2``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        update = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        wnorm = jnp.linalg.norm(w)
+        unorm = jnp.linalg.norm(update)
+        if self.lower_bound is not None:
+            wnorm = jnp.maximum(wnorm, self.lower_bound)
+        if self.upper_bound is not None:
+            wnorm = jnp.minimum(wnorm, self.upper_bound)
+        trust = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+        return w - lr * trust * update, (m, v)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference ``LARS`` optimizer)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(_as_jax(weight))
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        wnorm = jnp.linalg.norm(w)
+        gnorm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)
+        g = g + wd * w
+        mom = self.momentum * state + lr * trust * g
+        return w - mom, mom
+
+
+@register
+class RMSProp(Optimizer):
+    """Reference anchor ``rmsprop_update`` (centered variant =
+    ``rmspropalex_update``)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        if self.centered:
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+        return (jnp.zeros_like(w),)
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if not self.centered:
+            (n,) = state
+            n = self.rho * n + (1 - self.rho) * jnp.square(g)
+            new_w = w - lr * g / jnp.sqrt(n + self.epsilon)
+            new_state = (n,)
+        else:
+            n, mg, delta = state
+            n = self.rho * n + (1 - self.rho) * jnp.square(g)
+            mg = self.rho * mg + (1 - self.rho) * g
+            delta = self.momentum * delta - \
+                lr * g / jnp.sqrt(n - jnp.square(mg) + self.epsilon)
+            new_w = w + delta
+            new_state = (n, mg, delta)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w, new_state
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(_as_jax(weight))
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        hist = state + jnp.square(g)
+        return w - lr * g / (jnp.sqrt(hist) + self.epsilon), hist
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        acc_g, acc_delta = state
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta + self.epsilon) / \
+            jnp.sqrt(acc_g + self.epsilon) * g
+        acc_delta = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+        return w - lr * delta, (acc_g, acc_delta)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))  # z, n
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        z, n = state
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + jnp.square(g)
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n)) / lr + wd), 0.0)
+        return new_w.astype(w.dtype), (z, n)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        d, v, z = state
+        g = g + wd * w
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * \
+            (jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return -z / d_t, (d_t, v, z)
+
+
+@register
+class Signum(Optimizer):
+    """Sign-SGD with momentum (reference anchor ``signum_update``)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros_like(_as_jax(weight))
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        if self.momentum == 0.0:
+            return w - lr * (jnp.sign(g) + self.wd_lh * w), None
+        mom = self.momentum * state - (1 - self.momentum) * (g + wd * w)
+        return w - lr * (jnp.sign(-mom) + self.wd_lh * w), mom
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (noise-injected SGD)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        from .. import random as mxrandom
+        g = g + wd * w
+        noise = jax.random.normal(mxrandom.next_key(), w.shape, w.dtype) * \
+            jnp.sqrt(lr)
+        return w - 0.5 * lr * g + noise, None
+
+
+# keep reference aliases
+_REGISTRY["adagrad"] = AdaGrad
+_REGISTRY["adadelta"] = AdaDelta

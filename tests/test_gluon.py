@@ -1,0 +1,238 @@
+"""Gluon Block/HybridBlock/Parameter tests (mirrors reference
+tests/python/unittest/test_gluon.py strategy, SURVEY.md §7)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(init=mx.init.Xavier())
+    assert p.data().shape == (4, 3)
+    assert p.grad().shape == (4, 3)
+    assert float(p.grad().asnumpy().sum()) == 0.0
+
+
+def test_parameter_deferred():
+    p = gluon.Parameter("weight", shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (4, 7)
+    p._finish_deferred_init()
+    assert p.data().shape == (4, 7)
+
+
+def test_dense_forward_matches_numpy():
+    net = nn.Dense(5, in_units=3, use_bias=True)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(2, 3).astype(onp.float32))
+    out = net(x)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    expect = x.asnumpy() @ w.T + b
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(4, 3).astype(onp.float32))
+    assert net(x).shape == (4, 2)
+    params = net.collect_params()
+    assert len(params) == 4
+    weights = net.collect_params(".*weight")
+    assert len(weights) == 2
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(5, 7).astype(onp.float32))
+    out_imp = net(x).asnumpy()
+    net.hybridize()
+    out_hyb = net(x).asnumpy()
+    onp.testing.assert_allclose(out_imp, out_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grads_match():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+        return net
+
+    mx.random.seed(7)
+    net = build()
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(0).randn(6, 4).astype(onp.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_imp = net[0].weight.grad().asnumpy().copy()
+
+    net.hybridize()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_hyb = net[0].weight.grad().asnumpy()
+    onp.testing.assert_allclose(g_imp, g_hyb, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_moving_stats_update():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(8, 3, 4, 4).astype(onp.float32) * 5 + 2)
+    with mx.autograd.record():
+        net(x)
+    mm = net.running_mean.data().asnumpy()
+    assert onp.abs(mm).sum() > 0  # moved off zero
+
+
+def test_batchnorm_moving_stats_update_hybridized():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(onp.random.randn(8, 3, 4, 4).astype(onp.float32) * 5 + 2)
+    with mx.autograd.record():
+        net(x)
+    mm = net.running_mean.data().asnumpy()
+    assert onp.abs(mm).sum() > 0
+    # eval mode: stats stay fixed
+    before = net.running_mean.data().asnumpy().copy()
+    net(x)
+    onp.testing.assert_allclose(net.running_mean.data().asnumpy(), before)
+
+
+def test_conv2d_deferred_init():
+    net = nn.Conv2D(8, 3, padding=1)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(2, 5, 9, 9).astype(onp.float32))
+    out = net(x)
+    assert out.shape == (2, 8, 9, 9)
+    assert net.weight.shape == (8, 5, 3, 3)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=3), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "x.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=3), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net[0].weight.data().asnumpy(),
+                                net2[0].weight.data().asnumpy())
+
+
+def test_losses():
+    pred = mx.nd.array(onp.random.randn(4, 5).astype(onp.float32))
+    label = mx.nd.array(onp.array([0, 2, 1, 4], onp.float32))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    # numpy reference
+    p = pred.asnumpy()
+    logp = p - p.max(-1, keepdims=True)
+    logp = logp - onp.log(onp.exp(logp).sum(-1, keepdims=True))
+    expect = -logp[onp.arange(4), label.asnumpy().astype(int)]
+    onp.testing.assert_allclose(l.asnumpy(), expect, rtol=1e-4)
+
+    l2 = gluon.loss.L2Loss()(pred, pred * 0 + 1.0)
+    expect2 = 0.5 * ((p - 1.0) ** 2).mean(-1)
+    onp.testing.assert_allclose(l2.asnumpy(), expect2, rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, pred * 0)
+    onp.testing.assert_allclose(l1.asnumpy(), onp.abs(p).mean(-1), rtol=1e-5)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array(onp.ones((4, 2), onp.float32))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=4)
+    # dL/dw = sum over batch of x = [4,4]; /batch_size=1 each; w = 1-0.1
+    onp.testing.assert_allclose(net.weight.data().asnumpy(),
+                                onp.full((1, 2), 0.9, onp.float32),
+                                rtol=1e-6)
+
+
+def test_trainer_full_loop_decreases_loss():
+    mx.random.seed(42)
+    rs = onp.random.RandomState(1)
+    x = mx.nd.array(rs.randn(64, 10).astype(onp.float32))
+    true_w = rs.randn(10, 1).astype(onp.float32)
+    y = mx.nd.array(rs.randn(64, 1).astype(onp.float32) * 0.01
+                    + x.asnumpy() @ true_w)
+    net = nn.Dense(1, in_units=10)
+    net.initialize(init=mx.init.Normal(0.1))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(60):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch_size=64)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array(onp.ones((2, 2), onp.float32))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    trainer.step(1)
+    f = str(tmp_path / "t.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+    assert trainer._optimizer.num_update == 1
+
+
+def test_update_on_kvstore_dist_semantics():
+    """dist_sync: optimizer runs inside the store (PS-server semantics)."""
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="dist_sync")
+    x = mx.nd.array(onp.ones((4, 2), onp.float32))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=4)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(),
+                                onp.full((1, 2), 0.9, onp.float32),
+                                rtol=1e-6)
+
+
+def test_grad_clipping_pattern():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0}, kvstore=None)
+    x = mx.nd.array(onp.full((1, 2), 100.0, onp.float32))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    grads = [p.grad() for p in net.collect_params().values()
+             if p.grad_req != "null"]
+    total = float(sum((g.norm() ** 2).asnumpy() for g in grads) ** 0.5)
+    scale = min(1.0, 1.0 / total)
+    for g in grads:
+        g *= scale
+    trainer.update(batch_size=1)
+    w = net.weight.data().asnumpy()
+    assert onp.linalg.norm(onp.ones((1, 2)) - w) <= 1.0 + 1e-4

@@ -1,0 +1,162 @@
+"""Optimizer, lr_scheduler, initializer, and metric tests (reference model:
+tests/python/unittest/test_optimizer.py / test_metric.py)."""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt_mod
+
+
+def _nd(x):
+    return mx.nd.array(onp.asarray(x, onp.float32))
+
+
+def _run_steps(opt, w0, grads):
+    w = _nd(w0)
+    state = opt.create_state_multi_precision(0, w)
+    for g in grads:
+        state = opt.update_multi_precision(0, w, _nd(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_formula():
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    w = _run_steps(opt, [1.0, 2.0], [[0.5, 0.5], [0.5, 0.5]])
+    # manual
+    wm = onp.array([1.0, 2.0])
+    mom = onp.zeros(2)
+    for _ in range(2):
+        g = onp.array([0.5, 0.5]) + 0.01 * wm
+        mom = 0.9 * mom - 0.1 * g
+        wm = wm + mom
+    onp.testing.assert_allclose(w, wm, rtol=1e-6)
+
+
+def test_adam_matches_formula():
+    opt = opt_mod.Adam(learning_rate=0.01)
+    w = _run_steps(opt, [1.0], [[0.1]] * 3)
+    wm, m, v = onp.array([1.0]), onp.zeros(1), onp.zeros(1)
+    for t in range(1, 4):
+        g = onp.array([0.1])
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        lr_t = 0.01 * math.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        wm = wm - lr_t * m / (onp.sqrt(v) + 1e-8)
+    onp.testing.assert_allclose(w, wm, rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adamw", "lamb",
+                                  "lars", "rmsprop", "adagrad", "adadelta",
+                                  "ftrl", "ftml", "signum", "nadam"])
+def test_all_optimizers_reduce_quadratic(name):
+    """Each optimizer must make progress on f(w) = ||w||^2 / 2."""
+    opt = opt_mod.create(name)
+    w = _nd(onp.ones(4))
+    state = opt.create_state_multi_precision(0, w)
+    for _ in range(30):
+        g = mx.nd.array(w.asnumpy())  # grad of quadratic
+        state = opt.update_multi_precision(0, w, g, state)
+    assert onp.linalg.norm(w.asnumpy()) < onp.linalg.norm(onp.ones(4))
+
+
+def test_multi_precision_master_weights():
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = mx.nd.array(onp.ones(3)).astype("float16")
+    state = opt.create_state_multi_precision(0, w)
+    assert state[0].dtype == onp.float32  # master copy
+    g = mx.nd.array(onp.full(3, 0.1)).astype("float16")
+    opt.update_multi_precision(0, w, g, state)
+    assert w.dtype == onp.float16
+
+
+def test_lr_schedulers():
+    s = opt_mod.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0
+    assert s(10) == 0.5
+    assert s(25) == 0.25
+    m = opt_mod.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(4) == 1.0
+    assert abs(m(5) - 0.1) < 1e-12
+    assert abs(m(20) - 0.01) < 1e-12
+    c = opt_mod.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-12
+    assert abs(c(50) - 0.5) < 1e-12
+    assert c(100) == 0.0
+    p = opt_mod.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert abs(p(0) - 1.0) < 1e-12
+    assert p(100) == 0.0
+    # warmup
+    ws = opt_mod.FactorScheduler(step=100, base_lr=1.0, warmup_steps=10,
+                                 warmup_begin_lr=0.0)
+    assert ws(5) == 0.5
+
+
+def test_scheduler_in_optimizer():
+    sched = opt_mod.FactorScheduler(step=1, factor=0.5, base_lr=0.4)
+    opt = opt_mod.SGD(learning_rate=0.4, lr_scheduler=sched)
+    w = _nd([1.0])
+    s = opt.create_state(0, w)
+    opt.update(0, w, _nd([1.0]), s)
+    assert opt.learning_rate == 0.4 * 0.5  # stepped once
+
+
+def test_initializers():
+    import jax
+    key = jax.random.PRNGKey(0)
+    x = mx.init.Xavier(rnd_type="gaussian").generate("w_weight", key,
+                                                     (64, 32))
+    assert x.shape == (64, 32)
+    assert abs(float(x.std()) - math.sqrt(3.0 / 48)) < 0.05
+    o = mx.init.Orthogonal().generate("w_weight", key, (16, 16))
+    q = onp.asarray(o) / 1.414
+    onp.testing.assert_allclose(q @ q.T, onp.eye(16), atol=1e-4)
+    b = mx.init.Normal().generate("fc_bias", key, (8,))
+    onp.testing.assert_allclose(onp.asarray(b), onp.zeros(8))
+    g = mx.init.Uniform().generate("bn_gamma", key, (8,))
+    onp.testing.assert_allclose(onp.asarray(g), onp.ones(8))
+
+
+def test_metrics_accuracy():
+    m = mx.metric.Accuracy()
+    pred = _nd([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+    label = _nd([0, 1, 1])
+    m.update([label], [pred])
+    assert m.get() == ("accuracy", 2.0 / 3)
+
+
+def test_metrics_topk_f1_mse():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = _nd([[0.2, 0.5, 0.3], [0.7, 0.2, 0.1]])
+    m.update([_nd([2, 2])], [pred])
+    assert m.get()[1] == 0.5
+
+    f1 = mx.metric.F1()
+    f1.update([_nd([1, 0, 1, 1])], [_nd([0.9, 0.2, 0.8, 0.3])])
+    prec, rec = 2 / 2, 2 / 3
+    assert abs(f1.get()[1] - 2 * prec * rec / (prec + rec)) < 1e-9
+
+    mse = mx.metric.MSE()
+    mse.update([_nd([1.0, 2.0])], [_nd([1.5, 2.0])])
+    assert abs(mse.get()[1] - 0.125) < 1e-7
+
+
+def test_metric_composite_and_create():
+    m = mx.metric.create(["acc", "ce"])
+    pred = _nd([[0.9, 0.1]])
+    m.update([_nd([0])], [pred])
+    names, values = m.get()
+    assert "accuracy" in names
+
+    cm = mx.metric.np(lambda l, p: float((l == p.argmax(-1)).mean()))
+    cm.update([_nd([0])], [pred])
+    assert cm.get()[1] == 1.0
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = _nd([[0.5, 0.5], [0.25, 0.75]])
+    m.update([_nd([0, 1])], [pred])
+    expect = math.exp(-(math.log(0.5) + math.log(0.75)) / 2)
+    assert abs(m.get()[1] - expect) < 1e-6
