@@ -157,14 +157,28 @@ class Block:
 
     # -- registration ----------------------------------------------------- #
     def __setattr__(self, name, value):
+        # deregister on overwrite so a replaced child/param doesn't linger in
+        # collect_params()/save_parameters() (reference raises TypeError on
+        # type-changing reassignment; we allow it but keep registries exact)
         if isinstance(value, Block):
             existing = self.__dict__.get("_children")
             if existing is not None:
                 existing[name] = value
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg.pop(name, None)
         elif isinstance(value, Parameter):
             reg = self.__dict__.get("_reg_params")
             if reg is not None:
                 reg[name] = value
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing.pop(name, None)
+        else:
+            for regname in ("_children", "_reg_params"):
+                reg = self.__dict__.get(regname)
+                if reg is not None:
+                    reg.pop(name, None)
         super().__setattr__(name, value)
 
     def register_child(self, block, name=None):
@@ -236,6 +250,11 @@ class Block:
                     _load_one(byname[k], v, ctx)
                 elif not ignore_extra:
                     raise MXNetError(f"extra parameter {k} in {filename}")
+            if not allow_missing:
+                missing = set(byname) - set(loaded)
+                if missing:
+                    raise MXNetError(
+                        f"missing parameters in {filename}: {sorted(missing)}")
             return
         for name, p in params.items():
             if name in loaded:
@@ -316,15 +335,7 @@ class Block:
 
 
 def _load_one(p: Parameter, src: NDArray, ctx):
-    p.shape = tuple(src.shape)
-    if p._deferred_init is not None:
-        p._finish_deferred_init()
-    if p._data is None:
-        init, c, default = (None, [ctx] if isinstance(ctx, Context)
-                            else ctx, None)
-        p._set_data_arr(NDArray(jnp.asarray(src._data, jnp.dtype(p.dtype))))
-    else:
-        p.set_data(src)
+    p._load_init(src, ctx)
 
 
 def _classname_hint(name):

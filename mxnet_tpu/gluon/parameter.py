@@ -123,7 +123,8 @@ class Parameter:
                    force_reinit=False):
         """Create and fill the canonical array.  Deferred when the shape has
         unknown (0) dims (reference deferred-init mechanism)."""
-        default_init = default_init or init_mod.Uniform()
+        default_init = init_mod.create(default_init) if default_init is not None \
+            else init_mod.Uniform()
         if self._data is not None and not force_reinit:
             return
         if ctx is None:
@@ -140,15 +141,16 @@ class Parameter:
         self._init_impl(init, ctx, default_init)
 
     def _init_impl(self, init, ctx_list, default_init):
-        from .. import random as mxrandom
-
-        ini = init_mod.create(init) if init is not None else \
-            (init_mod.create(self.init) if self.init is not None
-             else default_init)
+        # Explicit init (param-level ``self.init`` or the ``init`` argument)
+        # rides the InitDesc ``__init__`` attr so the global initializer's
+        # name-suffix dispatch is bypassed (reference Parameter._init_impl).
+        explicit = init_mod.create(init) if init is not None \
+            else init_mod.create(self.init)
         ctx = ctx_list[0]
         arr = NDArray(jnp.zeros(self._shape, jnp.dtype(self.dtype)), ctx)
-        desc = init_mod.InitDesc(self.name)
-        ini(desc, arr)
+        desc = init_mod.InitDesc(
+            self.name, {"__init__": explicit} if explicit is not None else {})
+        default_init(desc, arr)
         if self._sharding is not None:
             arr._rebind(jax.device_put(arr._data, self._sharding))
         elif ctx is not None:
@@ -225,6 +227,32 @@ class Parameter:
         if self._sharding is not None:
             src = jax.device_put(src, self._sharding)
         self._data._rebind(jnp.asarray(src, self._data._data.dtype))
+
+    def _load_init(self, src, ctx=None):
+        """Set the value from a loaded array (``load_parameters`` /
+        ``ParameterDict.load``): cast to ``self.dtype``, honor the requested
+        ctx (falling back to the ctx captured by a pending deferred init),
+        apply sharding, and never pay a random init that would be
+        overwritten."""
+        self.shape = tuple(src.shape)
+        data = jnp.asarray(src._data if isinstance(src, NDArray) else src,
+                           jnp.dtype(self.dtype))
+        c = None
+        if ctx is not None:
+            c = ctx[0] if isinstance(ctx, (list, tuple)) else ctx
+        elif self._deferred_init is not None:
+            dctx = self._deferred_init[1]
+            if dctx is not None:
+                c = dctx[0] if isinstance(dctx, (list, tuple)) else dctx
+        self._deferred_init = None
+        if self._sharding is not None:
+            data = jax.device_put(data, self._sharding)
+        elif isinstance(c, Context):
+            data = jax.device_put(data, c.jax_device())
+        if self._data is None:
+            self._set_data_arr(NDArray(data, c))
+        else:
+            self._data._rebind(jnp.asarray(data, self._data._data.dtype))
 
     def zero_grad(self):
         if self._data is not None and self._data._grad is not None:
@@ -386,14 +414,7 @@ class ParameterDict:
             loaded = {restore_prefix + k: v for k, v in loaded.items()}
         for name, p in self._params.items():
             if name in loaded:
-                p.shape = tuple(loaded[name].shape)
-                p._finish_deferred_init() if p._deferred_init else None
-                if p._data is None:
-                    p._set_data_arr(NDArray(
-                        jnp.asarray(loaded[name]._data,
-                                    jnp.dtype(p.dtype))))
-                else:
-                    p.set_data(loaded[name])
+                p._load_init(loaded[name], ctx)
             elif not allow_missing:
                 raise MXNetError(f"missing parameter {name} in {filename}")
         if not ignore_extra:

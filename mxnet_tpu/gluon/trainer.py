@@ -79,24 +79,33 @@ class Trainer:
             self._kvstore = None
             self._update_on_kvstore = False
         else:
-            from .. import kvstore as kv_mod
-            self._kvstore = kv_mod.create(
-                self._kv_type if isinstance(self._kv_type, str)
-                else "device") if not hasattr(self._kv_type, "push") \
-                else self._kv_type
-            if self._compression_params:
-                self._kvstore.set_gradient_compression(
-                    self._compression_params)
             if self._update_on_kvstore is None:
                 # reference default: update on kvstore for dist, local
                 # update otherwise (single-process TPU: local fused update)
                 self._update_on_kvstore = str(self._kv_type).startswith(
                     "dist")
-            for i, p in enumerate(self._params):
-                if p.grad_req != "null":
-                    self._kvstore.init(i, p.data())
-            if self._update_on_kvstore:
-                self._kvstore.set_optimizer(self._optimizer)
+            if (not self._update_on_kvstore
+                    and not hasattr(self._kv_type, "push")
+                    and not str(self._kv_type).startswith("dist")):
+                # a Parameter owns ONE canonical (possibly GSPMD-sharded)
+                # array, so local pushpull would be an identity allreduce;
+                # skip the store entirely (no weight mirror, no per-step
+                # no-op) — jit/GSPMD handles cross-device reduction
+                self._kvstore = None
+            else:
+                from .. import kvstore as kv_mod
+                self._kvstore = self._kv_type \
+                    if hasattr(self._kv_type, "push") else kv_mod.create(
+                        self._kv_type if isinstance(self._kv_type, str)
+                        else "device")
+                if self._compression_params:
+                    self._kvstore.set_gradient_compression(
+                        self._compression_params)
+                for i, p in enumerate(self._params):
+                    if p.grad_req != "null":
+                        self._kvstore.init(i, p.data())
+                if self._update_on_kvstore:
+                    self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True
 
     @property

@@ -97,18 +97,32 @@ class Initializer:
             return self._init_weight(name, key, shape, dtype)
 
     def __call__(self, desc, arr):
-        """Imperative surface: initialize NDArray ``arr`` in place."""
-        from .ndarray.ndarray import NDArray
+        """Imperative surface: initialize NDArray ``arr`` in place.
+
+        An explicit initializer attached to the parameter
+        (``desc.attrs['__init__']``, reference ``InitDesc`` protocol)
+        BYPASSES the name-suffix dispatch — the reference calls
+        ``create(init)._init_weight(desc, arr)`` directly, so e.g.
+        ``bias_initializer='ones'`` must not be overridden to zeros."""
         from . import random as mxrandom
 
         name = str(desc)
         init_override = getattr(desc, "attrs", {}).get("__init__", "")
         if init_override:
-            ini = create(json.loads(init_override)[0],
-                         **json.loads(init_override)[1]) \
-                if init_override.startswith("[") else create(init_override)
-            val = ini.generate(name, mxrandom.next_key(), arr.shape,
-                               arr._data.dtype)
+            if isinstance(init_override, Initializer):
+                ini = init_override
+            elif init_override.startswith("["):
+                spec = json.loads(init_override)
+                ini = create(spec[0], **spec[1])
+            else:
+                ini = create(init_override)
+            if type(ini).__call__ is not Initializer.__call__:
+                # Load/Mixed style initializers define their own imperative
+                # surface; hand them the array without the override attr.
+                ini(InitDesc(name), arr)
+                return
+            val = ini._init_weight(name, mxrandom.next_key(), arr.shape,
+                                   arr._data.dtype)
         else:
             val = self.generate(name, mxrandom.next_key(), arr.shape,
                                 arr._data.dtype)

@@ -231,6 +231,8 @@ class Perplexity(EvalMetric):
         for label, pred in zip(_as_list(labels), _as_list(preds)):
             pred = _to_numpy(pred)
             label = _to_numpy(label).astype(onp.int64)
+            if self.axis not in (-1, pred.ndim - 1):
+                pred = onp.moveaxis(pred, self.axis, -1)
             flat_pred = pred.reshape(-1, pred.shape[-1])
             flat_label = label.ravel()
             probs = flat_pred[onp.arange(len(flat_label)), flat_label]

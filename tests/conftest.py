@@ -4,11 +4,25 @@ multi-process-on-localhost nightly pattern, SURVEY.md §7 test strategy).
 Must set XLA flags before jax initializes."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The sandbox injects a TPU-tunnel PJRT plugin ("axon") via sitecustomize,
+# which runs before this conftest and registers backend factories whose
+# first initialization dials the tunnel (can hang for minutes).  Tests run
+# on the virtual CPU mesh, so drop every non-cpu factory before any jax
+# backend is initialized.
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _plat in [p for p in _xb._backend_factories if p != "cpu"]:
+    _xb._backend_factories.pop(_plat, None)
+# sitecustomize imported jax with JAX_PLATFORMS=axon already in the env, so
+# the config snapshot must be overridden as well as the env var.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as onp
 import pytest
