@@ -35,6 +35,7 @@ def __getattr__(name):
         "util": ".util",
         "image": ".image",
         "recordio": ".recordio",
+        "parallel": ".parallel",
         "np": ".numpy",
         "npx": ".numpy_extension",
         "lr_scheduler": ".optimizer.lr_scheduler",

@@ -15,7 +15,6 @@ kernels, supplied by XLA fusion instead of hand-written CUDA.
 """
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import jax
@@ -249,7 +248,7 @@ class Adam(Optimizer):
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         coef1 = 1 - self.beta1 ** t
         coef2 = 1 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
+        lr_t = lr * jnp.sqrt(coef2) / coef1
         return w - lr_t * m / (jnp.sqrt(v) + self.epsilon), (m, v)
 
 
@@ -263,7 +262,7 @@ class AdamW(Adam):
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         coef1 = 1 - self.beta1 ** t
         coef2 = 1 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
+        lr_t = lr * jnp.sqrt(coef2) / coef1
         return w - lr_t * (m / (jnp.sqrt(v) + self.epsilon) + wd * w), (m, v)
 
 
@@ -274,23 +273,30 @@ class Nadam(Adam):
         super().__init__(learning_rate=learning_rate, beta1=beta1,
                          beta2=beta2, epsilon=epsilon, **kwargs)
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        # the momentum-schedule product is per-state (not on self) so the
+        # rule stays pure and jit-safe under SPMDTrainer
+        return (jnp.zeros_like(w), jnp.zeros_like(w),
+                jnp.ones((), jnp.float32))
 
     def _update_rule(self, w, g, state, lr, wd, t):
-        m, v = state
+        m, v, m_sched = state
         g = g + wd * w
         momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
         momentum_t1 = self.beta1 * (1 - 0.5 * 0.96 **
                                     ((t + 1) * self.schedule_decay))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t1
-        g_prime = g / (1 - self.m_schedule)
+        m_sched = m_sched * momentum_t
+        m_schedule_next = m_sched * momentum_t1
+        g_prime = g / (1 - m_sched)
         m = self.beta1 * m + (1 - self.beta1) * g
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         m_prime = m / (1 - m_schedule_next)
         v_prime = v / (1 - self.beta2 ** t)
         m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
-        return w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon), (m, v)
+        return (w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon),
+                (m, v, m_sched))
 
 
 @register

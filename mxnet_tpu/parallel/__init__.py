@@ -1,0 +1,35 @@
+"""mxnet_tpu.parallel — distribution over TPU meshes.
+
+This package is the TPU-native answer to the reference's entire
+communication stack (SURVEY.md §3.3, §5.8): the KVStore comm trees
+(``src/kvstore/comm.h``), NCCL ring allreduce (``kvstore_nccl.h``), and the
+ps-lite parameter server (``3rdparty/ps-lite``) all collapse into XLA
+collectives over a ``jax.sharding.Mesh``:
+
+- :mod:`mesh` — device-mesh construction (dp/tp/sp/pp axes, multi-host
+  dcn×ici layouts) and the process-level bootstrap
+  (``init_distributed`` = the reference's ``tools/launch.py`` env
+  protocol, SURVEY.md §4.4).
+- :mod:`collectives` — explicit NDArray-facing collectives
+  (all_reduce/all_gather/reduce_scatter/ppermute) built on ``shard_map``;
+  the reference's engine-scheduled comm ops become compiled XLA ops.
+- :mod:`spmd` — ``ShardingRules`` (regex → PartitionSpec, the GSPMD
+  analog of per-device replica lists) and ``SPMDTrainer``: ONE jitted
+  train step (fwd+bwd+optimizer, donated buffers) over the mesh — the
+  TPU-native form of the reference's record→backward→Trainer.step loop
+  (SURVEY.md §4.2 "the whole step becomes one jit").
+"""
+from .mesh import (Mesh, P, make_mesh, current_mesh, default_mesh,
+                   use_mesh, named_sharding, data_sharding,
+                   replicated_sharding, init_distributed, local_mesh_axes)
+from .collectives import (all_reduce, all_gather, reduce_scatter,
+                          broadcast, ring_pass)
+from .spmd import ShardingRules, shard_block, SPMDTrainer
+
+__all__ = [
+    "Mesh", "P", "make_mesh", "current_mesh", "default_mesh", "use_mesh",
+    "named_sharding", "data_sharding", "replicated_sharding",
+    "init_distributed", "local_mesh_axes",
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ring_pass",
+    "ShardingRules", "shard_block", "SPMDTrainer",
+]

@@ -1,0 +1,156 @@
+"""Device meshes and the multi-host bootstrap.
+
+Reference counterpart: context groups + kvstore device lists
+(``mx.gpu(i)`` lists sliced by ``DataParallelExecutorGroup``) and the
+ps-lite/ZMQ node bootstrap driven by ``tools/launch.py`` env vars
+(``DMLC_PS_ROOT_URI``/``DMLC_ROLE``/..., SURVEY.md §4.4).  TPU-native:
+one ``jax.sharding.Mesh`` names the axes (``dp``/``tp``/``sp``/``pp``)
+and XLA emits the collectives; multi-host membership comes from
+``jax.distributed.initialize`` instead of a ZMQ Van.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["Mesh", "P", "make_mesh", "current_mesh", "default_mesh",
+           "use_mesh", "named_sharding", "data_sharding",
+           "replicated_sharding", "init_distributed", "local_mesh_axes"]
+
+_state = threading.local()
+
+
+def make_mesh(axes=None, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named device mesh.
+
+    ``axes``: dict ``{name: size}`` in major→minor order; at most one size
+    may be ``-1`` ("fill with the remaining devices").  Defaults to a pure
+    data-parallel mesh ``{'dp': n_devices}``.  For multi-host topologies put
+    the cross-host axis first (major) so its collectives ride DCN while the
+    minor axes stay on ICI (SURVEY.md §5.8).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    if isinstance(axes, (list, tuple)):
+        axes = dict(axes)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    fixed = 1
+    for s in sizes:
+        if s != -1:
+            fixed *= s
+    if n % fixed:
+        raise MXNetError(
+            f"mesh axes {axes} do not divide {n} devices")
+    if -1 in sizes:
+        sizes[sizes.index(-1)] = n // fixed
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise MXNetError(
+            f"mesh axes {dict(zip(names, sizes))} use {total} devices, "
+            f"have {n}")
+    arr = onp.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def default_mesh() -> Mesh:
+    """The ambient mesh: the active ``use_mesh`` if any, else a cached pure-DP
+    mesh over all devices."""
+    cur = current_mesh()
+    if cur is not None:
+        return cur
+    if getattr(_state, "default", None) is None or \
+            _state.default.devices.size != len(jax.devices()):
+        _state.default = make_mesh()
+    return _state.default
+
+
+def current_mesh() -> Optional[Mesh]:
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+class use_mesh:
+    """Context manager making ``mesh`` the ambient mesh for sharding-aware
+    APIs (Parameter.set_sharding defaults, SPMDTrainer, kvstore 'tpu')."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = []
+        _state.stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *a):
+        _state.stack.pop()
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def data_sharding(mesh: Optional[Mesh] = None, axis: str = "dp",
+                  ) -> NamedSharding:
+    """Batch-dim sharding for input batches (the reference's batch slicing
+    across the ctx list, SURVEY.md §3.3 row 'Data parallel')."""
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P())
+
+
+def local_mesh_axes(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None) -> None:
+    """Multi-host bootstrap (replaces the reference's ps-lite scheduler
+    rendezvous, SURVEY.md §4.4).
+
+    Falls back to env vars so ``tools/launch.py``-style launchers work:
+    ``MXNET_COORDINATOR`` (or the reference-compatible pair
+    ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``), ``MXNET_NUM_WORKERS`` (or
+    ``DMLC_NUM_WORKER``), ``MXNET_WORKER_ID`` (or ``DMLC_WORKER_ID``).
+    No-ops when single-process and no coordinator is configured.
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXNET_COORDINATOR")
+        if coordinator_address is None:
+            uri = os.environ.get("DMLC_PS_ROOT_URI")
+            port = os.environ.get("DMLC_PS_ROOT_PORT")
+            if uri and port:
+                coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        num_processes = int(os.environ.get(
+            "MXNET_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+    if process_id is None:
+        process_id = int(os.environ.get(
+            "MXNET_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+    if coordinator_address is None and num_processes == 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)

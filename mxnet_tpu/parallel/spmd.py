@@ -1,0 +1,340 @@
+"""GSPMD sharding rules and the fused SPMD train step.
+
+Reference counterpart (SURVEY.md §4.2): the training step is
+``record → forward → backward → Trainer.step`` with the KVStore doing the
+cross-device reduction as separate engine ops.  TPU-native, that whole loop
+is ONE jitted function over the mesh: forward+backward+optimizer with
+donated buffers; GSPMD inserts the grad all-reduce (data axis) and the
+tensor-parallel collectives (model axis) from sharding annotations — the
+explicit KVStore machinery disappears into the compiler
+(SURVEY.md §7 "KVStore").
+
+``ShardingRules`` plays the role of the reference's per-device replica
+lists / `group2ctx` placement (§3.3): a regex over parameter names maps
+each param to a ``PartitionSpec`` on the mesh.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .mesh import Mesh, P, default_mesh
+from jax.sharding import NamedSharding
+
+__all__ = ["ShardingRules", "shard_block", "SPMDTrainer"]
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) rules for parameter sharding.
+
+    Example (tensor parallel Dense layers on axis 'tp', everything else
+    replicated)::
+
+        rules = ShardingRules([
+            (r".*dense\\d*\\.weight", P("tp", None)),
+            (r".*\\.bias",            P("tp")),
+        ])
+        shard_block(net, mesh, rules)
+    """
+
+    def __init__(self, rules: Sequence, default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, name: str, shape=None, mesh: Optional[Mesh] = None):
+        spec = self.default
+        for pat, s in self.rules:
+            if pat.match(name):
+                spec = s
+                break
+        if shape is None or mesh is None:
+            return spec
+        return _fit_spec(spec, shape, mesh)
+
+
+def _fit_spec(spec, shape, mesh: Mesh):
+    """Drop spec axes that don't divide the corresponding dim, and truncate
+    the spec to the array rank (so tiny test shapes and rank-mismatched
+    rules still compile instead of erroring inside GSPMD)."""
+    from .mesh import local_mesh_axes
+    sizes = local_mesh_axes(mesh)
+    out = []
+    for i, s in enumerate(tuple(spec)[:len(shape)]):
+        if s is None:
+            out.append(None)
+            continue
+        ax_size = sizes.get(s) if isinstance(s, str) else None
+        if isinstance(s, (tuple, list)):
+            ax_size = 1
+            for name in s:
+                ax_size *= sizes[name]
+        if ax_size is None or (shape[i] and shape[i] % ax_size == 0):
+            out.append(s)
+        else:
+            out.append(None)
+    return P(*out)
+
+
+def shard_block(block, mesh: Optional[Mesh] = None,
+                rules: Optional[ShardingRules] = None):
+    """Annotate every initialized parameter of ``block`` with a
+    ``NamedSharding`` from ``rules`` (device_put happens immediately;
+    uninitialized params pick the sharding up at init)."""
+    mesh = mesh or default_mesh()
+    rules = rules or ShardingRules([])
+    for name, p in block.collect_params().items():
+        spec = rules.spec_for(name, p.shape if p.shape else None, mesh)
+        p.set_sharding(NamedSharding(mesh, spec))
+    return block
+
+
+class SPMDTrainer:
+    """One-jit training: ``step(data, label)`` runs forward, backward, and
+    the optimizer update as a single compiled SPMD program over the mesh.
+
+    - ``dp_axis`` shards the batch (data parallel); grads are reduced by
+      GSPMD automatically because params are replicated (or sharded) over
+      that axis.
+    - param shardings come from ``rules`` (tensor/sequence parallel) or
+      previously applied ``Parameter.set_sharding``.
+    - param + optimizer-state buffers are donated: the update is in-place
+      at the XLA level (the reference's ``static_alloc`` memory reuse).
+
+    The imperative ``gluon.Trainer`` remains the API-parity path; this is
+    the performance path (SURVEY.md §7 build plan, Phase 2).
+    """
+
+    def __init__(self, block, loss_fn: Callable, optimizer,
+                 optimizer_params: Optional[dict] = None,
+                 mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None,
+                 dp_axis: str = "dp", donate: bool = True):
+        from .. import optimizer as opt_mod
+
+        self._block = block
+        self._loss_fn = loss_fn
+        self._mesh = mesh or default_mesh()
+        self._rules = rules
+        self._dp_axis = dp_axis
+        self._donate = donate
+        optimizer_params = dict(optimizer_params or {})
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._opt = optimizer
+            self._rescale = float(optimizer_params.pop(
+                "rescale_grad", optimizer.rescale_grad))
+        else:
+            self._rescale = float(optimizer_params.pop("rescale_grad", 1.0))
+            self._opt = opt_mod.create(optimizer, **optimizer_params)
+        self._built = False
+        self._step_fn = None
+        self._t = 0
+        self._param_names: list = []
+        self._train_params: list = []   # Parameter objs with grad_req != null
+        self._frozen_params: list = []  # grad_req == null (e.g. running stats)
+        self._train_vals: list = []
+        self._frozen_vals: list = []
+        self._opt_states: list = []
+
+    # ------------------------------------------------------------------ #
+    @property
+    def optimizer(self):
+        return self._opt
+
+    @property
+    def learning_rate(self):
+        return self._opt.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._opt.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------ #
+    def _ensure_built(self, data, label):
+        if self._built:
+            return
+        from ..ndarray.ndarray import NDArray
+        from ..gluon.block import _no_hybrid
+        from .. import autograd
+
+        block = self._block
+        params = block.collect_params()
+        if any(p._data is None for p in params.values()):
+            # materialize deferred shapes with one imperative forward
+            with autograd.pause(train_mode=False), _no_hybrid():
+                block(data if isinstance(data, NDArray) else
+                      NDArray(jnp.asarray(data)))
+            params = block.collect_params()
+        if self._rules is not None:
+            shard_block(block, self._mesh, self._rules)
+        for name, p in params.items():
+            if p._data is None:
+                continue
+            self._param_names.append(name)
+            if p.grad_req != "null":
+                self._train_params.append(p)
+            else:
+                self._frozen_params.append(p)
+        self._train_vals = [p._data._data for p in self._train_params]
+        self._frozen_vals = [p._data._data for p in self._frozen_params]
+        self._opt_states = [
+            self._opt.create_state_multi_precision(i, p.data())
+            for i, p in enumerate(self._train_params)]
+        self._step_fn = self._compile()
+        self._built = True
+
+    # ------------------------------------------------------------------ #
+    def _forward_loss(self, key, train_vals, frozen_vals, data, label,
+                      aux_out):
+        """Pure loss: swap param values into the block, run block + loss
+        imperatively (ops dispatch straight to jnp on tracers), collect aux
+        (running-stat) updates."""
+        from ..ndarray.ndarray import NDArray
+        from ..gluon.block import _no_hybrid, _trace_state
+        from .. import autograd, random as mxrandom
+
+        all_params = self._train_params + self._frozen_params
+        all_vals = list(train_vals) + list(frozen_vals)
+        saved = [(p._data._data, p._data._autograd_node,
+                  p._data._autograd_idx) for p in all_params]
+        aux: OrderedDict = OrderedDict()
+        _trace_state.stack.append(aux)
+        mxrandom.push_trace_key(key)
+        try:
+            for p, v in zip(all_params, all_vals):
+                p._data._data = v
+                p._data._autograd_node = None
+            with autograd.pause(train_mode=True), _no_hybrid():
+                out = self._block(NDArray(data))
+                out0 = out[0] if isinstance(out, (list, tuple)) else out
+                loss = self._loss_fn(out0, NDArray(label))
+                loss_val = jnp.mean(loss._data if isinstance(loss, NDArray)
+                                    else loss)
+        finally:
+            for p, (v, node, idx) in zip(all_params, saved):
+                p._data._data = v
+                p._data._autograd_node = node
+                p._data._autograd_idx = idx
+            mxrandom.pop_trace_key()
+            _trace_state.stack.pop()
+        aux_out.append([(p, jax.lax.stop_gradient(v))
+                        for (p, v) in aux.values()])
+        return loss_val
+
+    def _compile(self):
+        opt = self._opt
+        mp_flags = []
+        for s, p in zip(self._opt_states, self._train_params):
+            w = p._data._data
+            mp_flags.append(
+                opt.multi_precision and w.dtype in (jnp.float16, jnp.bfloat16)
+                and isinstance(s, tuple) and len(s) == 2
+                and getattr(s[0], "dtype", None) == jnp.float32)
+        lr_mults = [float(p.lr_mult) for p in self._train_params]
+        wd_mults = [float(p.wd_mult) for p in self._train_params]
+
+        def step_fn(train_vals, opt_states, frozen_vals, key, lr, rescale,
+                    t, data, label):
+            aux_box: list = []
+
+            def loss_of(tv):
+                return self._forward_loss(key, tv, frozen_vals, data,
+                                          label, aux_box)
+
+            loss, grads = jax.value_and_grad(loss_of)(tuple(train_vals))
+            aux_pairs = aux_box[-1] if aux_box else []
+
+            new_vals, new_states = [], []
+            for i, (w, g, s, mp) in enumerate(
+                    zip(train_vals, grads, opt_states, mp_flags)):
+                lr_i = lr * lr_mults[i]
+                wd_i = opt.wd * wd_mults[i]
+                if mp:
+                    master, inner = s
+                    g32 = g.astype(jnp.float32) * rescale
+                    if opt.clip_gradient is not None:
+                        g32 = jnp.clip(g32, -opt.clip_gradient,
+                                       opt.clip_gradient)
+                    nm, ni = opt._update_rule(master, g32, inner, lr_i,
+                                              wd_i, t)
+                    new_vals.append(nm.astype(w.dtype))
+                    new_states.append((nm, ni))
+                else:
+                    g = g.astype(w.dtype) * rescale
+                    if opt.clip_gradient is not None:
+                        g = jnp.clip(g, -opt.clip_gradient,
+                                     opt.clip_gradient)
+                    nw, ns = opt._update_rule(w, g, s, lr_i, wd_i, t)
+                    new_vals.append(nw)
+                    new_states.append(ns)
+
+            # map aux updates back to frozen-param slots
+            aux_by_id = {id(p): v for p, v in aux_pairs}
+            new_frozen = [aux_by_id.get(id(p), v)
+                          for p, v in zip(self._frozen_params, frozen_vals)]
+            return loss, list(new_vals), new_states, new_frozen
+
+        mesh = self._mesh
+        repl = NamedSharding(mesh, P())
+
+        def shard_of(p):
+            return p._sharding if p._sharding is not None else repl
+
+        def state_shardings(s, p):
+            psh = shard_of(p)
+            return jax.tree.map(
+                lambda leaf: psh if getattr(leaf, "shape", None)
+                == p._data._data.shape else repl, s)
+
+        in_shardings = (
+            [shard_of(p) for p in self._train_params],
+            [state_shardings(s, p)
+             for s, p in zip(self._opt_states, self._train_params)],
+            [shard_of(p) for p in self._frozen_params],
+            repl, repl, repl, repl,
+            NamedSharding(mesh, P(self._dp_axis)),
+            NamedSharding(mesh, P(self._dp_axis)),
+        )
+        out_shardings = (
+            repl,               # loss
+            in_shardings[0],    # new param values keep their layout
+            in_shardings[1],    # optimizer states likewise
+            in_shardings[2],    # frozen/aux values likewise
+        )
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step_fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+
+    # ------------------------------------------------------------------ #
+    def step(self, data, label, batch_size: Optional[int] = None):
+        """Run one fused train step; returns the (device-async) loss as an
+        NDArray.  ``batch_size`` defaults to the global batch dim (grad is
+        the mean loss's grad, so rescale defaults to 1)."""
+        from ..ndarray.ndarray import NDArray
+        from .. import random as mxrandom
+
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        self._ensure_built(NDArray(d), NDArray(l))
+        self._t += 1
+        self._opt.num_update = self._t
+        lr = jnp.asarray(self._opt.learning_rate, jnp.float32)
+        rescale = jnp.asarray(
+            self._rescale / (batch_size if batch_size else 1.0), jnp.float32)
+        t = jnp.asarray(self._t, jnp.int32)
+        d = jax.device_put(d, NamedSharding(self._mesh, P(self._dp_axis)))
+        l = jax.device_put(l, NamedSharding(self._mesh, P(self._dp_axis)))
+        loss, self._train_vals, self._opt_states, self._frozen_vals = \
+            self._step_fn(self._train_vals, self._opt_states,
+                          self._frozen_vals, mxrandom.next_key(), lr,
+                          rescale, t, d, l)
+        # sync new values back into the block's Parameters (rebind is
+        # async — no host transfer)
+        for p, v in zip(self._train_params, self._train_vals):
+            p._data._data = v
+        for p, v in zip(self._frozen_params, self._frozen_vals):
+            p._data._data = v
+        return NDArray(loss)

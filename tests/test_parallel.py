@@ -1,0 +1,200 @@
+"""Collectives + SPMD sharding tests on the 8-device virtual CPU mesh
+(mirrors the reference's multi-process-on-localhost nightly kvstore tests,
+SURVEY.md §7 test strategy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.parallel import P
+
+
+def test_make_mesh_shapes():
+    m = parallel.make_mesh()
+    assert m.devices.size == 8
+    m2 = parallel.make_mesh({"dp": 2, "tp": -1})
+    assert dict(zip(m2.axis_names, m2.devices.shape)) == {"dp": 2, "tp": 4}
+    with pytest.raises(mx.MXNetError):
+        parallel.make_mesh({"dp": 3})
+
+
+def test_use_mesh_context():
+    m = parallel.make_mesh({"dp": 4, "tp": 2})
+    assert parallel.current_mesh() is None
+    with parallel.use_mesh(m):
+        assert parallel.current_mesh() is m
+        assert parallel.default_mesh() is m
+    assert parallel.current_mesh() is None
+
+
+def test_all_reduce_sum_mean():
+    x = mx.nd.array(onp.arange(16, dtype="float32").reshape(8, 2))
+    red = parallel.all_reduce(x, axis="dp", op="sum")
+    # each shard is (1,2); sum over 8 shards
+    expect = onp.arange(16, dtype="float32").reshape(8, 2).sum(0)
+    onp.testing.assert_allclose(red.asnumpy(), expect[None, :], rtol=1e-6)
+    mean = parallel.all_reduce(x, axis="dp", op="mean")
+    onp.testing.assert_allclose(mean.asnumpy(), expect[None, :] / 8,
+                                rtol=1e-6)
+
+
+def test_all_gather_roundtrip():
+    x = mx.nd.array(onp.arange(8, dtype="float32").reshape(8, 1))
+    g = parallel.all_gather(x, axis="dp")
+    assert g.shape == (8, 1)
+    onp.testing.assert_allclose(g.asnumpy(), x.asnumpy())
+
+
+def test_reduce_scatter():
+    x = mx.nd.array(onp.ones((8, 4), dtype="float32"))
+    r = parallel.reduce_scatter(x, axis="dp", op="sum")
+    assert r.shape == (8, 4)
+    onp.testing.assert_allclose(r.asnumpy(), 8 * onp.ones((8, 4)), rtol=1e-6)
+
+
+def test_broadcast_root():
+    x = mx.nd.array(onp.arange(8, dtype="float32").reshape(8, 1))
+    b = parallel.broadcast(x, axis="dp", root=3)
+    onp.testing.assert_allclose(b.asnumpy(), 3 * onp.ones((1, 1)))
+
+
+def test_ring_pass_rotates():
+    m = parallel.make_mesh({"sp": 8})
+    x = mx.nd.array(onp.arange(8, dtype="float32").reshape(8, 1))
+    y = parallel.ring_pass(x, mesh=m, axis="sp", shift=1)
+    # shard i receives shard (i-1 mod 8)'s value
+    expect = onp.roll(onp.arange(8, dtype="float32"), 1).reshape(8, 1)
+    onp.testing.assert_allclose(y.asnumpy(), expect)
+
+
+def test_sharding_rules_fit():
+    m = parallel.make_mesh({"dp": 2, "tp": 4})
+    rules = parallel.ShardingRules([
+        (r".*weight", P("tp", None)),
+        (r".*bias", P("tp")),
+    ])
+    assert tuple(rules.spec_for("dense0.weight", (8, 16), m)) == ("tp", None)
+    # 6 not divisible by tp=4 -> fall back to replicated on that dim
+    assert tuple(rules.spec_for("dense0.weight", (6, 16), m)) == (None, None)
+    assert tuple(rules.spec_for("dense0.bias", (8,), m)) == ("tp",)
+    assert tuple(rules.spec_for("other.gamma", (8,), m)) == ()
+
+
+def _make_net():
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    return net
+
+
+def test_spmd_trainer_dp_trains():
+    from mxnet_tpu import gluon
+    mx.random.seed(0)
+    net = _make_net()
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh({"dp": 8})
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "adam", {"learning_rate": 0.05}, mesh=mesh)
+    onp.random.seed(0)
+    X = onp.random.randn(64, 16).astype("float32")
+    W = onp.random.randn(16, 8).astype("float32")
+    y = (X @ W).argmax(1)
+    losses = [float(tr.step(mx.nd.array(X), mx.nd.array(y)).asnumpy())
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_spmd_trainer_tp_matches_replicated():
+    """Same seed, TP-sharded vs replicated params: losses must agree (the
+    sharding is a layout, not a math change)."""
+    from mxnet_tpu import gluon
+
+    def run(rules):
+        mx.random.seed(1)
+        net = _make_net()
+        net.initialize(mx.init.Xavier())
+        mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+        tr = parallel.SPMDTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, mesh=mesh, rules=rules)
+        onp.random.seed(1)
+        X = onp.random.randn(16, 16).astype("float32")
+        y = onp.random.randint(0, 8, size=16)
+        return [float(tr.step(mx.nd.array(X), mx.nd.array(y)).asnumpy())
+                for _ in range(5)]
+
+    tp_rules = parallel.ShardingRules([(r".*weight", P("tp", None))])
+    base = run(None)
+    tp = run(tp_rules)
+    onp.testing.assert_allclose(base, tp, rtol=2e-5)
+
+
+def test_spmd_trainer_nadam_multi_step():
+    """Nadam's momentum schedule lives in per-param state, not on self —
+    step 2 must not see a leaked tracer."""
+    from mxnet_tpu import gluon
+    mx.random.seed(0)
+    net = _make_net()
+    net.initialize()
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "nadam", {"learning_rate": 0.01},
+                              mesh=parallel.make_mesh({"dp": 8}))
+    X = onp.random.randn(16, 16).astype("float32")
+    y = onp.random.randint(0, 8, size=16)
+    for _ in range(3):
+        loss = tr.step(mx.nd.array(X), mx.nd.array(y))
+    assert onp.isfinite(loss.asnumpy()).all()
+
+
+def test_spmd_trainer_honors_instance_rescale():
+    from mxnet_tpu import gluon, optimizer
+    mx.random.seed(0)
+    net = _make_net()
+    net.initialize()
+    opt = optimizer.SGD(learning_rate=0.5, rescale_grad=0.0)
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
+                              mesh=parallel.make_mesh({"dp": 8}))
+    X = onp.random.randn(16, 16).astype("float32")
+    y = onp.random.randint(0, 8, size=16)
+    net(mx.nd.array(X))  # materialize deferred shapes
+    w_before = net[0].weight.data().asnumpy().copy()
+    tr.step(mx.nd.array(X), mx.nd.array(y))
+    onp.testing.assert_allclose(net[0].weight.data().asnumpy(), w_before)
+
+
+def test_fit_spec_truncates_rank():
+    m = parallel.make_mesh({"dp": 2, "tp": 4})
+    rules = parallel.ShardingRules([(r".*dense.*", P("tp", None))])
+    # rank-1 bias matched by a rank-2 spec: spec must truncate, not error
+    assert tuple(rules.spec_for("dense0.bias", (8,), m)) in ((None,), ("tp",))
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(8, in_units=16)
+    net.initialize()
+    parallel.shard_block(net, m, rules)  # must not raise
+
+
+def test_broadcast_bad_root_raises():
+    x = mx.nd.array(onp.arange(8, dtype="float32").reshape(8, 1))
+    with pytest.raises(ValueError):
+        parallel.broadcast(x, axis="dp", root=8)
+
+
+def test_spmd_trainer_batchnorm_aux_updates():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.BatchNorm(in_channels=16),
+            nn.Dense(4))
+    net.initialize()
+    mesh = parallel.make_mesh({"dp": 8})
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {"learning_rate": 0.1}, mesh=mesh)
+    X = onp.random.randn(16, 8).astype("float32")
+    y = onp.random.randint(0, 4, size=16)
+    bn = net[1]
+    before = bn.running_mean.data().asnumpy().copy()
+    for _ in range(3):
+        tr.step(mx.nd.array(X), mx.nd.array(y))
+    after = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(before, after), "running stats never updated"
