@@ -1,0 +1,89 @@
+"""Headline benchmark: BERT-base pretrain-style train step, tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): upstream-MXNet-era BERT-base pretrain throughput on
+V100 fp16 was ~10-20k tokens/sec/GPU; vs_baseline is measured against the
+15k midpoint.  The model here is BERT-base geometry (12 layers, 768 units,
+12 heads, seq 128) in bfloat16 with a full-vocab tied MLM head, trained by
+the fused SPMD step (forward+backward+AdamW in one donated jit).
+"""
+import json
+import os
+import sys
+import time
+
+BASELINE_TOKENS_PER_SEC = 15000.0
+
+
+def main():
+    if os.environ.get("MXNET_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["MXNET_BENCH_PLATFORM"])
+    import numpy as onp
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models import BERTModel, BERTConfig
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    mx.random.seed(0)
+
+    seq = 128
+    batch = 64 if on_tpu else 8
+    cfg = BERTConfig(vocab_size=30528, max_length=seq, num_layers=12,
+                     units=768, num_heads=12, hidden_size=3072,
+                     dtype="bfloat16" if on_tpu else "float32")
+    if not on_tpu:  # CPU smoke config (local sanity runs only)
+        cfg.num_layers = 2
+    bert = BERTModel(cfg, use_pooler=False, use_mlm=True)
+
+    class _MLMHeadOnly(gluon.Block):
+        """Select the MLM logits as the training output."""
+
+        def __init__(self):
+            super().__init__()
+            self.bert = bert
+
+        def forward(self, tokens):
+            return self.bert(tokens)[-1]
+
+    net = _MLMHeadOnly()
+    net.initialize(mx.init.Normal(0.02))
+
+    mesh = parallel.make_mesh({"dp": len(jax.devices())})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = parallel.SPMDTrainer(net, loss_fn, "adamw",
+                                   {"learning_rate": 1e-4}, mesh=mesh)
+
+    rng = onp.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, (batch, seq))
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq))
+    data = mx.nd.array(toks)
+    label = mx.nd.array(labels)
+
+    # warmup (compile) + steady-state timing
+    for _ in range(3):
+        trainer.step(data, label).wait_to_read()
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = trainer.step(data, label)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * n_steps / dt / max(
+        1, len(jax.devices()))
+    print(json.dumps({
+        "metric": "bert_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

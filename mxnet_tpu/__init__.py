@@ -36,6 +36,7 @@ def __getattr__(name):
         "image": ".image",
         "recordio": ".recordio",
         "parallel": ".parallel",
+        "models": ".models",
         "np": ".numpy",
         "npx": ".numpy_extension",
         "lr_scheduler": ".optimizer.lr_scheduler",

@@ -1,0 +1,22 @@
+"""Model families (transformers).
+
+The reference's transformer-era surface lives in GluonNLP (external) plus the
+contrib fused-attention ops (SURVEY.md §3.1 contrib family,
+``_contrib_interleaved_matmul_selfatt_*``).  Here the transformer family is
+first-class: hybridizable Gluon blocks whose attention runs the flash
+kernel (ops/attention.py) and whose layouts are MXU-shaped (fused QKV
+matmul, big batched GEMMs).  Vision models live in
+``gluon.model_zoo.vision``.
+"""
+from .transformer import (MultiHeadAttention, PositionwiseFFN,
+                          TransformerEncoderCell, TransformerDecoderCell)
+from .gpt import GPT, GPTConfig, gpt2_small, gpt2_medium, gpt2_large, \
+    gpt2_774m, gpt_tp_rules
+from .bert import BERTModel, BERTConfig, bert_base, bert_large
+
+__all__ = [
+    "MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+    "TransformerDecoderCell", "GPT", "GPTConfig", "gpt2_small",
+    "gpt2_medium", "gpt2_large", "gpt2_774m", "gpt_tp_rules",
+    "BERTModel", "BERTConfig", "bert_base", "bert_large",
+]

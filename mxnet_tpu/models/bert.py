@@ -1,0 +1,118 @@
+"""BERT encoder models (BASELINE config 3: BERT-base pretrain).
+
+Reference counterpart: GluonNLP BERT over the contrib interleaved
+self-attention ops (SURVEY.md §3.1 contrib).  TPU-native: flash-attention
+encoder cells with an additive padding-mask bias, token-type + position
+embeddings, pooler, and MLM/NSP heads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..gluon.block import HybridBlock
+from ..gluon.nn.basic_layers import Dense, Dropout, Embedding, LayerNorm
+from .transformer import TransformerEncoderCell
+
+__all__ = ["BERTConfig", "BERTModel", "bert_base", "bert_large"]
+
+
+@dataclass
+class BERTConfig:
+    vocab_size: int = 30522
+    max_length: int = 512
+    type_vocab_size: int = 2
+    num_layers: int = 12
+    units: int = 768
+    num_heads: int = 12
+    hidden_size: int = 3072
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+
+class BERTModel(HybridBlock):
+    """tokens (B, L) [+ token_types (B, L), + valid_length (B,)] →
+    (sequence_output (B, L, U), pooled_output (B, U), mlm_logits)."""
+
+    def __init__(self, config: BERTConfig, use_pooler=True, use_mlm=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cfg = config
+        self._use_pooler = use_pooler
+        self._use_mlm = use_mlm
+        c = config
+        with self.name_scope():
+            self.word_embed = Embedding(c.vocab_size, c.units,
+                                        dtype=c.dtype, prefix="word_")
+            self.token_type_embed = Embedding(c.type_vocab_size, c.units,
+                                              dtype=c.dtype, prefix="type_")
+            self.position_embed = Embedding(c.max_length, c.units,
+                                            dtype=c.dtype, prefix="pos_")
+            self.embed_ln = LayerNorm(in_channels=c.units, prefix="embln_")
+            self.embed_drop = Dropout(c.dropout) if c.dropout else None
+            self.cells = []
+            for i in range(c.num_layers):
+                cell = TransformerEncoderCell(
+                    c.units, c.hidden_size, c.num_heads, c.dropout,
+                    dtype=c.dtype,
+                    prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.cells.append(cell)
+            if use_pooler:
+                self.pooler = Dense(c.units, flatten=False,
+                                    in_units=c.units, activation="tanh",
+                                    dtype=c.dtype, prefix="pooler_")
+            if use_mlm:
+                self.mlm_dense = Dense(c.units, flatten=False,
+                                       in_units=c.units, activation="gelu",
+                                       dtype=c.dtype, prefix="mlmd_")
+                self.mlm_ln = LayerNorm(in_channels=c.units,
+                                        prefix="mlmln_")
+
+    def forward(self, tokens, token_types=None, valid_length=None,
+                *args, **kwargs):
+        from .. import ndarray as F
+        B, L = tokens.shape
+        c = self._cfg
+        x = self.word_embed(tokens)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        pos_ids = F.broadcast_to(
+            F.reshape(F.arange(L, dtype="int32"), shape=(1, L)),
+            shape=(B, L))
+        x = x + self.position_embed(pos_ids)
+        x = self.embed_ln(x)
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        mask = None
+        if valid_length is not None:
+            # additive key-side padding mask: (B, 1, 1, L), −1e30 at pads
+            kpos = F.reshape(F.arange(L, dtype="float32"), shape=(1, 1, 1, L))
+            vl = F.reshape(valid_length.astype("float32"), shape=(B, 1, 1, 1))
+            mask = (F.broadcast_to(kpos, shape=(B, 1, 1, L)) >=
+                    F.broadcast_to(vl, shape=(B, 1, 1, L))) * -1e30
+        for cell in self.cells:
+            x = cell(x) if mask is None else cell(x, mask)
+        outs = [x]
+        if self._use_pooler:
+            cls = F.reshape(F.slice_axis(x, axis=1, begin=0, end=1), shape=(B, c.units))
+            outs.append(self.pooler(cls))
+        if self._use_mlm:
+            h = self.mlm_ln(self.mlm_dense(x))
+            w = self.word_embed.weight.data()          # tied decoder
+            logits = F.dot(F.reshape(h, shape=(B * L, c.units)), w,
+                           transpose_b=True)
+            outs.append(F.reshape(logits, shape=(B, L, c.vocab_size)))
+        return outs if len(outs) > 1 else outs[0]
+
+
+def _preset(**kw):
+    def make(use_pooler=True, use_mlm=True, **overrides):
+        cfg = BERTConfig(**{**kw, **overrides})
+        return BERTModel(cfg, use_pooler=use_pooler, use_mlm=use_mlm), cfg
+    return make
+
+
+bert_base = _preset(num_layers=12, units=768, num_heads=12,
+                    hidden_size=3072)
+bert_large = _preset(num_layers=24, units=1024, num_heads=16,
+                     hidden_size=4096)

@@ -1,0 +1,117 @@
+"""Transformer building blocks (hybridizable, MXU-shaped).
+
+Reference counterpart: GluonNLP's BERT/Transformer blocks built on the
+contrib interleaved self-attention ops
+(``_contrib_interleaved_matmul_selfatt_qk``, SURVEY.md §3.1) which fuse the
+QKV projections into one matmul.  Here the same fusion holds (one
+Dense(3·units) projection — one big MXU GEMM) and the O(L²) score
+materialization is replaced by the flash kernel (O(L) memory,
+SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon.nn.basic_layers import Dense, Dropout, LayerNorm
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerDecoderCell"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Fused-QKV multi-head self-attention over (batch, seq, units).
+
+    ``causal=True`` gives decoder (GPT) masking inside the flash kernel;
+    an optional additive ``mask`` input (broadcastable to (B, 1, L, L),
+    −inf at masked positions) carries encoder padding masks.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 use_bias=True, dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by "
+                             f"num_heads {num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, use_bias=use_bias,
+                             in_units=units, dtype=dtype, prefix="qkv_")
+            self.proj = Dense(units, flatten=False, use_bias=use_bias,
+                              in_units=units, dtype=dtype, prefix="out_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mask=None):
+        B, L, U = x.shape
+        H, D = self._heads, self._units // self._heads
+        qkv = self.qkv(x)                                     # (B, L, 3U)
+        qkv = F.reshape(qkv, shape=(B, L, 3, H, D))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))          # (3,B,H,L,D)
+        q = F.reshape(F.slice_axis(qkv, axis=0, begin=0, end=1), shape=(B, H, L, D))
+        k = F.reshape(F.slice_axis(qkv, axis=0, begin=1, end=2), shape=(B, H, L, D))
+        v = F.reshape(F.slice_axis(qkv, axis=0, begin=2, end=3), shape=(B, H, L, D))
+        out = F.flash_attention(q, k, v, mask, causal=self._causal)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, L, U))
+        out = self.proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """units → hidden (GELU) → units; both matmuls MXU-large."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.fc1 = Dense(hidden_size, flatten=False, in_units=units,
+                             activation=activation, dtype=dtype,
+                             prefix="fc1_")
+            self.fc2 = Dense(units, flatten=False, in_units=hidden_size,
+                             dtype=dtype, prefix="fc2_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.fc2(self.fc1(x))
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class _TransformerCell(HybridBlock):
+    """Pre-norm transformer layer: x + attn(ln(x)); x + ffn(ln(x))."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 causal=False, dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=units, prefix="ln1_")
+            self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                           causal=causal, dtype=dtype,
+                                           prefix="attn_")
+            self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       dtype=dtype, prefix="ffn_")
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = x + self.attn(self.ln1(x), mask) if mask is not None else \
+            x + self.attn(self.ln1(x))
+        return x + self.ffn(self.ln2(x))
+
+
+class TransformerEncoderCell(_TransformerCell):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(units, hidden_size, num_heads, dropout,
+                         causal=False, dtype=dtype, prefix=prefix,
+                         params=params)
+
+
+class TransformerDecoderCell(_TransformerCell):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(units, hidden_size, num_heads, dropout,
+                         causal=True, dtype=dtype, prefix=prefix,
+                         params=params)

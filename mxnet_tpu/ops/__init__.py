@@ -3,3 +3,4 @@ from . import registry
 from .registry import Op, get_op, list_ops, invoke, register
 from . import defs
 from . import nn
+from . import attention

@@ -1,0 +1,400 @@
+"""Attention kernels: flash attention (Pallas TPU) + ring attention (SP).
+
+Reference counterpart: the BERT-era fused attention matmuls
+(``_contrib_interleaved_matmul_selfatt_qk/valatt``, SURVEY.md §3.1
+"Operator corpus" contrib family) which materialize the O(L²) score matrix.
+The TPU-native answer (SURVEY.md §5.7 — NEW capability, not parity) is:
+
+- ``flash_attention``: blockwise online-softmax attention, O(L) memory.
+  Forward is a Pallas kernel on TPU (MXU-tiled 128-blocks, fp32
+  accumulation); everywhere else a ``lax.scan`` blockwise implementation
+  that XLA fuses.  Backward recomputes blockwise from the saved
+  log-sum-exp (the flash-attention-2 scheme) — no O(L²) residuals.
+- ``ring_attention``: sequence-parallel attention over a mesh axis; K/V
+  shards rotate around the ICI ring via ``ppermute`` while each device
+  accumulates online-softmax partials for its local Q shard.  This is the
+  scale-out long-context path (SURVEY.md §3.3 "SP/CP" row).
+
+Shapes follow (batch, heads, seq, head_dim) throughout.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+__all__ = ["flash_attention", "ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # run the Pallas kernel in interpreter mode (CPU numerics testing)
+    return os.environ.get("MXNET_FLASH_INTERPRET", "") == "1"
+
+
+def _use_pallas() -> bool:
+    env = os.environ.get("MXNET_USE_FLASH_ATTENTION", "").lower()
+    if env in ("0", "false", "off"):
+        return False
+    if _interpret():
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# blockwise reference path (runs everywhere; O(L) memory via scan)
+# ---------------------------------------------------------------------------
+
+def _blockwise_attn(q, k, v, bias, scale, causal, q_block):
+    """Online-softmax attention, scanning over q blocks.  Returns
+    (out, lse) with lse = logsumexp of scores per query row (fp32).
+    ``bias`` is an optional additive score bias broadcastable to
+    (B, H, Lq, Lk) — the padding-mask channel."""
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    nq = -(-Lq // q_block)
+    pad_q = nq * q_block - Lq
+    qf = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    qf = qf.reshape(B, H, nq, q_block, D)
+    if bias is not None:
+        bias = jnp.broadcast_to(
+            bias.astype(jnp.float32),
+            (bias.shape[0], bias.shape[1], Lq, Lk))
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_q), (0, 0))) \
+            if pad_q else bias
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    kpos = lax.broadcasted_iota(jnp.int32, (1, Lk), 1)
+
+    def one_block(i, qb):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qb.astype(jnp.float32), k32)
+        s = s * scale
+        if bias is not None:
+            s = s + lax.dynamic_slice_in_dim(bias, i * q_block, q_block,
+                                             axis=2)
+        if causal:
+            qpos = i * q_block + lax.broadcasted_iota(
+                jnp.int32, (q_block, 1), 0)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        m = jnp.maximum(m, -1e30)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v32) / jnp.maximum(l, 1e-30)
+        lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+        return o, lse
+
+    def scan_fn(_, xs):
+        i, qb = xs
+        return None, one_block(i, qb)
+
+    _, (o, lse) = lax.scan(
+        scan_fn, None, (jnp.arange(nq), jnp.moveaxis(qf, 2, 0)))
+    o = jnp.moveaxis(o, 0, 2).reshape(B, H, nq * q_block, D)
+    lse = jnp.moveaxis(lse, 0, 2).reshape(B, H, nq * q_block)
+    if pad_q:
+        o, lse = o[:, :, :Lq], lse[:, :, :Lq]
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
+    """Flash forward on TPU.  Grid (batch·heads, q_blocks, k_blocks) with
+    the k axis innermost: VMEM holds one q/k/v block at a time (O(block·D)
+    VMEM — long sequences stream from HBM) while running max / sum / output
+    accumulators live in VMEM scratch across the k sweep.  head_dim is
+    padded to the 128-lane width so every model head size hits the MXU."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, L, D0 = q.shape
+    Lk = k.shape[2]
+    D = max(128, -(-D0 // 128) * 128)
+    if D != D0:
+        padd = ((0, 0), (0, 0), (0, 0), (0, D - D0))
+        q = jnp.pad(q, padd)
+        k = jnp.pad(k, padd)
+        v = jnp.pad(v, padd)
+    nq = L // block_q
+    nk = Lk // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_s[:] = jnp.full_like(m_s, _NEG_INF)
+            l_s[:] = jnp.zeros_like(l_s)
+            acc_s[:] = jnp.zeros_like(acc_s)
+
+        run = True
+        if causal:
+            # skip fully-masked blocks above the diagonal
+            run = (qi + 1) * block_q > kj * block_k
+
+        @pl.when(run if causal else True)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                qpos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, 1), 0)
+                kpos = kj * block_k + lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            m_prev = m_s[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            m_s[:] = m_new
+            l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(kj == nk - 1)
+        def _finalize():
+            l = jnp.maximum(l_s[:], 1e-30)
+            o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
+            lse_ref[0] = (m_s[:] + jnp.log(l))[:, 0]
+
+    grid = (B * H, nq, nk)
+    qr = q.reshape(B * H, L, D)
+    kr = k.reshape(B * H, Lk, D)
+    vr = v.reshape(B * H, Lk, D)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, L), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr)
+    out = out.reshape(B, H, L, D)
+    if D != D0:
+        out = out[..., :D0]
+    return out, lse.reshape(B, H, L)
+
+
+# ---------------------------------------------------------------------------
+# custom VJP: blockwise recompute backward (flash-attention-2 scheme)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, bias, scale, causal):
+    out, _ = _flash_fwd_impl(q, k, v, bias, scale, causal)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, bias, scale, causal):
+    B, H, L, D = q.shape
+    Lk = k.shape[2]
+    if bias is None and _use_pallas() and L % 128 == 0 and Lk % 128 == 0:
+        return _pallas_fwd(q, k, v, scale, causal)
+    return _blockwise_attn(q, k, v, bias, scale, causal,
+                           q_block=min(128, max(16, L)))
+
+
+def _flash_fwd(q, k, v, bias, scale, causal):
+    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(scale, causal, res, g):
+    q, k, v, bias, out, lse = res
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    g32, o32 = g.astype(jnp.float32), out.astype(jnp.float32)
+    # delta_i = sum_d o_i * do_i  (row-wise), standard flash backward
+    delta = jnp.sum(o32 * g32, axis=-1)              # (B,H,Lq)
+
+    block = min(512, Lk)
+    nkb = -(-Lk // block)
+    padk = nkb * block - Lk
+    if padk:
+        k32 = jnp.pad(k32, ((0, 0), (0, 0), (0, padk), (0, 0)))
+        v32 = jnp.pad(v32, ((0, 0), (0, 0), (0, padk), (0, 0)))
+    qpos = lax.broadcasted_iota(jnp.int32, (Lq, 1), 0)
+
+    bias32 = None
+    if bias is not None:
+        bias32 = jnp.broadcast_to(
+            bias.astype(jnp.float32),
+            (bias.shape[0], bias.shape[1], Lq, Lk))
+        if padk:
+            bias32 = jnp.pad(bias32, ((0, 0), (0, 0), (0, 0), (0, padk)))
+
+    def body(carry, j):
+        dq_acc = carry
+        ks = lax.dynamic_slice_in_dim(k32, j * block, block, axis=2)
+        vs = lax.dynamic_slice_in_dim(v32, j * block, block, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, ks) * scale
+        if bias32 is not None:
+            s = s + lax.dynamic_slice_in_dim(bias32, j * block, block,
+                                             axis=3)
+        kpos = j * block + lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        valid = kpos < Lk
+        if causal:
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])              # (B,H,Lq,block)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, ks)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+        if bias is None:
+            dbias_blk = jnp.zeros((), jnp.float32)
+        else:
+            # d(bias) = ds / scale, summed over dims bias broadcasts on
+            db = ds / scale
+            for ax in range(3):
+                if bias.shape[ax] == 1:
+                    db = jnp.sum(db, axis=ax, keepdims=True)
+            if bias.shape[3] == 1:
+                db = jnp.sum(db, axis=3, keepdims=True)
+            dbias_blk = db
+        return dq_acc, (dk, dv, dbias_blk)
+
+    dq0 = jnp.zeros_like(q32)
+    dq, (dks, dvs, dbs) = lax.scan(body, dq0, jnp.arange(nkb))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, nkb * block, D)[:, :, :Lk]
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, nkb * block, D)[:, :, :Lk]
+    if bias is None:
+        dbias = None
+    elif bias.shape[3] == 1:
+        dbias = jnp.sum(dbs, axis=0).astype(bias.dtype)
+    else:
+        # stacked k-blocks → (b0, b1, b2, nkb*block) → trim pad
+        dbias = jnp.moveaxis(dbs, 0, 3)
+        dbias = dbias.reshape(*dbias.shape[:3], nkb * block)[..., :Lk]
+        dbias = dbias.astype(bias.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@op("flash_attention")
+def flash_attention(q, k, v, bias=None, *, scale: Optional[float] = None,
+                    causal: bool = False):
+    """Memory-efficient attention over (B, H, L, D) tensors.  ``bias`` is an
+    optional additive score bias broadcastable to (B, H, Lq, Lk) — use
+    large negative values as a padding mask (treated as constant w.r.t.
+    grad)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, bias, float(scale), bool(causal))
+
+
+# ---------------------------------------------------------------------------
+# ring attention: sequence parallelism over a mesh axis
+# ---------------------------------------------------------------------------
+
+def _ring_attn_local(q, k, v, scale, causal, axis, n_shards):
+    """Runs inside shard_map: q/k/v are the LOCAL sequence shards
+    (B, H, L/n, D).  K/V rotate around the ring; each step folds one
+    remote block into the online softmax."""
+    my = lax.axis_index(axis)
+    Lloc = q.shape[2]
+    q32 = q.astype(jnp.float32)
+    qpos = (my * Lloc + lax.broadcasted_iota(
+        jnp.int32, (Lloc, 1), 0))[None, None]       # (1,1,Lloc,1)
+
+    def step(carry, i):
+        kcur, vcur, m, l, acc = carry
+        src = (my - i) % n_shards                   # whose shard we hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       kcur.astype(jnp.float32)) * scale
+        if causal:
+            kpos = (src * Lloc + lax.broadcasted_iota(
+                jnp.int32, (1, Lloc), 1))[None, None]
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vcur.astype(jnp.float32))
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_next = lax.ppermute(kcur, axis, perm)
+        v_next = lax.ppermute(vcur, axis, perm)
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    B, H, _, D = q.shape
+    m0 = jnp.full((B, H, Lloc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lloc, 1), jnp.float32)
+    a0 = jnp.zeros((B, H, Lloc, D), jnp.float32)
+    (kf, vf, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, a0), jnp.arange(n_shards))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+@op("ring_attention", differentiable=True)
+def ring_attention(q, k, v, *, scale: Optional[float] = None,
+                   causal: bool = False, axis: str = "sp",
+                   mesh=None):
+    """Sequence-parallel attention: inputs sharded over ``axis`` on the seq
+    dim; communication is ``ppermute`` around the ring (ICI-neighbor
+    traffic only, the canonical long-context pattern)."""
+    from jax import shard_map
+    from ..parallel.mesh import default_mesh, local_mesh_axes, P
+    from jax.sharding import NamedSharding
+
+    mesh = mesh or default_mesh()
+    n = local_mesh_axes(mesh)[axis]
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    seq_sharding = NamedSharding(mesh, P(None, None, axis, None))
+    q = jax.device_put(q, seq_sharding)
+    k = jax.device_put(k, seq_sharding)
+    v = jax.device_put(v, seq_sharding)
+    fn = shard_map(
+        functools.partial(_ring_attn_local, scale=float(scale),
+                          causal=bool(causal), axis=axis, n_shards=n),
+        mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None),
+        check_vma=False)
+    return fn(q, k, v)

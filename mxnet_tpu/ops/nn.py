@@ -92,6 +92,10 @@ def softmax(data, length=None, *, axis=-1, temperature=None,
 @op("log_softmax")
 def log_softmax(data, *, axis=-1, temperature=None):
     x = data / temperature if temperature else data
+    if x.dtype in (jnp.float16, jnp.bfloat16):
+        # fp32 logits math, half-precision output (mixed-precision softmax)
+        return jax.nn.log_softmax(x.astype(jnp.float32),
+                                  axis=axis).astype(data.dtype)
     return jax.nn.log_softmax(x, axis=axis)
 
 
@@ -310,13 +314,18 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
 @op("LayerNorm")
 def LayerNorm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     """Reference anchor ``LayerNorm`` (fused CUDA kernel there; XLA fuses
-    the reduction+scale chain here)."""
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
+    the reduction+scale chain here).  Statistics always accumulate in fp32
+    — bf16 inputs keep bf16 storage but fp32 numerics (TPU mixed-precision
+    convention)."""
+    x = data.astype(jnp.float32) if data.dtype in (jnp.float16,
+                                                   jnp.bfloat16) else data
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
     inv = lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
-    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    out = ((x - mean) * inv * gamma.astype(x.dtype).reshape(shape)
+           + beta.astype(x.dtype).reshape(shape)).astype(data.dtype)
     if output_mean_var:
         return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
     return out

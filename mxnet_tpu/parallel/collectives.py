@@ -114,8 +114,10 @@ def broadcast(x, mesh: Optional[Mesh] = None, axis: str = "dp",
 
     def _bcast(v):
         idx = jax.lax.axis_index(axis)
-        keep = jnp.where(idx == root, 1.0, 0.0).astype(v.dtype)
-        return jax.lax.psum(v * keep, axis)
+        # where (not multiply): inf/NaN on non-root shards must not leak
+        # through the psum
+        contrib = jnp.where(idx == root, v, jnp.zeros_like(v))
+        return jax.lax.psum(contrib, axis)
 
     fn = shard_map(_bcast, mesh=mesh, in_specs=P(axis), out_specs=P())
     return _wrap_like(fn(data), x)

@@ -11,17 +11,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The sandbox injects a TPU-tunnel PJRT plugin ("axon") via sitecustomize,
-# which runs before this conftest and registers backend factories whose
-# first initialization dials the tunnel (can hang for minutes).  Tests run
-# on the virtual CPU mesh, so drop every non-cpu factory before any jax
-# backend is initialized.
+# which runs before this conftest and imports jax with JAX_PLATFORMS=axon in
+# the env; first axon-backend initialization dials the tunnel (can hang for
+# minutes).  Overriding the config snapshot (not just the env var) makes
+# backends() initialize only cpu, so the tunnel is never dialed.  The axon
+# factory stays registered — harmless, and removing it would unregister the
+# "tpu" platform name that Pallas interpret-mode lowering relies on.
 import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
 
-for _plat in [p for p in _xb._backend_factories if p != "cpu"]:
-    _xb._backend_factories.pop(_plat, None)
-# sitecustomize imported jax with JAX_PLATFORMS=axon already in the env, so
-# the config snapshot must be overridden as well as the env var.
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as onp

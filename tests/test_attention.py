@@ -1,0 +1,184 @@
+"""Flash / ring attention numerics vs the naive O(L²) softmax reference
+(the reference framework's vanilla attention path, SURVEY.md §5.7)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _naive(q, k, v, causal=False, scale=None):
+    import jax.numpy as jnp
+    scale = scale or 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Lq, Lk = s.shape[-2], s.shape[-1]
+        mask = onp.tril(onp.ones((Lq, Lk), bool))
+        s = jnp.where(jnp.asarray(mask), s, -1e30)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def _rand(*shape):
+    return onp.random.RandomState(0).randn(*shape).astype("float32")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_naive(causal):
+    q, k, v = (_rand(2, 3, 64, 16) for _ in range(3))
+    out = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k),
+                                mx.nd.array(v), causal=causal)
+    ref = _naive(q, k, v, causal=causal)
+    onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_long_seq_blocks():
+    # seq > q_block so the scan path actually tiles
+    q, k, v = (_rand(1, 2, 300, 8) for _ in range(3))
+    out = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k),
+                                mx.nd.array(v), causal=True)
+    ref = _naive(q, k, v, causal=True)
+    onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
+                                rtol=3e-5, atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_naive(causal):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _flash
+
+    q, k, v = (_rand(1, 2, 48, 8) for _ in range(3))
+
+    def f_flash(q, k, v):
+        return jnp.sum(_flash(q, k, v, None, 0.125, causal) ** 2)
+
+    def f_naive(q, k, v):
+        return jnp.sum(_naive(q, k, v, causal=causal, scale=0.125) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_flash_autograd_through_tape():
+    q = mx.nd.array(_rand(1, 2, 32, 8))
+    k = mx.nd.array(_rand(1, 2, 32, 8))
+    v = mx.nd.array(_rand(1, 2, 32, 8))
+    for x in (q, k, v):
+        x.attach_grad()
+    with autograd.record():
+        out = mx.nd.flash_attention(q, k, v, causal=True)
+        loss = (out * out).sum()
+    loss.backward()
+    assert q.grad is not None and onp.isfinite(q.grad.asnumpy()).all()
+    assert onp.abs(v.grad.asnumpy()).sum() > 0
+
+
+def test_pallas_kernel_interpret_mode():
+    """Run the actual Pallas kernel through the interpreter on CPU and
+    check numerics (128-aligned shapes as on real TPU)."""
+    from mxnet_tpu.ops import attention as attn
+
+    q, k, v = (_rand(1, 1, 128, 128) for _ in range(3))
+    os.environ["MXNET_FLASH_INTERPRET"] = "1"
+    try:
+        out, lse = attn._pallas_fwd(q, k, v, 0.08838834765, True)
+    finally:
+        del os.environ["MXNET_FLASH_INTERPRET"]
+    ref = _naive(q, k, v, causal=True, scale=0.08838834765)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+    assert onp.isfinite(onp.asarray(lse)).all()
+
+
+def test_flash_padding_mask_bias():
+    import jax.numpy as jnp
+    q, k, v = (_rand(2, 2, 32, 8) for _ in range(3))
+    valid = 20  # keys >= valid are masked out
+    bias = onp.zeros((2, 1, 32, 32), "float32")
+    bias[:, :, :, valid:] = -1e30
+    out = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k),
+                                mx.nd.array(v), mx.nd.array(bias))
+    ref = _naive(q, k[:, :, :valid], v[:, :, :valid])
+    onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bias_grad_matches_naive():
+    """A learned (e.g. ALiBi-style) bias must receive real gradients."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _flash
+
+    q, k, v = (_rand(2, 2, 24, 8) for _ in range(3))
+    bias = (_rand(2, 1, 24, 24) * 0.1).astype("float32")
+
+    def f_flash(bias):
+        return jnp.sum(_flash(q, k, v, bias, 0.3, False) ** 2)
+
+    def f_naive(bias):
+        import jax.numpy as jnp
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.3 + bias
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v) ** 2)
+
+    g1 = jax.grad(f_flash)(bias)
+    g2 = jax.grad(f_naive)(bias)
+    onp.testing.assert_allclose(onp.asarray(g1), onp.asarray(g2),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_kernel_interpret_head_dim_64():
+    """head_dim 64 (every shipped model) must reach the kernel via lane
+    padding."""
+    from mxnet_tpu.ops import attention as attn
+
+    q, k, v = (_rand(1, 2, 256, 64) for _ in range(3))
+    os.environ["MXNET_FLASH_INTERPRET"] = "1"
+    try:
+        out, lse = attn._pallas_fwd(q, k, v, 0.125, True)
+    finally:
+        del os.environ["MXNET_FLASH_INTERPRET"]
+    assert out.shape == (1, 2, 256, 64)
+    ref = _naive(q, k, v, causal=True, scale=0.125)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_transformer_forward():
+    from mxnet_tpu.models import GPT, GPTConfig
+    mx.random.seed(0)
+    net = GPT(GPTConfig(vocab_size=97, max_length=32, num_layers=1,
+                        units=32, num_heads=4, hidden_size=64,
+                        dtype="bfloat16"))
+    net.initialize()
+    # every Dense/Embedding param must actually be bf16
+    import jax.numpy as jnp
+    dts = {n: p.data().dtype for n, p in net.collect_params().items()}
+    assert all(onp.dtype(dt) == onp.dtype(jnp.bfloat16) for n, dt in
+               dts.items() if "weight" in n or "bias" in n), dts
+    toks = onp.random.RandomState(0).randint(0, 97, size=(2, 16))
+    out = net(mx.nd.array(toks))
+    assert onp.isfinite(out.asnumpy().astype("float32")).all()
+
+
+def test_ring_attention_matches_full():
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh({"sp": 8})
+    q, k, v = (_rand(1, 2, 64, 8) for _ in range(3))
+    for causal in (False, True):
+        out = mx.nd.ring_attention(mx.nd.array(q), mx.nd.array(k),
+                                   mx.nd.array(v), causal=causal,
+                                   axis="sp", mesh=mesh)
+        ref = _naive(q, k, v, causal=causal)
+        onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
+                                    rtol=2e-5, atol=2e-5)

@@ -99,7 +99,7 @@ def test_spmd_trainer_dp_trains():
     X = onp.random.randn(64, 16).astype("float32")
     W = onp.random.randn(16, 8).astype("float32")
     y = (X @ W).argmax(1)
-    losses = [float(tr.step(mx.nd.array(X), mx.nd.array(y)).asnumpy())
+    losses = [float(tr.step(mx.nd.array(X), mx.nd.array(y)).asnumpy().item())
               for _ in range(30)]
     assert losses[-1] < losses[0] * 0.5, losses
 
@@ -120,7 +120,7 @@ def test_spmd_trainer_tp_matches_replicated():
         onp.random.seed(1)
         X = onp.random.randn(16, 16).astype("float32")
         y = onp.random.randint(0, 8, size=16)
-        return [float(tr.step(mx.nd.array(X), mx.nd.array(y)).asnumpy())
+        return [tr.step(mx.nd.array(X), mx.nd.array(y)).asnumpy().item()
                 for _ in range(5)]
 
     tp_rules = parallel.ShardingRules([(r".*weight", P("tp", None))])
