@@ -1,0 +1,18 @@
+"""Gluon RNN package.
+
+Reference surface: ``python/mxnet/gluon/rnn/`` (SURVEY.md §3.2 "Gluon
+layers" rnn row): fused ``RNN/LSTM/GRU`` layers backed by the cuDNN RNN op
+plus unrolled cells (``LSTMCell``/``GRUCell``/wrappers).
+
+TPU-native: the "fused" layers are one ``lax.scan`` over time compiled by
+XLA (the cuDNN analog — one kernel for the whole sequence), cells are pure
+step functions, and both share the same math so ``unroll`` == fused.
+"""
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ResidualCell, ZoneoutCell)
+
+__all__ = ["RNN", "LSTM", "GRU", "RecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ResidualCell", "ZoneoutCell"]

@@ -1,0 +1,117 @@
+"""Fused RNN op: the whole multi-layer (bi)directional recurrence as ONE
+op — a ``lax.scan`` over time per layer/direction, compiled by XLA.
+
+Reference counterpart: the fused ``RNN`` operator
+(``src/operator/nn/rnn*``, SURVEY.md §3.1 "Operator corpus" nn family:
+"fused RNN op [cuDNN LSTM/GRU + native CPU]").  Gate orders follow the
+reference: LSTM gates (i, f, g, o) — so ``LSTMBias``'s forget chunk is
+[H:2H] — and GRU gates (r, z, n) with the reference's
+``n = tanh(i2h_n + r * h2h_n)`` formulation.
+
+Weight layout per (layer, direction), matching the layer's parameter
+order: i2h_weight (G·H, in), h2h_weight (G·H, H), i2h_bias, h2h_bias.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+__all__ = ["fused_rnn"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_step(mode, x_t, h, c, wi, wh, bi, bh):
+    """One time step.  x_t (N, in), h/c (N, H).  Returns (out, h, c)."""
+    gx = x_t @ wi.T + bi
+    gh = h @ wh.T + bh
+    H = h.shape[-1]
+    if mode == "rnn_relu":
+        h = jax.nn.relu(gx + gh)
+        return h, h, c
+    if mode == "rnn_tanh":
+        h = jnp.tanh(gx + gh)
+        return h, h, c
+    if mode == "lstm":
+        g = gx + gh
+        i = jax.nn.sigmoid(g[:, :H])
+        f = jax.nn.sigmoid(g[:, H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:])
+        c = f * c + i * gg
+        h = o * jnp.tanh(c)
+        return h, h, c
+    if mode == "gru":
+        r = jax.nn.sigmoid(gx[:, :H] + gh[:, :H])
+        z = jax.nn.sigmoid(gx[:, H:2 * H] + gh[:, H:2 * H])
+        n = jnp.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        h = (1 - z) * n + z * h
+        return h, h, c
+    raise ValueError(f"unknown rnn mode {mode}")
+
+
+def _scan_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse):
+    """x (T, N, in) → (out (T, N, H), h_n, c_n)."""
+
+    def step(carry, x_t):
+        h, c = carry
+        out, h, c = _rnn_step(mode, x_t, h, c, wi, wh, bi, bh)
+        return (h, c), out
+
+    (h_n, c_n), out = lax.scan(step, (h0, c0), x, reverse=reverse)
+    return out, h_n, c_n
+
+
+@op("fused_rnn", variadic=True)
+def fused_rnn(arrays, *, mode="lstm", num_layers=1, bidirectional=False,
+              state_size=0, dropout=0.0, training=False, layout="TNC"):
+    """arrays = [x, h0, (c0 if lstm), then per (layer, direction):
+    i2h_weight, h2h_weight, i2h_bias, h2h_bias].
+
+    x is (T, N, in) for layout TNC or (N, T, in) for NTC; h0/c0 are
+    (num_layers·dirs, N, H).  Returns (out, h_n[, c_n])."""
+    ndir = 2 if bidirectional else 1
+    x = arrays[0]
+    if layout == "NTC":
+        x = jnp.swapaxes(x, 0, 1)
+    has_c = mode == "lstm"
+    h0 = arrays[1]
+    c0 = arrays[2] if has_c else None
+    weights = arrays[3 if has_c else 2:]
+    assert len(weights) == 4 * num_layers * ndir, (
+        f"expected {4 * num_layers * ndir} weight arrays, got "
+        f"{len(weights)}")
+
+    H = state_size
+    inp = x
+    h_states, c_states = [], []
+    key = None
+    for l in range(num_layers):
+        outs = []
+        for d in range(ndir):
+            idx = l * ndir + d
+            wi, wh, bi, bh = weights[4 * idx:4 * idx + 4]
+            h_init = h0[idx]
+            c_init = c0[idx] if has_c else jnp.zeros_like(h_init)
+            out, h_n, c_n = _scan_direction(
+                mode, inp, h_init, c_init, wi, wh, bi, bh,
+                reverse=(d == 1))
+            outs.append(out)
+            h_states.append(h_n)
+            c_states.append(c_n)
+        inp = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+        if dropout and training and l < num_layers - 1:
+            from .. import random as mxrandom
+            key = mxrandom.next_traced_key() if key is None else \
+                jax.random.split(key)[0]
+            keep = jax.random.bernoulli(key, 1 - dropout, inp.shape)
+            inp = jnp.where(keep, inp / (1 - dropout), 0).astype(inp.dtype)
+
+    out = inp if layout == "TNC" else jnp.swapaxes(inp, 0, 1)
+    h_n = jnp.stack(h_states)
+    if has_c:
+        return out, h_n, jnp.stack(c_states)
+    return out, h_n
