@@ -65,14 +65,17 @@ def main():
     data = mx.nd.array(toks)
     label = mx.nd.array(labels)
 
-    # warmup (compile) + steady-state timing
+    # warmup (compile) + steady-state timing.  NOTE: timing must end with a
+    # device->host readback (asnumpy) — on remote-tunneled TPU backends
+    # block_until_ready returns before execution finishes, so a readback is
+    # the only reliable synchronization point.
     for _ in range(3):
-        trainer.step(data, label).wait_to_read()
-    n_steps = 10
+        float(onp.asarray(trainer.step(data, label).asnumpy()).reshape(()))
+    n_steps = 20 if on_tpu else 5
     t0 = time.perf_counter()
     for _ in range(n_steps):
         loss = trainer.step(data, label)
-    loss.wait_to_read()
+    float(onp.asarray(loss.asnumpy()).reshape(()))
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * n_steps / dt / max(

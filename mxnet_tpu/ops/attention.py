@@ -131,6 +131,10 @@ def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
     nq = L // block_q
     nk = Lk // block_k
 
+    # m/l scratch live at full 128-lane width (the value broadcast across
+    # lanes) — TPU vregs are (8, 128); a lane-1 scratch would not tile.
+    LANES = 128
+
     def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
         qi = pl.program_id(1)
         kj = pl.program_id(2)
@@ -161,20 +165,23 @@ def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
                     jnp.int32, (1, block_k), 1)
                 s = jnp.where(qpos >= kpos, s, _NEG_INF)
             m_prev = m_s[:]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            m_new = jnp.maximum(
+                m_prev, jnp.broadcast_to(
+                    jnp.max(s, axis=-1, keepdims=True), (block_q, LANES)))
             alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new)
+            p = jnp.exp(s - m_new[:, :1])
             m_s[:] = m_new
-            l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            l_s[:] = l_s[:] * alpha + jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), (block_q, LANES))
+            acc_s[:] = acc_s[:] * alpha[:, :1] + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         @pl.when(kj == nk - 1)
         def _finalize():
             l = jnp.maximum(l_s[:], 1e-30)
-            o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
-            lse_ref[0] = (m_s[:] + jnp.log(l))[:, 0]
+            o_ref[0] = (acc_s[:] / l[:, :1]).astype(o_ref.dtype)
+            lse_ref[0] = m_s[:] + jnp.log(l)
 
     grid = (B * H, nq, nk)
     qr = q.reshape(B * H, L, D)
@@ -194,16 +201,16 @@ def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, L), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, L, 128), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         interpret=_interpret(),
@@ -211,7 +218,7 @@ def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
     out = out.reshape(B, H, L, D)
     if D != D0:
         out = out[..., :D0]
-    return out, lse.reshape(B, H, L)
+    return out, lse[..., 0].reshape(B, H, L)
 
 
 # ---------------------------------------------------------------------------
