@@ -1,0 +1,156 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Reference surface: ``python/mxnet/gluon/rnn/rnn_layer.py`` (SURVEY.md §3.2
+"Gluon layers" rnn row): layers backed by the fused ``RNN`` operator
+(cuDNN LSTM/GRU + native CPU, ``src/operator/nn/rnn*``).
+
+TPU-native: the fused op is ``ops.rnn.fused_rnn`` — one ``lax.scan`` per
+(layer, direction) compiled by XLA, gate math shared with the unrolled
+cells.  Parameter names follow the reference layout
+``{l|r}{layer}_{i2h|h2h}_{weight|bias}`` so checkpoints interchange with
+cell-based models via ``LSTM(...)[l0_i2h_weight] == LSTMCell.i2h_weight``.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}; expected TNC or NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        ng = _GATES[mode]
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d, dname in enumerate(["l", "r"][:self._dir]):
+                    in_size = input_size if layer == 0 \
+                        else hidden_size * self._dir
+                    for kind, shape in (
+                            ("i2h_weight", (ng * hidden_size, in_size)),
+                            ("h2h_weight", (ng * hidden_size, hidden_size)),
+                            ("i2h_bias", (ng * hidden_size,)),
+                            ("h2h_bias", (ng * hidden_size,))):
+                        name = f"{dname}{layer}_{kind}"
+                        init = {"i2h_weight": i2h_weight_initializer,
+                                "h2h_weight": h2h_weight_initializer,
+                                "i2h_bias": i2h_bias_initializer,
+                                "h2h_bias": h2h_bias_initializer}[kind]
+                        p = self.params.get(name, shape=shape, dtype=dtype,
+                                            init=init,
+                                            allow_deferred_init=True)
+                        setattr(self, name, p)
+
+    def infer_shape(self, x, *args):
+        in_size = x.shape[-1]
+        ng = _GATES[self._mode]
+        for d in ["l", "r"][:self._dir]:
+            p = getattr(self, f"{d}0_i2h_weight")
+            if p.shape[-1] == 0:
+                p.shape = (ng * self._hidden_size, in_size)
+
+    def state_info(self, batch_size=0):
+        n = self._num_layers * self._dir
+        if self._mode == "lstm":
+            return [{"shape": (n, batch_size, self._hidden_size)},
+                    {"shape": (n, batch_size, self._hidden_size)}]
+        return [{"shape": (n, batch_size, self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        if func is None:
+            func = F.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def __call__(self, x, states=None, **kwargs):
+        return super().__call__(x, *([states] if states is not None else []),
+                                **kwargs)
+
+    def forward(self, x, states=None):
+        from ... import autograd, ndarray as F
+        from ..parameter import DeferredInitializationError
+        try:
+            params = {n: p.data() for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(x)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            params = {n: p.data() for n, p in self._reg_params.items()}
+
+        batch = x.shape[self._layout.find("N")]
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(batch, dtype=x.dtype)
+        if isinstance(states, F.NDArray):
+            states = [states]
+
+        arrays = [x] + list(states)
+        for layer in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                for kind in ("i2h_weight", "h2h_weight", "i2h_bias",
+                             "h2h_bias"):
+                    arrays.append(params[f"{d}{layer}_{kind}"])
+
+        out = F.fused_rnn(
+            arrays, mode=self._mode, num_layers=self._num_layers,
+            bidirectional=self._dir == 2, dropout=self._dropout,
+            training=autograd.is_training(), layout=self._layout)
+        if self._mode == "lstm":
+            output, h_n, c_n = out
+            new_states = [h_n, c_n]
+        else:
+            output, h_n = out
+            new_states = [h_n]
+        if return_states:
+            return output, new_states
+        return output
+
+    def hybrid_forward(self, F, x, *args, **params):
+        return self.forward(x, *args)
+
+    def __repr__(self):
+        s = (f"{type(self).__name__}({self._hidden_size}, "
+             f"num_layers={self._num_layers}, layout={self._layout}"
+             f"{', bidirectional' if self._dir == 2 else ''})")
+        return s
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu or tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference fused ``RNN`` op, mode='lstm')."""
+
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference fused ``RNN`` op, mode='gru')."""
+
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

@@ -66,8 +66,8 @@ def _scan_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse):
 
 
 @op("fused_rnn", variadic=True)
-def fused_rnn(arrays, *, mode="lstm", num_layers=1, bidirectional=False,
-              state_size=0, dropout=0.0, training=False, layout="TNC"):
+def fused_rnn(*arrays, mode="lstm", num_layers=1, bidirectional=False,
+              dropout=0.0, training=False, layout="TNC"):
     """arrays = [x, h0, (c0 if lstm), then per (layer, direction):
     i2h_weight, h2h_weight, i2h_bias, h2h_bias].
 
@@ -85,10 +85,8 @@ def fused_rnn(arrays, *, mode="lstm", num_layers=1, bidirectional=False,
         f"expected {4 * num_layers * ndir} weight arrays, got "
         f"{len(weights)}")
 
-    H = state_size
     inp = x
     h_states, c_states = [], []
-    key = None
     for l in range(num_layers):
         outs = []
         for d in range(ndir):
@@ -105,9 +103,8 @@ def fused_rnn(arrays, *, mode="lstm", num_layers=1, bidirectional=False,
         inp = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
         if dropout and training and l < num_layers - 1:
             from .. import random as mxrandom
-            key = mxrandom.next_traced_key() if key is None else \
-                jax.random.split(key)[0]
-            keep = jax.random.bernoulli(key, 1 - dropout, inp.shape)
+            keep = jax.random.bernoulli(mxrandom.next_key(), 1 - dropout,
+                                        inp.shape)
             inp = jnp.where(keep, inp / (1 - dropout), 0).astype(inp.dtype)
 
     out = inp if layout == "TNC" else jnp.swapaxes(inp, 0, 1)

@@ -1,0 +1,151 @@
+"""RNN layers and cells (reference test model: tests/python/unittest/
+test_gluon_rnn.py — golden/consistency checks between fused layers and
+unrolled cells)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+@pytest.mark.parametrize("mode,cls,cell_cls", [
+    ("lstm", rnn.LSTM, rnn.LSTMCell),
+    ("gru", rnn.GRU, rnn.GRUCell),
+    ("rnn_relu", rnn.RNN, rnn.RNNCell),
+])
+def test_layer_matches_cell(mode, cls, cell_cls):
+    """The fused layer and the unrolled cell share math and parameters."""
+    mx.random.seed(0)
+    T, N, C, H = 5, 3, 4, 6
+    x = mx.nd.array(onp.random.RandomState(0).randn(T, N, C))
+
+    layer = cls(H, input_size=C) if mode != "rnn_relu" else \
+        rnn.RNN(H, activation="relu", input_size=C)
+    layer.initialize(mx.init.Xavier())
+    out = layer(x)
+    assert out.shape == (T, N, H)
+
+    cell = cell_cls(H, input_size=C) if mode != "rnn_relu" else \
+        rnn.RNNCell(H, activation="relu", input_size=C)
+    cell.initialize()
+    # copy layer params into the cell
+    lp = {p.name.split("_", 1)[1] if "_l0_" not in p.name else p.name:
+          p for p in layer.collect_params().values()}
+    mapping = {}
+    for name, p in layer.collect_params().items():
+        short = name[name.index("l0_") + 3:] if "l0_" in name else name
+        mapping[short] = p
+    for name, p in cell.collect_params().items():
+        for k in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+            if name.endswith(k):
+                p.set_data(mapping[k].data())
+    outs, states = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    onp.testing.assert_allclose(_np(out), _np(outs), rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_states_and_grad():
+    T, N, C, H = 4, 2, 3, 5
+    lstm = rnn.LSTM(H, num_layers=2, input_size=C)
+    lstm.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(1).randn(T, N, C))
+    begin = lstm.begin_state(N)
+    with autograd.record():
+        out, states = lstm(x, begin)
+        loss = (out ** 2).sum()
+    loss.backward()
+    assert out.shape == (T, N, H)
+    assert states[0].shape == (2, N, H)
+    assert states[1].shape == (2, N, H)
+    g = lstm.collect_params()[lstm.prefix + "l0_i2h_weight"].grad()
+    assert float(g.abs().sum().asnumpy()) > 0
+
+
+def test_bidirectional_lstm_shape():
+    T, N, C, H = 4, 2, 3, 5
+    lstm = rnn.LSTM(H, bidirectional=True, input_size=C)
+    lstm.initialize()
+    out = lstm(mx.nd.array(onp.random.randn(T, N, C)))
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_ntc_layout():
+    N, T, C, H = 2, 6, 3, 4
+    gru = rnn.GRU(H, layout="NTC", input_size=C)
+    gru.initialize()
+    out = gru(mx.nd.array(onp.random.randn(N, T, C)))
+    assert out.shape == (N, T, H)
+
+
+def test_deferred_input_size():
+    lstm = rnn.LSTM(4)
+    lstm.initialize()
+    out = lstm(mx.nd.array(onp.random.randn(3, 2, 7)))
+    assert out.shape == (3, 2, 4)
+    assert lstm.l0_i2h_weight.shape == (16, 7)
+
+
+def test_hybridized_rnn():
+    lstm = rnn.LSTM(4, input_size=3)
+    lstm.initialize()
+    x = mx.nd.array(onp.random.RandomState(2).randn(5, 2, 3))
+    ref = lstm(x).asnumpy()
+    lstm.hybridize()
+    out = lstm(x).asnumpy()
+    onp.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-5)
+
+
+def test_sequential_and_wrappers():
+    T, N, C, H = 5, 2, 4, 4
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, input_size=C))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(H, input_size=H)))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.initialize()
+    x = mx.nd.array(onp.random.randn(T, N, C))
+    outs, states = stack.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, H)
+    # LSTM contributes (h, c) per cell; dropout none
+    assert len(states) == 4
+
+
+def test_bidirectional_cell():
+    T, N, C, H = 4, 2, 3, 5
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(H, input_size=C),
+                               rnn.LSTMCell(H, input_size=C))
+    bi.initialize()
+    x = mx.nd.array(onp.random.randn(T, N, C))
+    outs, states = bi.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (T, N, 2 * H)
+
+
+def test_rnn_dropout_multilayer():
+    lstm = rnn.LSTM(4, num_layers=3, dropout=0.5, input_size=3)
+    lstm.initialize()
+    x = mx.nd.array(onp.random.randn(5, 2, 3))
+    with autograd.record(train_mode=True):
+        out = lstm(x)
+    assert out.shape == (5, 2, 4)
+    # eval mode: no dropout, deterministic
+    a = lstm(x).asnumpy()
+    b = lstm(x).asnumpy()
+    onp.testing.assert_allclose(a, b)
+
+
+def test_unroll_valid_length():
+    T, N, C, H = 6, 3, 2, 4
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    x = mx.nd.array(onp.random.randn(N, T, C))
+    vl = mx.nd.array([2, 4, 6])
+    outs, states = cell.unroll(T, x, layout="NTC", merge_outputs=True,
+                               valid_length=vl)
+    o = outs.asnumpy()
+    # outputs past valid_length are zeroed
+    assert abs(o[0, 2:]).max() == 0
+    assert abs(o[1, 4:]).max() == 0
+    assert abs(o[0, :2]).max() > 0
