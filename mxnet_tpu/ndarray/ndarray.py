@@ -589,7 +589,9 @@ def _coerce(x, like: "NDArray"):
 
 
 def _wrap_like(data, ref: Optional[NDArray]) -> NDArray:
-    return NDArray(data, ref._ctx if ref is not None else None)
+    # honor the ref's class so mx.np arrays propagate through every op
+    cls = type(ref) if ref is not None else NDArray
+    return cls(data, ref._ctx if ref is not None else None)
 
 
 # ---------------------------------------------------------------------- #

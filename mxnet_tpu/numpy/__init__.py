@@ -1,0 +1,5 @@
+"""``mx.np`` package (reference ``python/mxnet/numpy/``)."""
+from .multiarray import *  # noqa: F401,F403
+from .multiarray import (ndarray, array, _coerce_arr, _run)  # noqa: F401
+from . import linalg  # noqa: F401
+from . import random  # noqa: F401

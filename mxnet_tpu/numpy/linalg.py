@@ -1,0 +1,111 @@
+"""``mx.np.linalg`` (reference ``python/mxnet/numpy/linalg.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .multiarray import _run, ndarray, _coerce_arr
+
+__all__ = ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
+           "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
+           "matrix_rank", "matrix_power", "multi_dot", "tensorinv",
+           "tensorsolve"]
+
+
+def norm(x, ord=None, axis=None, keepdims=False):  # noqa: A002
+    return _run("linalg_norm", lambda a: jnp.linalg.norm(
+        a, ord=ord, axis=tuple(axis) if isinstance(axis, list) else axis,
+        keepdims=keepdims), [x])
+
+
+def svd(a, full_matrices=False, compute_uv=True):
+    arr = _coerce_arr(a)
+    r = jnp.linalg.svd(arr._data, full_matrices=full_matrices,
+                       compute_uv=compute_uv)
+    if compute_uv:
+        return ndarray(r[0]), ndarray(r[1]), ndarray(r[2])
+    return ndarray(r)
+
+
+def cholesky(a):
+    return _run("linalg_cholesky", jnp.linalg.cholesky, [a])
+
+
+def qr(a, mode="reduced"):
+    arr = _coerce_arr(a)
+    q, r = jnp.linalg.qr(arr._data, mode=mode)
+    return ndarray(q), ndarray(r)
+
+
+def inv(a):
+    return _run("linalg_inv", jnp.linalg.inv, [a])
+
+
+def pinv(a, rcond=None):
+    return _run("linalg_pinv", lambda x: jnp.linalg.pinv(x, rcond=rcond),
+                [a])
+
+
+def det(a):
+    return _run("linalg_det", jnp.linalg.det, [a])
+
+
+def slogdet(a):
+    arr = _coerce_arr(a)
+    sign, logdet = jnp.linalg.slogdet(arr._data)
+    return ndarray(sign), ndarray(logdet)
+
+
+def solve(a, b):
+    return _run("linalg_solve", jnp.linalg.solve, [a, b])
+
+
+def lstsq(a, b, rcond=None):
+    arr, brr = _coerce_arr(a), _coerce_arr(b)
+    x, res, rank, sv = jnp.linalg.lstsq(arr._data, brr._data, rcond=rcond)
+    return ndarray(x), ndarray(res), int(rank), ndarray(sv)
+
+
+def eig(a):
+    arr = _coerce_arr(a)
+    w, v = jnp.linalg.eig(arr._data)
+    return ndarray(w), ndarray(v)
+
+
+def eigh(a, UPLO="L"):
+    arr = _coerce_arr(a)
+    w, v = jnp.linalg.eigh(arr._data, UPLO=UPLO)
+    return ndarray(w), ndarray(v)
+
+
+def eigvals(a):
+    return _run("linalg_eigvals", jnp.linalg.eigvals, [a])
+
+
+def eigvalsh(a, UPLO="L"):
+    return _run("linalg_eigvalsh",
+                lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), [a])
+
+
+def matrix_rank(a, tol=None):
+    return _run("linalg_matrix_rank",
+                lambda x: jnp.linalg.matrix_rank(x, tol=tol), [a])
+
+
+def matrix_power(a, n):
+    return _run("linalg_matrix_power",
+                lambda x: jnp.linalg.matrix_power(x, n), [a])
+
+
+def multi_dot(arrays):
+    return _run("linalg_multi_dot", lambda *xs: jnp.linalg.multi_dot(xs),
+                list(arrays))
+
+
+def tensorinv(a, ind=2):
+    return _run("linalg_tensorinv",
+                lambda x: jnp.linalg.tensorinv(x, ind=ind), [a])
+
+
+def tensorsolve(a, b, axes=None):
+    return _run("linalg_tensorsolve",
+                lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes), [a, b])

@@ -1,0 +1,159 @@
+"""``mx.np.random`` (reference ``python/mxnet/numpy/random.py``) — NumPy
+random API over the framework's functional key stream (mx.random)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _base
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import Op, invoke
+from .multiarray import ndarray, _coerce_arr
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "permutation", "gamma", "beta",
+           "exponential", "poisson", "multinomial", "binomial",
+           "lognormal", "laplace", "gumbel", "logistic", "chisquare",
+           "standard_normal", "multivariate_normal"]
+
+seed = _base.seed
+
+
+def _sample(name, fn, extra=()):
+    key = _base.next_key()
+    o = Op(name=f"_npr_{name}", fn=fn, differentiable=False)
+    out = invoke(o, [ndarray(key)] + [(_coerce_arr(e)) for e in extra], {})
+    return out
+
+
+def _shp(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    return _sample("uniform", lambda k: jax.random.uniform(
+        k, _shp(size), jnp.dtype(dtype or "float32"), low, high))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return _sample("normal", lambda k: jax.random.normal(
+        k, _shp(size), jnp.dtype(dtype or "float32")) * scale + loc)
+
+
+def standard_normal(size=None, dtype=None):
+    return normal(0.0, 1.0, size, dtype)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size or None)
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size or None)
+
+
+def randint(low, high=None, size=None, dtype=None):
+    if high is None:
+        low, high = 0, low
+    return _sample("randint", lambda k: jax.random.randint(
+        k, _shp(size), low, high, jnp.dtype(dtype or "int32")))
+
+
+def choice(a, size=None, replace=True, p=None):
+    def fn(k, *arrs):
+        arr = arrs[0] if arrs else jnp.arange(a)
+        prob = arrs[1] if len(arrs) > 1 else None
+        return jax.random.choice(k, arr, _shp(size), replace, prob)
+    extra = []
+    if not isinstance(a, int):
+        extra.append(a)
+        if p is not None:
+            extra.append(p)
+    elif p is not None:
+        extra = [jnp.arange(a), p]
+
+        def fn(k, arr, prob):  # noqa: F811
+            return jax.random.choice(k, arr, _shp(size), replace, prob)
+    return _sample("choice", fn, extra)
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return _sample("permutation",
+                       lambda k: jax.random.permutation(k, x))
+    return _sample("permutation",
+                   lambda k, a: jax.random.permutation(k, a), [x])
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference semantics)."""
+    r = permutation(x)
+    x._rebind(r._data)
+    return None
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+    return _sample("gamma", lambda k: jax.random.gamma(
+        k, shape, _shp(size) if size is not None else None) * scale)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    return _sample("beta", lambda k: jax.random.beta(
+        k, a, b, _shp(size) if size is not None else None))
+
+
+def exponential(scale=1.0, size=None):
+    return _sample("exponential", lambda k: jax.random.exponential(
+        k, _shp(size)) * scale)
+
+
+def poisson(lam=1.0, size=None):
+    return _sample("poisson", lambda k: jax.random.poisson(k, lam,
+                                                           _shp(size)))
+
+
+def multinomial(n, pvals, size=None):
+    def fn(k, p):
+        shape = _shp(size) if size is not None else ()
+        return jax.random.multinomial(k, n, p, shape=shape + p.shape[:-1]
+                                      if shape else None)
+    return _sample("multinomial", fn, [pvals])
+
+
+def binomial(n, p, size=None):
+    return _sample("binomial", lambda k: jax.random.binomial(
+        k, n, p, shape=_shp(size) if size is not None else None))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None):
+    return _sample("lognormal", lambda k: jnp.exp(
+        jax.random.normal(k, _shp(size)) * sigma + mean))
+
+
+def laplace(loc=0.0, scale=1.0, size=None):
+    return _sample("laplace", lambda k: jax.random.laplace(
+        k, _shp(size)) * scale + loc)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None):
+    return _sample("gumbel", lambda k: jax.random.gumbel(
+        k, _shp(size)) * scale + loc)
+
+
+def logistic(loc=0.0, scale=1.0, size=None):
+    return _sample("logistic", lambda k: jax.random.logistic(
+        k, _shp(size)) * scale + loc)
+
+
+def chisquare(df, size=None):
+    return _sample("chisquare", lambda k: jax.random.chisquare(
+        k, df, shape=_shp(size) if size is not None else None))
+
+
+def multivariate_normal(mean, cov, size=None):
+    def fn(k, m, c):
+        return jax.random.multivariate_normal(
+            k, m, c, shape=_shp(size) if size is not None else None)
+    return _sample("multivariate_normal", fn, [mean, cov])

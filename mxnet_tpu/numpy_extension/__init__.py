@@ -1,0 +1,191 @@
+"""``mx.npx`` — NumPy-extension namespace (reference
+``python/mxnet/numpy_extension/`` + ``mx.npx`` op surface): neural-network
+ops that have no NumPy equivalent, plus the ``set_np``/``reset_np``
+semantics switches."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import Op, invoke
+from ..numpy.multiarray import ndarray, _coerce_arr, _run
+from ..util import (set_np, reset_np, is_np_array, is_np_shape,
+                    np_array, np_shape, use_np)  # noqa: F401
+from .. import random as _random  # noqa: F401
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "seed",
+           "relu", "sigmoid", "softmax", "log_softmax", "activation",
+           "batch_norm", "layer_norm", "fully_connected", "convolution",
+           "pooling", "dropout", "embedding", "one_hot", "pick", "topk",
+           "reshape_like", "arange_like", "gamma", "erf", "erfinv",
+           "gelu", "leaky_relu", "batch_dot", "broadcast_like",
+           "sequence_mask", "smooth_l1", "multibox_detection", "waitall"]
+
+seed = _random.seed
+
+
+def _np_out(r):
+    if isinstance(r, list):
+        return [x.as_np_ndarray() if isinstance(x, NDArray) else x
+                for x in r]
+    return r.as_np_ndarray() if isinstance(r, NDArray) else r
+
+
+def _call(opname, *args, **kwargs):
+    from .. import ndarray as F
+    fn = getattr(F, opname)
+    return _np_out(fn(*[_coerce_arr(a) for a in args], **kwargs))
+
+
+def relu(data):
+    return _call("relu", data)
+
+
+def sigmoid(data):
+    return _call("sigmoid", data)
+
+
+def gelu(data):
+    return _call("Activation", data, act_type="gelu")
+
+
+def leaky_relu(data, gamma=0.01):
+    return _call("LeakyReLU", data, act_type="leaky", slope=gamma)
+
+
+def activation(data, act_type="relu"):
+    return _call("Activation", data, act_type=act_type)
+
+
+def softmax(data, axis=-1, length=None, temperature=None):
+    kw = {"axis": axis}
+    if temperature is not None:
+        kw["temperature"] = temperature
+    if length is not None:
+        return _call("softmax", data, length, use_length=True, **kw)
+    return _call("softmax", data, **kw)
+
+
+def log_softmax(data, axis=-1):
+    return _call("log_softmax", data, axis=axis)
+
+
+def fully_connected(x, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    return _call("FullyConnected", x, weight,
+                 *([] if no_bias or bias is None else [bias]),
+                 num_hidden=num_hidden or weight.shape[0],
+                 no_bias=no_bias or bias is None, flatten=flatten)
+
+
+def convolution(data=None, weight=None, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=0, num_group=1,
+                no_bias=False, layout=None):
+    args = [data, weight] + ([] if no_bias or bias is None else [bias])
+    return _call("Convolution", *args, kernel=kernel,
+                 stride=stride or (), dilate=dilate or (), pad=pad or (),
+                 num_filter=num_filter, num_group=num_group,
+                 no_bias=no_bias or bias is None,
+                 layout=layout or "NCHW")
+
+
+def pooling(data, kernel=(2, 2), pool_type="max", stride=None, pad=None,
+            global_pool=False, **kwargs):
+    return _call("Pooling", data, kernel=kernel, pool_type=pool_type,
+                 stride=stride or (), pad=pad or (),
+                 global_pool=global_pool, **kwargs)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1):
+    return _call("BatchNorm", x, gamma, beta, running_mean, running_var,
+                 eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                 use_global_stats=use_global_stats, axis=axis)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _call("LayerNorm", data, gamma, beta, axis=axis, eps=eps)
+
+
+def dropout(data, p=0.5, axes=(), mode="training"):
+    return _call("Dropout", data, p=p, axes=axes, mode=mode)
+
+
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    return _call("Embedding", data, weight,
+                 input_dim=input_dim or weight.shape[0],
+                 output_dim=output_dim or weight.shape[1])
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _call("one_hot", data, depth=depth, on_value=on_value,
+                 off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    return _call("pick", data, index, axis=axis, keepdims=keepdims,
+                 mode=mode)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    return _call("topk", data, axis=axis, k=k, ret_typ=ret_typ,
+                 is_ascend=is_ascend)
+
+
+def reshape_like(lhs, rhs):
+    return _run("reshape_like", lambda x, y: jnp.reshape(x, y.shape),
+                [lhs, rhs])
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    def impl(x):
+        n = x.size if axis is None else x.shape[axis]
+        return start + step * jnp.arange(n, dtype=jnp.float32)
+    return _run("arange_like", impl, [data])
+
+
+def gamma(data):
+    return _run("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)), [data])
+
+
+def erf(data):
+    return _run("erf", jax.lax.erf, [data])
+
+
+def erfinv(data):
+    return _run("erfinv", jax.lax.erf_inv, [data])
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return _call("batch_dot", a, b, transpose_a=transpose_a,
+                 transpose_b=transpose_b)
+
+
+def broadcast_like(lhs, rhs):
+    return _call("broadcast_like", lhs, rhs)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    args = [data] + ([sequence_length] if sequence_length is not None else [])
+    return _call("SequenceMask", *args,
+                 use_sequence_length=use_sequence_length, value=value,
+                 axis=axis)
+
+
+def smooth_l1(data, scalar=1.0):
+    return _call("smooth_l1", data, scalar=scalar)
+
+
+def multibox_detection(*args, **kwargs):
+    raise NotImplementedError(
+        "multibox_detection (SSD inference op) is not implemented; "
+        "see mxnet_tpu.contrib for detection utilities")
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+    return _w()

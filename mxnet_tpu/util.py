@@ -1,0 +1,162 @@
+"""``mx.util`` — NumPy-semantics switches and env helpers.
+
+Reference surface: ``python/mxnet/util.py`` (SURVEY.md §3.2 "profiler /
+rtc / runtime / util": ``set_np`` shape/array semantics switches,
+``environment()`` test helper; §5.6 config mechanisms).
+
+TPU-native note: jax arrays are NumPy-semantics natively, so ``np_shape``
+(zero-size / zero-dim shape support) is always on; the switches only
+control which *array class* (`mx.nd.NDArray` vs `mx.np.ndarray`) Gluon
+blocks hand out, mirroring the reference's behavioral contract.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+
+__all__ = ["is_np_shape", "is_np_array", "set_np_shape", "set_np",
+           "reset_np", "np_shape", "np_array", "use_np", "use_np_array",
+           "use_np_shape", "environment", "getenv", "setenv",
+           "get_gpu_count", "get_gpu_memory", "default_array"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "np_shape"):
+        _state.np_shape = True   # always-on in this framework (jax native)
+        _state.np_array = False
+    return _state
+
+
+def is_np_shape():
+    """Zero-dim/zero-size shapes enabled?  Always true here (jax arrays are
+    NumPy-semantics); kept for API parity."""
+    return _st().np_shape
+
+
+def is_np_array():
+    return _st().np_array
+
+
+def set_np_shape(active):
+    st = _st()
+    prev, st.np_shape = st.np_shape, bool(active)
+    return prev
+
+
+def set_np(shape=True, array=True):
+    """``mx.npx.set_np()`` — turn on NumPy semantics (array class +
+    shapes)."""
+    st = _st()
+    st.np_shape = bool(shape)
+    st.np_array = bool(array)
+
+
+def reset_np():
+    set_np(shape=True, array=False)
+
+
+@contextlib.contextmanager
+def np_shape(active=True):
+    prev = set_np_shape(active)
+    try:
+        yield
+    finally:
+        set_np_shape(prev)
+
+
+@contextlib.contextmanager
+def np_array(active=True):
+    st = _st()
+    prev, st.np_array = st.np_array, bool(active)
+    try:
+        yield
+    finally:
+        st.np_array = prev
+
+
+def use_np_array(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_array(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np(func):
+    """Decorator: run with both np semantics active (classes too)."""
+    if isinstance(func, type):
+        return func  # classes pass through (jax arrays already np-style)
+    return use_np_array(use_np_shape(func))
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Create ndarray of the active flavor (np if ``set_np()``)."""
+    if is_np_array():
+        from .numpy import array as np_array_fn
+        return np_array_fn(source_array, dtype=dtype, ctx=ctx)
+    from .ndarray import array as nd_array_fn
+    return nd_array_fn(source_array, ctx=ctx, dtype=dtype)
+
+
+# --------------------------------------------------------------------------- #
+# environment-variable helpers (reference ``mx.util.environment`` /
+# dmlc::GetEnv pattern, SURVEY.md §5.6 — MXNET_* env overlay)
+# --------------------------------------------------------------------------- #
+
+@contextlib.contextmanager
+def environment(*args):
+    """``with environment('MXNET_X', '1'):`` or ``environment({k: v})`` —
+    scoped env-var override (None deletes)."""
+    if len(args) == 2:
+        updates = {args[0]: args[1]}
+    elif len(args) == 1 and isinstance(args[0], dict):
+        updates = args[0]
+    else:
+        raise ValueError("environment(name, value) or environment(dict)")
+    saved = {k: os.environ.get(k) for k in updates}
+    try:
+        for k, v in updates.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def getenv(name):
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    from .context import gpu_memory_info
+    return gpu_memory_info(gpu_dev_id)

@@ -1,0 +1,245 @@
+"""mx.np / mx.npx namespaces (reference test model:
+tests/python/unittest/test_numpy_op.py — NumPy-golden checks)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+npx = mx.npx
+
+
+def _chk(mx_val, np_val, rtol=1e-5, atol=1e-6):
+    onp.testing.assert_allclose(mx_val.asnumpy(), np_val, rtol=rtol,
+                                atol=atol)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert np.zeros((2, 3)).shape == (2, 3)
+        assert np.ones(4).asnumpy().sum() == 4
+        assert np.full((2,), 7.0).asnumpy().tolist() == [7.0, 7.0]
+        assert np.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+        assert np.eye(3).asnumpy().trace() == 3
+        _chk(np.linspace(0, 1, 5), onp.linspace(0, 1, 5))
+
+    def test_float64_downcast(self):
+        # reference: python floats become float32
+        a = np.array([1.5, 2.5])
+        assert a.dtype == onp.float32
+
+    def test_like(self):
+        x = np.array([[1.0, 2], [3, 4]])
+        assert np.zeros_like(x).asnumpy().sum() == 0
+        assert np.ones_like(x).asnumpy().sum() == 4
+        assert type(np.zeros_like(x)) is np.ndarray
+
+
+class TestUfuncs:
+    def test_unary_golden(self):
+        x = onp.random.RandomState(0).rand(3, 4).astype(onp.float32) + 0.1
+        mx_x = np.array(x)
+        for name in ["exp", "log", "sqrt", "square", "sin", "cos", "tanh",
+                     "floor", "ceil", "abs", "sign", "log1p", "expm1"]:
+            _chk(getattr(np, name)(mx_x), getattr(onp, name)(x), rtol=1e-4)
+
+    def test_binary_golden(self):
+        r = onp.random.RandomState(1)
+        a, b = r.rand(2, 3).astype(onp.float32), \
+            r.rand(2, 3).astype(onp.float32)
+        ma, mb = np.array(a), np.array(b)
+        for name in ["add", "subtract", "multiply", "divide", "maximum",
+                     "minimum", "power", "arctan2", "hypot"]:
+            _chk(getattr(np, name)(ma, mb), getattr(onp, name)(a, b),
+                 rtol=1e-4)
+
+    def test_scalar_broadcast(self):
+        x = np.array([1.0, 2.0])
+        assert np.add(x, 1).asnumpy().tolist() == [2.0, 3.0]
+        assert (x + 1).asnumpy().tolist() == [2.0, 3.0]
+        assert (2 * x).asnumpy().tolist() == [2.0, 4.0]
+        assert type(x + 1) is np.ndarray
+
+    def test_comparisons(self):
+        x = np.array([1.0, 2.0, 3.0])
+        assert np.less(x, 2).asnumpy().tolist() == [True, False, False]
+        assert np.equal(x, 2).asnumpy().tolist() == [False, True, False]
+
+
+class TestReductions:
+    def test_golden(self):
+        x = onp.random.RandomState(2).rand(3, 4, 5).astype(onp.float32)
+        m = np.array(x)
+        _chk(np.sum(m), x.sum(), rtol=1e-4)
+        _chk(np.sum(m, axis=1), x.sum(axis=1), rtol=1e-4)
+        _chk(np.mean(m, axis=(0, 2)), x.mean(axis=(0, 2)), rtol=1e-4)
+        _chk(np.max(m, axis=0, keepdims=True), x.max(0, keepdims=True))
+        _chk(np.std(m), x.std(), rtol=1e-3)
+        _chk(np.var(m, ddof=1), x.var(ddof=1), rtol=1e-3)
+        _chk(np.prod(m, axis=2), x.prod(axis=2), rtol=1e-3)
+        _chk(np.cumsum(m, axis=1), x.cumsum(axis=1), rtol=1e-4)
+        assert int(np.argmax(m).asnumpy()) == x.argmax()
+
+    def test_bool_reductions(self):
+        x = np.array([[1.0, 0.0], [1.0, 1.0]])
+        assert bool(np.all(x).asnumpy()) is False
+        assert bool(np.any(x).asnumpy()) is True
+        assert int(np.count_nonzero(x).asnumpy()) == 3
+
+
+class TestManipulation:
+    def test_shapes(self):
+        x = np.arange(24)
+        r = np.reshape(x, (2, 3, 4))
+        assert r.shape == (2, 3, 4)
+        assert np.transpose(r).shape == (4, 3, 2)
+        assert np.transpose(r, (1, 0, 2)).shape == (3, 2, 4)
+        assert np.swapaxes(r, 0, 2).shape == (4, 3, 2)
+        assert np.expand_dims(r, 0).shape == (1, 2, 3, 4)
+        assert np.squeeze(np.expand_dims(r, 0)).shape == (2, 3, 4)
+        assert np.broadcast_to(np.ones((1, 4)), (3, 4)).shape == (3, 4)
+
+    def test_joins(self):
+        a, b = np.ones((2, 3)), np.zeros((2, 3))
+        assert np.concatenate([a, b], axis=0).shape == (4, 3)
+        assert np.stack([a, b], axis=1).shape == (2, 2, 3)
+        assert np.vstack([a, b]).shape == (4, 3)
+        assert np.hstack([a, b]).shape == (2, 6)
+        s = np.split(np.arange(12).reshape(3, 4), 2, axis=1)
+        assert len(s) == 2 and s[0].shape == (3, 2)
+
+    def test_index_ops(self):
+        x = np.array([3.0, 1.0, 2.0])
+        assert np.sort(x).asnumpy().tolist() == [1.0, 2.0, 3.0]
+        assert np.argsort(x).asnumpy().tolist() == [1, 2, 0]
+        assert np.take(x, np.array([0, 2])).asnumpy().tolist() == [3.0, 2.0]
+        u = np.unique(np.array([1, 1, 2, 3, 3]))
+        assert u.asnumpy().tolist() == [1, 2, 3]
+        nz = np.nonzero(np.array([0, 1, 0, 2]))
+        assert nz[0].asnumpy().tolist() == [1, 3]
+
+    def test_indexing_returns_np(self):
+        x = np.arange(10).reshape(2, 5)
+        assert type(x[0]) is np.ndarray
+        assert type(x[:, 1:3]) is np.ndarray
+        assert x[1, 4].item() == 9
+        mask = x > 6
+        assert x[mask].asnumpy().tolist() == [7, 8, 9]
+
+
+class TestLinalgEinsum:
+    def test_products(self):
+        r = onp.random.RandomState(3)
+        a = r.rand(3, 4).astype(onp.float32)
+        b = r.rand(4, 5).astype(onp.float32)
+        _chk(np.dot(np.array(a), np.array(b)), a @ b, rtol=1e-4)
+        _chk(np.matmul(np.array(a), np.array(b)), a @ b, rtol=1e-4)
+        _chk(np.einsum("ij,jk->ik", np.array(a), np.array(b)), a @ b,
+             rtol=1e-4)
+        _chk(np.tensordot(np.array(a), np.array(b), axes=([1], [0])),
+             onp.tensordot(a, b, axes=([1], [0])), rtol=1e-4)
+
+    def test_linalg(self):
+        r = onp.random.RandomState(4)
+        a = r.rand(4, 4).astype(onp.float32)
+        spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+        m = np.array(spd)
+        _chk(np.linalg.inv(m), onp.linalg.inv(spd), rtol=1e-2, atol=1e-3)
+        _chk(np.linalg.cholesky(m), onp.linalg.cholesky(spd), rtol=1e-3,
+             atol=1e-4)
+        _chk(np.linalg.norm(m), onp.linalg.norm(spd), rtol=1e-4)
+        w, v = np.linalg.eigh(m)
+        _chk(w, onp.linalg.eigh(spd)[0], rtol=1e-3, atol=1e-3)
+        _chk(np.linalg.det(m), onp.linalg.det(spd), rtol=1e-3)
+        x = np.linalg.solve(m, np.ones((4,)))
+        _chk(x, onp.linalg.solve(spd, onp.ones(4)), rtol=1e-3, atol=1e-4)
+
+
+class TestAutogradThroughNp:
+    def test_backward(self):
+        a = np.array([1.0, 2.0, 3.0])
+        a.attach_grad()
+        with autograd.record():
+            loss = np.sum(np.square(a) * 3.0)
+        loss.backward()
+        assert a.grad.asnumpy().tolist() == [6.0, 12.0, 18.0]
+
+    def test_einsum_grad(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]])
+        a.attach_grad()
+        with autograd.record():
+            loss = np.einsum("ij->", np.exp(a))
+        loss.backward()
+        _chk(a.grad, onp.exp(a.asnumpy()), rtol=1e-5)
+
+    def test_mixed_nd_np(self):
+        x = np.array([1.0, 2.0])
+        nd_x = x.as_nd_ndarray()
+        assert type(nd_x) is mx.nd.NDArray
+        back = nd_x.as_np_ndarray()
+        assert type(back) is np.ndarray
+
+
+class TestRandom:
+    def test_shapes_and_seed(self):
+        mx.random.seed(42)
+        a = np.random.normal(0, 1, (3, 4))
+        mx.random.seed(42)
+        b = np.random.normal(0, 1, (3, 4))
+        onp.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+        assert np.random.uniform(size=(5,)).shape == (5,)
+        assert np.random.randint(0, 10, (2, 3)).shape == (2, 3)
+        c = np.random.choice(5, size=(10,))
+        assert c.shape == (10,) and int(c.asnumpy().max()) < 5
+        p = np.random.permutation(6)
+        assert sorted(p.asnumpy().tolist()) == [0, 1, 2, 3, 4, 5]
+
+
+class TestNpx:
+    def test_nn_ops(self):
+        x = np.array([[-1.0, 2.0, 0.5]])
+        assert npx.relu(x).asnumpy().tolist() == [[0.0, 2.0, 0.5]]
+        s = npx.softmax(x)
+        assert abs(s.asnumpy().sum() - 1) < 1e-5
+        assert type(s) is np.ndarray
+        _chk(npx.sigmoid(np.array([0.0])), onp.array([0.5]))
+        ls = npx.log_softmax(x)
+        _chk(np.exp(ls), s, rtol=1e-5)
+
+    def test_one_hot_pick_topk(self):
+        idx = np.array([0, 2])
+        oh = npx.one_hot(idx, 3)
+        assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+        data = np.array([[0.1, 0.9], [0.8, 0.2]])
+        assert npx.topk(data, k=1).asnumpy().reshape(-1).tolist() == [1, 0]
+
+    def test_set_np(self):
+        npx.set_np()
+        assert npx.is_np_array()
+        npx.reset_np()
+        assert not npx.is_np_array()
+
+    def test_layer_norm(self):
+        x = np.random.normal(0, 1, (2, 8))
+        g, b = np.ones((8,)), np.zeros((8,))
+        y = npx.layer_norm(x, g, b)
+        m = y.asnumpy().mean(axis=-1)
+        onp.testing.assert_allclose(m, onp.zeros(2), atol=1e-5)
+
+
+class TestUtil:
+    def test_environment(self):
+        import os
+        from mxnet_tpu.util import environment
+        with environment("MXNET_TEST_VAR", "1"):
+            assert os.environ["MXNET_TEST_VAR"] == "1"
+        assert "MXNET_TEST_VAR" not in os.environ
+
+    def test_features(self):
+        import mxnet_tpu.runtime as rt
+        f = rt.Features()
+        assert f.is_enabled("XLA")
+        assert f.is_enabled("SPMD")
+        with pytest.raises(RuntimeError):
+            f.is_enabled("NOT_A_FEATURE")
