@@ -1,0 +1,7 @@
+"""Vision datasets + transforms (reference
+``python/mxnet/gluon/data/vision/``)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, ImageFolderDataset,
+                       ImageListDataset)
+from . import transforms
+from . import datasets

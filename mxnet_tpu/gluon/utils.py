@@ -1,0 +1,110 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``):
+``split_data``, ``split_and_load``, ``clip_global_norm``, ``download``
+(gated: no network in this environment), ``check_sha1``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split ``data`` into ``num_slice`` slices along ``batch_axis``
+    (reference ``split_data``; feeds per-device shards for data parallel)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}; set "
+            f"even_split=False")
+    step = size // num_slice
+    if not even_split:
+        slices = []
+        for i in range(num_slice):
+            lo = i * step + min(i, size % num_slice)
+            hi = lo + step + (1 if i < size % num_slice else 0)
+            slices.append(_take_axis(data, batch_axis, lo, hi))
+        return slices
+    return [_take_axis(data, batch_axis, i * step, (i + 1) * step)
+            for i in range(num_slice)]
+
+
+def _take_axis(data, axis, lo, hi):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(lo, hi)
+    return data[tuple(idx)]
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across a context list and load each slice
+    (reference ``split_and_load``).  On a 1-element ctx list this is a
+    single ``as_in_context``."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays in place so the global L2 norm ≤ max_norm; returns the
+    norm (reference ``clip_global_norm``)."""
+    if not arrays:
+        raise MXNetError("clip_global_norm: empty array list")
+    total = None
+    for a in arrays:
+        sq = (a * a).sum()
+        total = sq if total is None else total + sq
+    norm = float(total.sqrt().asnumpy()) if hasattr(total, "sqrt") else \
+        float(onp.sqrt(float(total.asnumpy())))
+    if check_isfinite and not onp.isfinite(norm):
+        raise MXNetError(f"global norm is not finite ({norm}); gradients "
+                         f"diverged or contain nan")
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference ``gluon.utils.download``.  This environment has no network
+    egress; the function resolves to a pre-populated local cache if present
+    and otherwise raises with instructions."""
+    fname = url.split("/")[-1] if path is None or os.path.isdir(path or ".") \
+        else path
+    if path and os.path.isdir(path):
+        fname = os.path.join(path, fname)
+    elif path:
+        fname = path
+    if os.path.isfile(fname) and not overwrite and \
+            (sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        f"download({url!r}) is unavailable: this environment has no network "
+        f"egress.  Place the file at {fname!r} manually.")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(isinstance(d, int) and d > 0 for d in shape)

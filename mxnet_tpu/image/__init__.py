@@ -1,0 +1,23 @@
+"""``mx.image`` — image decode, resize/crop/color augmenters, ImageIter.
+
+Reference surface: ``python/mxnet/image/image.py`` + ``image/detection.py``
+(SURVEY.md §3.2 "io / recordio / image" row: "imdecode via C++ op, ImageIter
+python-side pipeline, detection augmenters").
+
+TPU-native stance: decode happens on the HOST (PIL-backed here; the native
+C++ pipeline covers the throughput path), augmentation math is numpy on host
+— device time is reserved for the model step, and batches land on device via
+``mx.nd.array`` once, already augmented.  This mirrors the reference, where
+decode+augment run in the C++ OMP pool and only batches reach the GPU.
+"""
+from .image import (imdecode, imdecode_np, imencode, imread, imresize,
+                    resize_short, fixed_crop, center_crop, random_crop,
+                    random_size_crop, color_normalize, HSVJitterAug,
+                    Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
+                    CenterCropAug, RandomSizedCropAug, HorizontalFlipAug,
+                    CastAug, ColorNormalizeAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, LightingAug,
+                    ColorJitterAug, CreateAugmenter, ImageIter)
+from .detection import (DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter,
+                        ImageDetIter)

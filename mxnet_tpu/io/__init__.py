@@ -1,0 +1,9 @@
+"""``mx.io`` — DataIter protocol and built-in iterators.
+
+Reference surface: ``python/mxnet/io/io.py`` (SURVEY.md §3.2 "io / recordio
+/ image" row, L6): ``DataIter``, ``DataBatch``, ``DataDesc``, ``NDArrayIter``,
+``PrefetchingIter``, ``ResizeIter``, plus the C++-backed record iterators
+(``ImageRecordIter`` here is built over the native/python RecordIO pipeline).
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter)

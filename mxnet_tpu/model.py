@@ -1,0 +1,90 @@
+"""Model checkpoint helpers shared by Module & Trainer.
+
+Reference surface: ``python/mxnet/model.py`` (SURVEY.md §3.2 "model.py
+helpers" row): ``save_checkpoint/load_checkpoint`` (``prefix-symbol.json`` +
+``prefix-%04d.params``), ``_create_kvstore``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from .base import MXNetError
+from . import ndarray as nd
+
+BatchEndParam = None  # set below
+
+
+class _BatchEndParam(tuple):
+    pass
+
+
+try:
+    from collections import namedtuple
+    BatchEndParam = namedtuple("BatchEndParam",
+                               ["epoch", "nbatch", "eval_metric", "locals"])
+except Exception:  # pragma: no cover
+    pass
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Normalize a kvstore spec into (kvstore, update_on_kvstore)
+    (reference ``_create_kvstore``)."""
+    from .kvstore import KVStore, create as kv_create
+    update_on_kvstore = bool(int(os.environ.get(
+        "MXNET_UPDATE_ON_KVSTORE", "1")))
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kv_create(kvstore)
+    else:
+        raise MXNetError(f"invalid kvstore {kvstore!r}")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save ``prefix-symbol.json`` (if a symbol is given) +
+    ``prefix-%04d.params`` (reference ``save_checkpoint``)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json",
+                    remove_amp_cast=remove_amp_cast)
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    param_name = f"{prefix}-{epoch:04d}.params"
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_params(prefix, epoch):
+    """→ (arg_params, aux_params) from ``prefix-%04d.params``."""
+    loaded = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:  # plain name->array file (gluon save_parameters)
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """→ (symbol_or_None, arg_params, aux_params) (reference
+    ``load_checkpoint``)."""
+    sym_file = f"{prefix}-symbol.json"
+    symbol = None
+    if os.path.isfile(sym_file):
+        from .symbol import load as sym_load
+        symbol = sym_load(sym_file)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

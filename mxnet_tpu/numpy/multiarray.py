@@ -161,13 +161,13 @@ def _coerce_arr(x):
 
 
 def _run(name, fn, arrays, static=None):
-    """invoke() with np-class outputs (ref chosen from array args)."""
+    """invoke() with np-class outputs.  Every legacy NDArray arg is promoted
+    to the np subclass first — invoke's ``_wrap_like`` keys the output class
+    off the first NDArray arg, so a leading legacy array must not win."""
     arrays = [_coerce_arr(a) for a in arrays]
-    ref = next((a for a in arrays if isinstance(a, ndarray)), None)
-    if ref is None:
-        # promote: outputs should still be np arrays
-        arrays = [a.as_np_ndarray() if isinstance(a, NDArray) else a
-                  for a in arrays]
+    arrays = [a.as_np_ndarray()
+              if isinstance(a, NDArray) and not isinstance(a, ndarray) else a
+              for a in arrays]
     return invoke(Op(name=f"_np_{name}", fn=fn), arrays, static or {})
 
 
@@ -626,8 +626,12 @@ def pad(array, pad_width, mode="constant", **kwargs):  # noqa: A002
 
 
 def delete(arr, obj, axis=None):
-    return _run("delete", lambda x: jnp.delete(
-        x, obj, axis=axis, assume_unique_indices=True), [arr])
+    # concretize indices so jnp.delete handles duplicates/slices correctly
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.asnumpy())
+    elif isinstance(obj, (list, tuple)):
+        obj = onp.asarray(obj)
+    return _run("delete", lambda x: jnp.delete(x, obj, axis=axis), [arr])
 
 
 def insert(arr, obj, values, axis=None):
@@ -683,8 +687,7 @@ def unravel_index(indices, shape):  # noqa: A002
 
 def ravel_multi_index(multi_index, dims, mode="raise"):
     arrs = [_coerce_arr(a)._data for a in multi_index]
-    return ndarray(jnp.ravel_multi_index(tuple(arrs), _shp(dims),
-                                         mode="clip"))
+    return ndarray(jnp.ravel_multi_index(tuple(arrs), _shp(dims), mode=mode))
 
 
 def take(a, indices, axis=None, mode="clip"):  # noqa: A002

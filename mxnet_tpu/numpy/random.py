@@ -116,9 +116,11 @@ def poisson(lam=1.0, size=None):
 
 def multinomial(n, pvals, size=None):
     def fn(k, p):
-        shape = _shp(size) if size is not None else ()
-        return jax.random.multinomial(k, n, p, shape=shape + p.shape[:-1]
-                                      if shape else None)
+        if size is None:
+            return jax.random.multinomial(k, n, p)
+        # output shape = batch dims (size) + event dim (len(pvals))
+        return jax.random.multinomial(k, n, p,
+                                      shape=_shp(size) + p.shape[-1:])
     return _sample("multinomial", fn, [pvals])
 
 

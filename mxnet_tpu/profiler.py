@@ -39,7 +39,8 @@ _config = {
     "aggregate_stats": True,
     "continuous_dump": False,
 }
-_state = {"running": False, "trace_dir": None, "op_stats": None}
+_state = {"running": False, "trace_dir": None, "op_stats": None,
+          "paused": False}
 
 
 def set_config(**kwargs):
@@ -83,6 +84,10 @@ def _hook(name, dt):
 
 def start():
     """Start profiling: device trace + host op stats."""
+    # wire the per-op hook into the dispatch path (ops/registry.invoke)
+    import sys
+    from .ops import registry as _registry
+    _registry._profiler = sys.modules[__name__]
     with _lock:
         if _state["running"]:
             return
@@ -96,7 +101,9 @@ def start():
             pass  # nested/unsupported backends: keep host stats only
         _state["running"] = True
         _state["trace_dir"] = trace_dir
-        _state["op_stats"] = _OpStats()
+        if _state["op_stats"] is None or not _state["paused"]:
+            _state["op_stats"] = _OpStats()
+        _state["paused"] = False
 
 
 def stop():
@@ -111,6 +118,12 @@ def stop():
 
 
 def pause(profile_process="worker"):
+    """Suspend collection WITHOUT resetting accumulated stats (reference
+    pause/resume semantics)."""
+    with _lock:
+        if not _state["running"]:
+            return
+        _state["paused"] = True
     stop()
 
 

@@ -44,7 +44,7 @@ def _probe():
     add("PALLAS", lambda: iu.find_spec("jax.experimental.pallas"))
     add("XLA", lambda: True)
     add("SPMD", lambda: True)
-    add("INT64_TENSOR_SIZE", lambda: jax.config.jax_enable_x64 or True)
+    add("INT64_TENSOR_SIZE", lambda: bool(jax.config.jax_enable_x64))
     add("F16C", lambda: True)          # bfloat16 native on TPU
     add("BLAS_OPEN", lambda: True)     # XLA dot
     add("DIST_KVSTORE", lambda: hasattr(jax, "distributed"))
