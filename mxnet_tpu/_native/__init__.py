@@ -1,0 +1,229 @@
+"""ctypes bindings for the native IO runtime (``native/mxtpu_io.cc``).
+
+The native library is the TPU-framework analog of the reference's C++ data
+path (SURVEY.md §3.1 "C++ data pipeline"): RecordIO parse, libjpeg decode,
+threaded prefetch.  Loading is best-effort: if the ``.so`` is missing we try
+one ``make`` (g++ is in the image), and otherwise everything falls back to
+the pure-Python implementations — ``available()`` gates every call site.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as onp
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libmxtpu_io.so")
+_LIB = None
+_TRIED = False
+
+
+def _build():
+    src_dir = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+    if not os.path.isfile(os.path.join(src_dir, "Makefile")):
+        return False
+    try:
+        subprocess.run(["make", "-s"], cwd=src_dir, check=True,
+                       capture_output=True, timeout=120)
+        return os.path.isfile(_SO)
+    except Exception:
+        return False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.isfile(_SO) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.mxio_last_error.restype = ctypes.c_char_p
+    lib.mxio_reader_open.restype = ctypes.c_void_p
+    lib.mxio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.mxio_reader_count.restype = ctypes.c_int64
+    lib.mxio_reader_count.argtypes = [ctypes.c_void_p]
+    lib.mxio_reader_read.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.mxio_reader_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_int64)]
+    lib.mxio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.mxio_free.argtypes = [ctypes.c_void_p]
+    lib.mxio_writer_open.restype = ctypes.c_void_p
+    lib.mxio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.mxio_writer_write.restype = ctypes.c_int
+    lib.mxio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64]
+    lib.mxio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.mxio_decode_jpeg.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.mxio_decode_jpeg.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_int),
+                                     ctypes.POINTER(ctypes.c_int),
+                                     ctypes.POINTER(ctypes.c_int)]
+    lib.mxio_prefetch_create.restype = ctypes.c_void_p
+    lib.mxio_prefetch_create.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.mxio_prefetch_next.restype = ctypes.c_int
+    lib.mxio_prefetch_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+    lib.mxio_prefetch_close.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def last_error() -> str:
+    lib = _load()
+    return lib.mxio_last_error().decode() if lib else "native lib unavailable"
+
+
+class NativeRecordReader:
+    """Random-access RecordIO reader over the native offset index."""
+
+    def __init__(self, path: str, idx_path: str = ""):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = lib
+        self._h = lib.mxio_reader_open(path.encode(), idx_path.encode())
+        if not self._h:
+            raise IOError(last_error())
+
+    def __len__(self):
+        return self._lib.mxio_reader_count(self._h)
+
+    def read(self, i: int) -> bytes:
+        n = ctypes.c_int64()
+        p = self._lib.mxio_reader_read(self._h, i, ctypes.byref(n))
+        if not p:
+            raise IOError(last_error())
+        try:
+            return ctypes.string_at(p, n.value)
+        finally:
+            self._lib.mxio_free(p)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    def __init__(self, path: str, idx_path: str = ""):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = lib
+        self._h = lib.mxio_writer_open(path.encode(), idx_path.encode())
+        if not self._h:
+            raise IOError(last_error())
+
+    def write(self, buf: bytes):
+        if self._lib.mxio_writer_write(self._h, buf, len(buf)) != 0:
+            raise IOError("native write failed")
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def decode_jpeg(buf: bytes, want_color: bool = True) -> onp.ndarray:
+    """JPEG → HWC uint8 numpy via libjpeg."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    p = lib.mxio_decode_jpeg(buf, len(buf), int(want_color),
+                             ctypes.byref(w), ctypes.byref(h),
+                             ctypes.byref(c))
+    if not p:
+        raise IOError(last_error())
+    try:
+        arr = onp.ctypeslib.as_array(p, shape=(h.value, w.value, c.value))
+        return arr.copy()
+    finally:
+        lib.mxio_free(p)
+
+
+class NativePrefetcher:
+    """Threaded read(+decode) pipeline over a NativeRecordReader.
+
+    Yields either raw record bytes (``decode=False``) or decoded HWC uint8
+    arrays (``decode=True``, records packed with IRHeader) in submission
+    order.
+    """
+
+    IRHEADER_BYTES = 24  # uint32 flag | float label | uint64 id | uint64 id2
+
+    def __init__(self, reader: NativeRecordReader, indices, num_threads=2,
+                 capacity=16, decode=False):
+        self._lib = reader._lib
+        self._reader = reader  # keep alive
+        idx = (ctypes.c_int64 * len(indices))(*indices)
+        self._h = self._lib.mxio_prefetch_create(
+            reader._h, idx, len(indices), num_threads, capacity,
+            int(decode), self.IRHEADER_BYTES if decode else 0)
+        self._decode = decode
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            data = ctypes.POINTER(ctypes.c_uint8)()
+            n = ctypes.c_int64()
+            w = ctypes.c_int()
+            h = ctypes.c_int()
+            c = ctypes.c_int()
+            rc = self._lib.mxio_prefetch_next(
+                self._h, ctypes.byref(data), ctypes.byref(n), ctypes.byref(w),
+                ctypes.byref(h), ctypes.byref(c))
+            if rc == 0:
+                raise StopIteration
+            if rc < 0:
+                continue  # skip undecodable record
+            try:
+                if self._decode:
+                    arr = onp.ctypeslib.as_array(
+                        data, shape=(h.value, w.value, c.value)).copy()
+                    return arr
+                return ctypes.string_at(data, n.value)
+            finally:
+                self._lib.mxio_free(data)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_prefetch_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -127,17 +127,32 @@ class ArrayDataset(Dataset):
 
 class RecordFileDataset(Dataset):
     """Dataset over an indexed RecordIO file (``.rec`` + ``.idx``);
-    reference ``gluon.data.RecordFileDataset``."""
+    reference ``gluon.data.RecordFileDataset``.  Reads go through the
+    native C++ reader (offset-indexed pread, thread-safe) when the
+    ``mxtpu_io`` library is available."""
 
     def __init__(self, filename):
-        from ... import recordio
+        from ... import recordio, _native
         self._filename = filename
         idx_file = filename[:-4] + ".idx" if filename.endswith(".rec") \
             else filename + ".idx"
+        self._native = None
+        if _native.available():
+            try:
+                import os as _os
+                self._native = _native.NativeRecordReader(
+                    filename, idx_file if _os.path.isfile(idx_file) else "")
+                return
+            except Exception:
+                self._native = None
         self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
 
     def __len__(self):
+        if self._native is not None:
+            return len(self._native)
         return len(self._record.keys)
 
     def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native.read(idx)
         return self._record.read_idx(self._record.keys[idx])

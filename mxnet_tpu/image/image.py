@@ -33,7 +33,16 @@ except Exception:  # pragma: no cover
 # --------------------------------------------------------------------- #
 def imdecode_np(buf: bytes, iscolor: int = 1, to_rgb: bool = True) -> onp.ndarray:
     """Decode an encoded image to an HWC uint8 numpy array (RGB order when
-    ``to_rgb``, matching the reference's ``mx.image.imdecode`` default)."""
+    ``to_rgb``, matching the reference's ``mx.image.imdecode`` default).
+
+    Backend order: OpenCV → native libjpeg (mxtpu_io) → PIL."""
+    if _cv2 is None and len(buf) > 2 and buf[:2] == b"\xff\xd8":
+        from .. import _native
+        if _native.available():
+            try:
+                return _native.decode_jpeg(bytes(buf), want_color=iscolor != 0)
+            except Exception:
+                pass  # fall through to PIL on corrupt/unsupported streams
     if _cv2 is not None:
         flag = _cv2.IMREAD_COLOR if iscolor != 0 else _cv2.IMREAD_GRAYSCALE
         img = _cv2.imdecode(onp.frombuffer(buf, dtype=onp.uint8), flag)

@@ -1,0 +1,416 @@
+// mxtpu_io — native IO runtime for mxnet_tpu.
+//
+// TPU-native analog of the reference's C++ data path (SURVEY.md §3.1
+// "C++ data pipeline" row: ImageRecordIOParser2 / PrefetcherIter backed by
+// dmlc recordio + OMP decode pool; §4.5 call stack).  The device compute
+// path is JAX/XLA; this library owns the host side: record parsing, JPEG
+// decode, and a threaded prefetch queue feeding pinned host buffers.
+//
+// Flat C ABI, consumed from Python via ctypes (no pybind11 in this image).
+//
+// RecordIO format (dmlc): uint32 magic 0xced7230a | uint32 lrec
+// (cflag:3 | len:29) | payload | pad to 4B.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <csetjmp>
+#include <jpeglib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+// ------------------------------------------------------------------ //
+// Reader: offset-indexed random access over a .rec file
+// ------------------------------------------------------------------ //
+struct Reader {
+  int fd = -1;
+  int64_t file_size = 0;
+  std::vector<int64_t> offsets;  // byte offset of each record header
+
+  ~Reader() {
+    if (fd >= 0) close(fd);
+  }
+
+  bool open(const char* path, const char* idx_path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) {
+      set_error(std::string("cannot open ") + path);
+      return false;
+    }
+    struct stat st;
+    fstat(fd, &st);
+    file_size = st.st_size;
+    if (idx_path && idx_path[0]) {
+      FILE* f = fopen(idx_path, "r");
+      if (f) {
+        char line[256];
+        while (fgets(line, sizeof(line), f)) {
+          long long key, off;
+          if (sscanf(line, "%lld\t%lld", &key, &off) == 2)
+            offsets.push_back(off);
+        }
+        fclose(f);
+        if (!offsets.empty()) return true;
+      }
+    }
+    return scan();
+  }
+
+  // build the offset table by walking record headers
+  bool scan() {
+    offsets.clear();
+    int64_t pos = 0;
+    uint32_t head[2];
+    while (pos + 8 <= file_size) {
+      if (pread(fd, head, 8, pos) != 8) break;
+      if (head[0] != kMagic) {
+        set_error("bad record magic during scan");
+        return false;
+      }
+      uint32_t len = head[1] & kLenMask;
+      uint32_t cflag = head[1] >> 29;
+      if (cflag == 0 || cflag == 1) offsets.push_back(pos);
+      pos += 8 + ((len + 3) & ~3u);
+    }
+    return true;
+  }
+
+  // read record i (reassembling multi-part); returns malloc'd buffer
+  uint8_t* read(int64_t i, int64_t* out_len) {
+    if (i < 0 || i >= (int64_t)offsets.size()) {
+      set_error("record index out of range");
+      return nullptr;
+    }
+    int64_t pos = offsets[i];
+    std::vector<uint8_t> acc;
+    while (true) {
+      uint32_t head[2];
+      if (pread(fd, head, 8, pos) != 8 || head[0] != kMagic) {
+        set_error("truncated/corrupt record");
+        return nullptr;
+      }
+      uint32_t len = head[1] & kLenMask;
+      uint32_t cflag = head[1] >> 29;
+      size_t old = acc.size();
+      acc.resize(old + len);
+      if (pread(fd, acc.data() + old, len, pos + 8) != (ssize_t)len) {
+        set_error("short read");
+        return nullptr;
+      }
+      pos += 8 + ((len + 3) & ~3u);
+      if (cflag == 0 || cflag == 3) break;
+    }
+    uint8_t* out = (uint8_t*)malloc(acc.size());
+    memcpy(out, acc.data(), acc.size());
+    *out_len = acc.size();
+    return out;
+  }
+};
+
+// ------------------------------------------------------------------ //
+// Writer
+// ------------------------------------------------------------------ //
+struct Writer {
+  FILE* f = nullptr;
+  FILE* fidx = nullptr;
+  int64_t key = 0;
+
+  ~Writer() {
+    if (f) fclose(f);
+    if (fidx) fclose(fidx);
+  }
+
+  bool open(const char* path, const char* idx_path) {
+    f = fopen(path, "wb");
+    if (!f) {
+      set_error(std::string("cannot open ") + path);
+      return false;
+    }
+    if (idx_path && idx_path[0]) fidx = fopen(idx_path, "w");
+    return true;
+  }
+
+  bool write(const uint8_t* buf, int64_t len) {
+    int64_t pos = ftell(f);
+    uint32_t head[2] = {kMagic, (uint32_t)len & kLenMask};
+    if (fwrite(head, 1, 8, f) != 8) return false;
+    if (len && fwrite(buf, 1, len, f) != (size_t)len) return false;
+    static const uint8_t zeros[4] = {0, 0, 0, 0};
+    size_t pad = (-(size_t)len) & 3;
+    if (pad) fwrite(zeros, 1, pad, f);
+    if (fidx) fprintf(fidx, "%lld\t%lld\n", (long long)key++, (long long)pos);
+    return true;
+  }
+};
+
+// ------------------------------------------------------------------ //
+// JPEG decode (libjpeg) with error-trap (no exit() on corrupt input)
+// ------------------------------------------------------------------ //
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = (JpegErr*)cinfo->err;
+  longjmp(err->jb, 1);
+}
+
+// decode to RGB (or gray) uint8 HWC; returns malloc'd buffer
+uint8_t* decode_jpeg(const uint8_t* buf, int64_t len, int want_color,
+                     int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  uint8_t* out = nullptr;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    free(out);
+    set_error("jpeg decode failed");
+    return nullptr;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = want_color ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  *c = cinfo.output_components;
+  int stride = (*w) * (*c);
+  out = (uint8_t*)malloc((size_t)(*h) * stride);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out + (size_t)cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return out;
+}
+
+// ------------------------------------------------------------------ //
+// Prefetcher: worker threads read (+ optionally decode) records ahead
+// into a bounded queue — the role of ImageRecordIOParser2's OMP pool +
+// PrefetcherIter's background thread.
+// ------------------------------------------------------------------ //
+struct Item {
+  int64_t index = -1;
+  uint8_t* data = nullptr;  // record bytes or decoded pixels
+  int64_t len = 0;
+  int w = 0, h = 0, c = 0;  // set when decoded
+  bool ok = false;
+};
+
+struct Prefetcher {
+  Reader* reader = nullptr;
+  std::vector<int64_t> order;
+  std::atomic<size_t> next_fetch{0};
+  size_t next_emit = 0;  // order position to hand out next (in-order)
+  int decode = 0;        // 0: raw bytes; 1: jpeg->RGB
+  int skip_header = 0;   // bytes to skip before jpeg payload (IRHeader+label)
+  size_t capacity = 16;
+
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::deque<Item> ready;  // completed items, arbitrary order
+  std::vector<Item> stash;  // out-of-order completions
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop_flag{false};
+
+  ~Prefetcher() { shutdown(); }
+
+  void shutdown() {
+    stop_flag = true;
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+    for (auto& it : ready) free(it.data);
+    for (auto& it : stash) free(it.data);
+    ready.clear();
+    stash.clear();
+  }
+
+  void start(int num_threads) {
+    for (int t = 0; t < num_threads; ++t)
+      workers.emplace_back([this] { work(); });
+  }
+
+  void work() {
+    while (!stop_flag) {
+      size_t pos = next_fetch.fetch_add(1);
+      if (pos >= order.size()) return;
+      Item it;
+      it.index = pos;
+      int64_t len = 0;
+      uint8_t* rec = reader->read(order[pos], &len);
+      if (rec && decode) {
+        int64_t off = skip_header;
+        // variable-length label vector: IRHeader.flag floats after header
+        if (off >= 4 && len >= 4) {
+          uint32_t flag;
+          memcpy(&flag, rec, 4);
+          off = skip_header + 4 * (int64_t)flag;
+        }
+        if (off < len) {
+          it.data = decode_jpeg(rec + off, len - off, 1, &it.w, &it.h, &it.c);
+          it.len = it.data ? (int64_t)it.w * it.h * it.c : 0;
+          it.ok = it.data != nullptr;
+        }
+        free(rec);
+      } else {
+        it.data = rec;
+        it.len = len;
+        it.ok = rec != nullptr;
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [this] {
+        return stop_flag || ready.size() + stash.size() < capacity;
+      });
+      if (stop_flag) {
+        free(it.data);
+        return;
+      }
+      ready.push_back(it);
+      cv_ready.notify_all();
+    }
+  }
+
+  // next item in submission order; blocks. returns false at end.
+  bool next(Item* out) {
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      for (size_t i = 0; i < stash.size(); ++i) {
+        if ((size_t)stash[i].index == next_emit) {
+          *out = stash[i];
+          stash.erase(stash.begin() + i);
+          ++next_emit;
+          cv_space.notify_all();
+          return true;
+        }
+      }
+      for (size_t i = 0; i < ready.size(); ++i) {
+        if ((size_t)ready[i].index == next_emit) {
+          *out = ready[i];
+          ready.erase(ready.begin() + i);
+          ++next_emit;
+          cv_space.notify_all();
+          return true;
+        }
+      }
+      // move stragglers to stash
+      while (!ready.empty()) {
+        stash.push_back(ready.front());
+        ready.pop_front();
+      }
+      if (next_emit >= order.size()) return false;
+      cv_ready.wait(lk);
+      if (stop_flag) return false;
+    }
+  }
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------ //
+// C ABI
+// ------------------------------------------------------------------ //
+extern "C" {
+
+const char* mxio_last_error() { return g_error.c_str(); }
+
+void* mxio_reader_open(const char* path, const char* idx_path) {
+  auto* r = new Reader();
+  if (!r->open(path, idx_path)) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int64_t mxio_reader_count(void* h) {
+  return ((Reader*)h)->offsets.size();
+}
+
+uint8_t* mxio_reader_read(void* h, int64_t i, int64_t* len) {
+  return ((Reader*)h)->read(i, len);
+}
+
+void mxio_reader_close(void* h) { delete (Reader*)h; }
+
+void mxio_free(void* p) { free(p); }
+
+void* mxio_writer_open(const char* path, const char* idx_path) {
+  auto* w = new Writer();
+  if (!w->open(path, idx_path)) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int mxio_writer_write(void* h, const uint8_t* buf, int64_t len) {
+  return ((Writer*)h)->write(buf, len) ? 0 : -1;
+}
+
+void mxio_writer_close(void* h) { delete (Writer*)h; }
+
+uint8_t* mxio_decode_jpeg(const uint8_t* buf, int64_t len, int want_color,
+                          int* w, int* h, int* c) {
+  return decode_jpeg(buf, len, want_color, w, h, c);
+}
+
+// prefetcher over reader handle; indices = iteration order (epoch perm).
+// decode: 0=raw records, 1=jpeg RGB with skip_header bytes of IRHeader.
+void* mxio_prefetch_create(void* reader, const int64_t* indices, int64_t n,
+                           int num_threads, int capacity, int decode,
+                           int skip_header) {
+  auto* p = new Prefetcher();
+  p->reader = (Reader*)reader;
+  p->order.assign(indices, indices + n);
+  p->decode = decode;
+  p->skip_header = skip_header;
+  p->capacity = capacity > 0 ? capacity : 16;
+  p->start(num_threads > 0 ? num_threads : 2);
+  return p;
+}
+
+// returns: 1 item ok, 0 end of stream, -1 decode error (item skipped
+// upstream decides). data must be freed with mxio_free.
+int mxio_prefetch_next(void* h, uint8_t** data, int64_t* len, int* w,
+                       int* hh, int* c) {
+  Item it;
+  if (!((Prefetcher*)h)->next(&it)) return 0;
+  *data = it.data;
+  *len = it.len;
+  *w = it.w;
+  *hh = it.h;
+  *c = it.c;
+  return it.ok ? 1 : -1;
+}
+
+void mxio_prefetch_close(void* h) { delete (Prefetcher*)h; }
+
+}  // extern "C"
