@@ -207,7 +207,11 @@ class NativePrefetcher:
             if rc == 0:
                 raise StopIteration
             if rc < 0:
-                continue  # skip undecodable record
+                if not self._decode:
+                    # raw-mode failure = file corruption, not a bad image;
+                    # silently skipping would misalign sample/label streams
+                    raise IOError(last_error())
+                continue  # skip undecodable image
             try:
                 if self._decode:
                     arr = onp.ctypeslib.as_array(

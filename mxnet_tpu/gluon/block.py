@@ -373,6 +373,7 @@ class HybridBlock(Block):
         self._active = False
         self._cached_op = None
         self._flags = {}
+        self._last_input_structs = None
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   **kwargs):
@@ -399,6 +400,11 @@ class HybridBlock(Block):
 
     # -- forward dispatch -------------------------------------------------- #
     def __call__(self, *args, **kwargs):
+        if args and not _trace_state.active and \
+                all(isinstance(a, NDArray) for a in args):
+            # raw jax dtypes — no onp.dtype/str conversion on the hot path
+            self._last_input_structs = [(a._data.shape, a._data.dtype)
+                                        for a in args]
         if self._active and not _trace_state.no_hybrid:
             for hook in self._forward_pre_hooks.values():
                 hook(self, args)
@@ -431,24 +437,47 @@ class HybridBlock(Block):
             self._cached_op = _CachedOp(self, self._flags)
         return self._cached_op(args, kwargs)
 
-    def export(self, path, epoch=0):
-        """Save params in the reference's export layout
-        (``path-symbol.json`` stub + ``path-%04d.params``); see
-        SURVEY.md §5.4(b)."""
-        import json
-        params = self._collect_params_with_prefix()
-        meta = {
-            "format": "mxnet_tpu-hybrid-v1",
-            "block": type(self).__name__,
-            "params": {n: {"shape": list(p.shape), "dtype":
-                           onp.dtype(p.dtype).name}
-                       for n, p in params.items()},
-        }
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump(meta, f, indent=2)
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Save ``path-symbol.json`` + ``path-%04d.params`` (reference
+        ``HybridBlock.export``, SURVEY.md §5.4b).
+
+        The graph is obtained by CAPTURE: one predict-mode imperative
+        forward is replayed with every registry invoke recorded as a graph
+        node (the reference's tape-as-graph mechanism).  Requires at least
+        one prior forward call (to know input signatures) — same
+        precondition as the reference."""
+        from .. import autograd, ndarray as nd
+        from ..symbol.symbol import capture
+        if getattr(self, "_last_input_structs", None) is None:
+            raise MXNetError(
+                "export: run the block on real inputs once before export "
+                "(the reference has the same requirement)")
+        params = self.collect_params()
+        inputs = [nd.zeros(tuple(s), dtype=str(onp.dtype(dt)))
+                  for s, dt in self._last_input_structs]
+        in_names = ["data"] if len(inputs) == 1 else \
+            [f"data{i}" for i in range(len(inputs))]
+        with capture() as cap:
+            for name, p in params.items():
+                if p._data is not None:
+                    cap.mark_variable(name, p.data())
+            for nm, x in zip(in_names, inputs):
+                cap.mark_variable(nm, x, shape=x.shape)
+            with autograd.pause(train_mode=False):
+                with _no_hybrid():
+                    out = self.forward(*inputs)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        sym = cap.symbol_for(outs)
+        sym.save(f"{path}-symbol.json", remove_amp_cast=remove_amp_cast)
+        used = set(sym.list_arguments())
+        save_dict = {f"arg:{n}": p.data() for n, p in params.items()
+                     if n in used and p._data is not None}
+        for cname, cval in cap.const_values.items():
+            if cname in used:
+                save_dict[f"aux:{cname}"] = NDArray(cval)
         from ..ndarray import serialization
-        serialization.save(f"{path}-{epoch:04d}.params",
-                           {n: p.data() for n, p in params.items()})
+        serialization.save(f"{path}-{epoch:04d}.params", save_dict)
+        return sym
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         """Reference ``optimize_for(backend)``: partition/compile for a
@@ -600,13 +629,83 @@ class _no_hybrid:
 
 
 class SymbolBlock(HybridBlock):
-    """Load an exported graph as a Block (reference anchor
-    ``SymbolBlock.imports``).  Until the symbolic IR lands, imports restores
-    architecture-less parameter bundles and raises on forward."""
+    """Wrap a Symbol graph as a Block (reference anchor
+    ``SymbolBlock.imports``; SURVEY.md §5.4b "reloadable cross-language").
+
+    Forward executes the graph through the shared op registry, so a
+    SymbolBlock trains, hybridizes and exports like any other block."""
+
+    def __init__(self, outputs, inputs, params=None, prefix=None):
+        super().__init__(prefix=prefix or "symbolblock_")
+        from ..symbol.symbol import Symbol
+        if isinstance(outputs, (list, tuple)):
+            from ..symbol.symbol import Group
+            outputs = Group(outputs)
+        if not isinstance(outputs, Symbol):
+            raise MXNetError("SymbolBlock: outputs must be Symbol(s)")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sym = outputs
+        self._input_names = [s.name if isinstance(s, Symbol) else str(s)
+                             for s in inputs]
+        self._consts = {}
+        arg_names = outputs.list_arguments()
+        for nm in self._input_names:
+            if nm not in arg_names:
+                raise MXNetError(f"SymbolBlock: input {nm} not in graph")
+        for nm in arg_names:
+            if nm in self._input_names:
+                continue
+            p = (params or {}).get(nm)
+            if isinstance(p, Parameter):
+                self._params._params[nm] = p
+            else:
+                newp = Parameter(nm, shape=None, allow_deferred_init=True)
+                if p is not None:
+                    newp._load_init(p)
+                self._params._params[nm] = newp
+
+    def forward(self, *args):
+        feed = {}
+        for nm, a in zip(self._input_names, args):
+            feed[nm] = a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+        for nm, p in self._params.items():
+            if nm in self._consts:
+                feed[nm] = self._consts[nm]
+            elif p._data is not None:
+                feed[nm] = p.data()
+            else:
+                raise MXNetError(f"SymbolBlock: parameter {nm} has no value; "
+                                 f"load params first")
+        from ..symbol.symbol import _execute
+        outs = _execute(self._sym._heads, feed)
+        return outs[0] if len(outs) == 1 else outs
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        return self.forward(*args)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise MXNetError(
-            "SymbolBlock.imports requires the symbol IR (planned phase 5, "
-            "SURVEY.md §7); use Block.load_parameters with the original "
-            "model class instead")
+        """Load an exported (symbol.json, .params) pair as a Block."""
+        from .. import symbol as sym_mod
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        block = SymbolBlock(sym, input_names)
+        if param_file is not None:
+            arg_params, aux_params = load_params_file(param_file)
+            for nm, v in arg_params.items():
+                if nm in block._params._params:
+                    block._params._params[nm]._load_init(v)
+            for nm, v in aux_params.items():
+                if nm in block._params._params:
+                    block._consts[nm] = v
+                    block._params._params[nm]._load_init(v)
+        return block
+
+
+def load_params_file(param_file):
+    """Split a ``.params`` file into (arg, aux) dicts — delegates to the
+    single implementation in :mod:`mxnet_tpu.model`."""
+    from ..model import load_params_file as _impl
+    return _impl(param_file)

@@ -63,9 +63,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
-def load_params(prefix, epoch):
-    """→ (arg_params, aux_params) from ``prefix-%04d.params``."""
-    loaded = nd.load(f"{prefix}-{epoch:04d}.params")
+def load_params_file(param_file):
+    """Split a ``.params`` file into (arg, aux) dicts — the single
+    implementation of the ``arg:``/``aux:`` key scheme."""
+    loaded = nd.load(param_file)
+    if isinstance(loaded, list):
+        raise MXNetError("params file has unnamed arrays; cannot map")
     arg_params, aux_params = {}, {}
     for k, v in loaded.items():
         tp, _, name = k.partition(":")
@@ -76,6 +79,11 @@ def load_params(prefix, epoch):
         else:  # plain name->array file (gluon save_parameters)
             arg_params[k] = v
     return arg_params, aux_params
+
+
+def load_params(prefix, epoch):
+    """→ (arg_params, aux_params) from ``prefix-%04d.params``."""
+    return load_params_file(f"{prefix}-{epoch:04d}.params")
 
 
 def load_checkpoint(prefix, epoch):

@@ -1,0 +1,33 @@
+"""``mx.sym`` — symbolic graph namespace over the shared op registry
+(reference ``python/mxnet/symbol/``; SURVEY.md §3.2 "symbol module").
+
+Op builders (``mx.sym.FullyConnected`` …) are generated from the same
+registry that serves ``mx.nd`` — one table, three namespaces (SURVEY.md §7).
+"""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     Executor, capture, current_capture, _make_builder)
+from ..ops import registry as _registry
+
+# ensure the op corpus is registered before namespace generation
+from .. import ndarray as _nd  # noqa: F401
+
+# wire the capture hook into the dispatch path
+_registry._capture_get = current_capture
+
+
+def __getattr__(name):
+    try:
+        _registry.get_op(name)
+    except Exception:
+        raise AttributeError(
+            f"module 'mxnet_tpu.symbol' has no attribute {name!r}")
+    b = _make_builder(name)
+    globals()[name] = b
+    return b
+
+
+def zeros(shape, dtype="float32", name=None):
+    """Constant-from-attrs symbol (via full_like over a variable is not
+    possible without an input; use Variable + bind instead)."""
+    raise NotImplementedError(
+        "mx.sym.zeros: bind a Variable instead (XLA folds constants)")

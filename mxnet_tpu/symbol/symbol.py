@@ -1,0 +1,671 @@
+"""Symbol — the symbolic graph IR.
+
+Reference surface: ``python/mxnet/symbol/symbol.py`` + nnvm ``Graph``
+(SURVEY.md §3.1 "nnvm", §3.2 "symbol module", L4): ``Variable``, op
+composition, ``list_arguments/list_outputs``, ``infer_shape``,
+``tojson/save/load``, ``bind/simple_bind`` → ``Executor``, symbol
+composition ``sym2(data=sym1)``, ``Group``.
+
+TPU-native redesign: a Symbol node names an op in the SAME registry the
+imperative path uses (SURVEY.md §7 "Op registry" — one table serves
+``mx.nd``, ``mx.np`` and ``mx.sym``); execution walks the graph through
+``ops.registry.invoke`` so autograd and jit treatment are identical to
+imperative code.  The reference's graph passes disappear: shape/type
+inference is ``jax.eval_shape`` over the walked graph, memory planning and
+fusion belong to XLA.
+
+Graphs also arise by *capture* (``mxnet_tpu.symbol.capture``): the
+imperative dispatch path records one node per invoke — this is how
+``HybridBlock.export`` obtains the graph, mirroring the reference where the
+autograd tape IS an nnvm graph (SURVEY.md §3.1 "Imperative runtime").
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "Executor", "capture", "current_capture"]
+
+_JSON_TYPES = (str, int, float, bool, type(None))
+
+
+def _jsonable(v):
+    if isinstance(v, _JSON_TYPES):
+        return True
+    if isinstance(v, (list, tuple)):
+        return all(_jsonable(x) for x in v)
+    if isinstance(v, dict):
+        return all(isinstance(k, str) and _jsonable(x) for k, x in v.items())
+    return False
+
+
+class _Node:
+    """One graph node.  ``op is None`` ⇒ variable (reference "null" op)."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "num_outputs")
+
+    def __init__(self, op, name, inputs=(), attrs=None, num_outputs=None):
+        self.op = op
+        self.name = name
+        self.inputs = list(inputs)  # [(node, out_idx)]
+        self.attrs = dict(attrs or {})
+        self.num_outputs = num_outputs  # lazily discovered
+
+    def __repr__(self):
+        return f"<Node {self.op or 'var'} {self.name}>"
+
+
+_name_lock = threading.Lock()
+_name_counter: dict = {}
+
+
+def _auto_name(hint):
+    with _name_lock:
+        n = _name_counter.get(hint, 0)
+        _name_counter[hint] = n + 1
+    return f"{hint}{n}"
+
+
+def _topo(heads):
+    """Topological node order for the sub-graph reaching ``heads``."""
+    order, seen = [], set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp, _ in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """A set of output heads over the node DAG."""
+
+    def __init__(self, heads):
+        self._heads = list(heads)
+
+    # -- construction ------------------------------------------------- #
+    @property
+    def name(self):
+        return self._heads[0][0].name
+
+    def __repr__(self):
+        return f"<Symbol {' '.join(n.name for n, _ in self._heads)}>"
+
+    def __iter__(self):
+        for i in range(len(self._heads)):
+            yield Symbol([self._heads[i]])
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            names = self.list_outputs()
+            if i not in names:
+                raise MXNetError(f"no output named {i}")
+            i = names.index(i)
+        if isinstance(i, int):
+            if i >= len(self._heads):
+                raise MXNetError("output index out of range")
+            return Symbol([self._heads[i]])
+        raise MXNetError("Symbol index must be int or str")
+
+    def __len__(self):
+        return len(self._heads)
+
+    @property
+    def num_outputs(self):
+        return len(self._heads)
+
+    # -- introspection ------------------------------------------------- #
+    def list_arguments(self):
+        return [n.name for n in _topo(self._heads) if n.op is None]
+
+    def list_inputs(self):
+        return self.list_arguments()
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._heads:
+            out.append(f"{node.name}_output{idx}" if (node.num_outputs or 1) > 1
+                       else f"{node.name}_output" if node.op else node.name)
+        return out
+
+    def list_auxiliary_states(self):
+        return []  # aux state is functional on TPU (SURVEY.md §7)
+
+    def get_internals(self):
+        heads = []
+        for node in _topo(self._heads):
+            heads.append((node, 0))
+        return Symbol(heads)
+
+    def attr(self, key):
+        return self._heads[0][0].attrs.get(key)
+
+    # -- composition --------------------------------------------------- #
+    def __call__(self, *args, **kwargs):
+        """Substitute variables: ``net(data=other_sym)`` (reference symbol
+        composition)."""
+        if args:
+            arg_names = self.list_arguments()
+            for a, nm in zip(args, arg_names):
+                kwargs.setdefault(nm, a)
+        for k, v in kwargs.items():
+            if not isinstance(v, Symbol):
+                raise MXNetError(f"composition arg {k} must be a Symbol")
+        mapping = {}
+
+        def clone(node):
+            """→ (replacement_node, forced_out_idx or None)."""
+            if id(node) in mapping:
+                return mapping[id(node)]
+            if node.op is None and node.name in kwargs:
+                # a substituted variable takes BOTH node and output index of
+                # the replacement head (it may be a multi-output selection)
+                ent = kwargs[node.name]._heads[0]
+                mapping[id(node)] = ent
+                return ent
+            inputs = []
+            for i, idx in node.inputs:
+                r, ridx = clone(i)
+                inputs.append((r, ridx if ridx is not None else idx))
+            new = _Node(node.op, node.name, inputs, node.attrs,
+                        node.num_outputs)
+            mapping[id(node)] = (new, None)
+            return new, None
+
+        heads = []
+        for n, i in self._heads:
+            r, ridx = clone(n)
+            heads.append((r, ridx if ridx is not None else i))
+        return Symbol(heads)
+
+    # -- serialization ------------------------------------------------- #
+    def tojson(self, remove_amp_cast=True):
+        nodes = _topo(self._heads)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.op is None else n.op,
+                "name": n.name,
+                "attrs": {k: v if _jsonable(v) else repr(v)
+                          for k, v in n.attrs.items()},
+                "inputs": [[nid[id(i)], idx, 0] for i, idx in n.inputs],
+                **({"num_outputs": n.num_outputs}
+                   if n.num_outputs and n.num_outputs > 1 else {}),
+            })
+        graph = {
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.op is None],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[nid[id(n)], idx, 0] for n, idx in self._heads],
+            "attrs": {"mxnet_version": ["str", "mxnet_tpu-0.1"]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname, remove_amp_cast=True):
+        with open(fname, "w") as f:
+            f.write(self.tojson(remove_amp_cast=remove_amp_cast))
+
+    # -- shape/type inference ------------------------------------------ #
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux = self._infer(False, *args, **kwargs)
+        return arg_shapes, out_shapes, aux
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self._infer(False, *args, **kwargs)
+        except Exception:
+            return None, None, None
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtypes = dict(zip(arg_names, args))
+        dtypes.update(kwargs)
+        structs = {}
+        for nm in arg_names:
+            dt = dtypes.get(nm, "float32")
+            structs[nm] = onp.dtype(dt)
+        _, outs = _abstract_eval(
+            self._heads,
+            {nm: jax.ShapeDtypeStruct((1,), structs[nm]) for nm in arg_names})
+        return ([structs[nm] for nm in arg_names],
+                [onp.dtype(o.dtype) for o in outs], [])
+
+    def _infer(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        shapes = dict(zip(arg_names, args))
+        shapes.update(kwargs)
+        missing = [nm for nm in arg_names if shapes.get(nm) is None]
+        if missing:
+            raise MXNetError(f"infer_shape: missing shapes for {missing}")
+        feed = {nm: jax.ShapeDtypeStruct(tuple(shapes[nm]), onp.float32)
+                for nm in arg_names}
+        _, outs = _abstract_eval(self._heads, feed)
+        return ([tuple(shapes[nm]) for nm in arg_names],
+                [tuple(o.shape) for o in outs], [])
+
+    # -- execution ----------------------------------------------------- #
+    def eval(self, ctx=None, **kwargs):
+        """Evaluate with name->NDArray bindings; returns list of NDArrays."""
+        return _execute(self._heads, kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from .. import ndarray as nd
+        arg_names = self.list_arguments()
+        args = {}
+        for nm in arg_names:
+            if nm not in shapes:
+                raise MXNetError(f"simple_bind: missing shape for {nm}")
+            args[nm] = nd.zeros(shapes[nm])
+        return Executor(self, ctx, args, None, grad_req)
+
+    # -- operators ----------------------------------------------------- #
+    def _binary(self, other, opname, swap=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if swap else (self, other)
+            return _invoke_builder(opname, [a, b], {})
+        # scalar: materialize via full_like (stays shape-polymorphic)
+        const = _invoke_builder("full_like", [self], {"fill_value": other})
+        a, b = (const, self) if swap else (self, const)
+        return _invoke_builder(opname, [a, b], {})
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", swap=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "broadcast_div", swap=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power")
+
+    def __neg__(self):
+        return _invoke_builder("negative", [self], {})
+
+    # -- common methods (mirror NDArray surface) ----------------------- #
+    def reshape(self, shape):
+        return _invoke_builder("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _invoke_builder("transpose", [self],
+                               {"axes": tuple(axes)} if axes else {})
+
+    def astype(self, dtype):
+        return _invoke_builder("cast", [self], {"dtype": str(dtype)})
+
+    def sum(self, axis=None, keepdims=False):
+        return _invoke_builder("sum", [self],
+                               {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _invoke_builder("mean", [self],
+                               {"axis": axis, "keepdims": keepdims})
+
+
+def Variable(name, shape=None, dtype=None, **kwargs):
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    return Symbol([(_Node(None, name, attrs=attrs), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load_json(s: str) -> Symbol:
+    graph = json.loads(s)
+    nodes = []
+    for jn in graph["nodes"]:
+        op = None if jn["op"] == "null" else jn["op"]
+        node = _Node(op, jn["name"],
+                     [(None, idx) for _, idx, _ in jn["inputs"]],
+                     jn.get("attrs", {}), jn.get("num_outputs"))
+        node.inputs = [(nodes[i], idx) for i, idx, _ in jn["inputs"]]
+        nodes.append(node)
+    heads = [(nodes[i], idx) for i, idx, _ in graph["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# --------------------------------------------------------------------- #
+# graph walking
+# --------------------------------------------------------------------- #
+
+def _node_outputs_from_invoke(node, in_arrays, as_ndarray=True):
+    """Run one node through the shared registry."""
+    opref = _registry.get_op(node.op)
+    attrs = {k: v for k, v in node.attrs.items()
+             if not k.startswith("__")}
+    # JSON round-trips tuples to lists; normalize for static hashability
+    attrs = {k: tuple(v) if isinstance(v, list) else v
+             for k, v in attrs.items()}
+    if as_ndarray:
+        res = _registry.invoke(opref, in_arrays, attrs)
+    else:
+        res = opref.fn(*in_arrays, **attrs)
+    outs = list(res) if isinstance(res, (list, tuple)) else [res]
+    node.num_outputs = len(outs)
+    return outs
+
+
+def _execute(heads, feed, training=False):
+    """Imperative walk via invoke (autograd-aware).  ``feed``:
+    name -> NDArray for every variable."""
+    from .. import ndarray as nd
+    from ..ndarray import NDArray
+
+    memo = {}
+    outputs = []
+    for node in _topo(heads):
+        if node.op is None:
+            if node.name not in feed:
+                raise MXNetError(f"unbound variable {node.name}")
+            val = feed[node.name]
+            if not isinstance(val, NDArray):
+                val = nd.array(val)
+            memo[id(node)] = [val]
+        else:
+            ins = [memo[id(i)][idx] for i, idx in node.inputs]
+            memo[id(node)] = _node_outputs_from_invoke(node, ins)
+    for node, idx in heads:
+        outputs.append(memo[id(node)][idx])
+    return outputs
+
+
+def _abstract_eval(heads, feed_structs):
+    """jax.eval_shape over the graph (the InferShape/InferType pass)."""
+
+    names = list(feed_structs.keys())
+
+    def run(*arrays):
+        feed = dict(zip(names, arrays))
+        memo = {}
+        for node in _topo(heads):
+            if node.op is None:
+                memo[id(node)] = [feed[node.name]]
+            else:
+                ins = [memo[id(i)][idx] for i, idx in node.inputs]
+                memo[id(node)] = _node_outputs_from_invoke(
+                    node, ins, as_ndarray=False)
+        return [memo[id(n)][i] for n, i in heads]
+
+    outs = jax.eval_shape(run, *[feed_structs[n] for n in names])
+    return names, outs
+
+
+# --------------------------------------------------------------------- #
+# Executor (reference GraphExecutor, src/executor/ — SURVEY.md L4):
+# bind arguments, forward/backward.  Memory planning/fusion = XLA's job.
+# --------------------------------------------------------------------- #
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write"):
+        from .. import ndarray as nd
+        from ..ndarray import NDArray
+
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        self.arg_dict = OrderedDict()
+        for nm in arg_names:
+            if args is None or nm not in args:
+                raise MXNetError(f"bind: missing argument {nm}")
+            v = args[nm]
+            self.arg_dict[nm] = v if isinstance(v, NDArray) else nd.array(v)
+        if isinstance(grad_req, str):
+            grad_req = {nm: grad_req for nm in arg_names}
+        self._grad_req = grad_req
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self._args_grad = args_grad
+        for nm, arr in self.arg_dict.items():
+            req = grad_req.get(nm, "null")
+            if req != "null":
+                arr.attach_grad(req)
+        self.aux_dict = OrderedDict()
+        self.outputs = []
+
+    @property
+    def grad_dict(self):
+        return OrderedDict((nm, arr.grad) for nm, arr in self.arg_dict.items()
+                           if self._grad_req.get(nm, "null") != "null")
+
+    @property
+    def grad_arrays(self):
+        return [self.arg_dict[nm].grad
+                if self._grad_req.get(nm, "null") != "null" else None
+                for nm in self._symbol.list_arguments()]
+
+    @property
+    def arg_arrays(self):
+        return list(self.arg_dict.values())
+
+    def forward(self, is_train=False, **kwargs):
+        from .. import autograd
+        from ..ndarray import NDArray
+        from .. import ndarray as nd
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k}")
+            arr = v if isinstance(v, NDArray) else nd.array(v)
+            self.arg_dict[k]._rebind(arr._data)
+        needs_grad = any(r != "null" for r in self._grad_req.values())
+        if is_train and needs_grad:
+            with autograd.record():
+                self.outputs = _execute(self._symbol._heads, self.arg_dict)
+        else:
+            with autograd.pause(train_mode=is_train):
+                self.outputs = _execute(self._symbol._heads, self.arg_dict)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from .. import autograd
+        if not self.outputs:
+            raise MXNetError("backward before forward")
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        autograd.backward(self.outputs, out_grads)
+        if self._args_grad:
+            for nm, dst in self._args_grad.items():
+                g = self.arg_dict[nm].grad
+                if g is not None:
+                    dst._rebind(g._data)
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for nm, v in arg_params.items():
+            if nm in self.arg_dict:
+                self.arg_dict[nm]._rebind(v._data)
+
+
+# --------------------------------------------------------------------- #
+# op namespace builders (mx.sym.FullyConnected(...) etc.)
+# --------------------------------------------------------------------- #
+
+# ops whose output count is known at graph-build time (reference: the op
+# registry's num_outputs attr); callable receives the static attrs
+_MULTI_OUTPUT = {
+    "split": lambda attrs: int(attrs.get("num_outputs", 1)),
+    "_BatchNormStats": lambda attrs: 3,
+    "topk": lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1,
+}
+
+
+def _invoke_builder(opname, sym_args, attrs, name=None):
+    opref = _registry.get_op(opname)
+    inputs = []
+    for s in sym_args:
+        if s is None:
+            continue
+        if not isinstance(s, Symbol):
+            raise MXNetError(
+                f"{opname}: symbol op inputs must be Symbols, got {type(s)}")
+        if len(s._heads) != 1:
+            raise MXNetError(f"{opname}: grouped symbol cannot be an input")
+        inputs.append(s._heads[0])
+    attrs = {k: v for k, v in attrs.items() if v is not None or k == "axis"}
+    n_out = _MULTI_OUTPUT.get(opref.name, lambda a: 1)(attrs)
+    node = _Node(opref.name, name or _auto_name(opname.lower().strip("_")),
+                 inputs, attrs, num_outputs=n_out if n_out > 1 else None)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+import inspect as _inspect
+
+
+def _make_builder(opname):
+    opref = _registry.get_op(opname)
+    sig = None
+    try:
+        sig = _inspect.signature(opref.fn)
+        arr_names = [p.name for p in sig.parameters.values()
+                     if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    except (ValueError, TypeError):
+        arr_names = []
+
+    def builder(*args, name=None, **kwargs):
+        if opref.variadic:
+            arrays = list(args[0]) if len(args) == 1 and isinstance(
+                args[0], (list, tuple)) else list(args)
+            return _invoke_builder(opname, arrays, kwargs, name)
+        arrays = list(args)
+        # tensor params passed by keyword (bias=..., gamma=...)
+        for nm in arr_names[len(arrays):]:
+            v = kwargs.pop(nm, None)
+            arrays.append(v)
+        while arrays and arrays[-1] is None:
+            arrays.pop()
+        return _invoke_builder(opname, arrays, kwargs, name)
+
+    builder.__name__ = opname
+    builder.__doc__ = f"Symbolic {opname} (shared registry op)."
+    return builder
+
+
+# --------------------------------------------------------------------- #
+# capture: record imperative invokes as graph nodes (export path)
+# --------------------------------------------------------------------- #
+
+class _Capture:
+    def __init__(self):
+        self.value_to_entry = {}  # id(jax array) -> (node, out_idx)
+        self.keepalive = []
+        self.const_values = {}    # const var node name -> jax array
+
+    def lookup(self, arr):
+        return self.value_to_entry.get(id(arr))
+
+    def mark_variable(self, name, ndarray, shape=None, dtype=None):
+        node = _Node(None, name, attrs={})
+        if shape is not None:
+            node.attrs["__shape__"] = tuple(shape)
+        self.value_to_entry[id(ndarray._data)] = (node, 0)
+        self.keepalive.append(ndarray._data)
+        return node
+
+    def record(self, opref, array_args, kwargs, outs):
+        attrs = {k: v for k, v in kwargs.items() if _jsonable(v)}
+        if len(attrs) != len(kwargs):
+            bad = set(kwargs) - set(attrs)
+            raise MXNetError(
+                f"capture: op {opref.name} has non-serializable attrs {bad}")
+        inputs = []
+        for a in array_args:
+            ent = self.lookup(a._data if hasattr(a, "_data") else a)
+            if ent is None:
+                # unnamed input: auto-variable (e.g. a constant created
+                # inside forward) — keep the value so imports can restore it
+                data = a._data if hasattr(a, "_data") else a
+                node = _Node(None, _auto_name("_const"), attrs={})
+                ent = (node, 0)
+                self.value_to_entry[id(data)] = ent
+                self.keepalive.append(data)
+                self.const_values[node.name] = data
+            inputs.append(ent)
+        node = _Node(opref.name, _auto_name(opref.name.lower().strip("_")),
+                     inputs, attrs, num_outputs=len(outs))
+        for i, o in enumerate(outs):
+            data = o._data if hasattr(o, "_data") else o
+            self.value_to_entry[id(data)] = (node, i)
+            self.keepalive.append(data)
+        return node
+
+    def symbol_for(self, outputs):
+        heads = []
+        for o in outputs:
+            ent = self.lookup(o._data if hasattr(o, "_data") else o)
+            if ent is None:
+                raise MXNetError("capture: output was not produced by a "
+                                 "captured op")
+            heads.append(ent)
+        return Symbol(heads)
+
+
+class capture:
+    """``with capture() as cap:`` — every registry invoke records a node.
+
+    The imperative tape-as-graph mechanism (reference ``Imperative::RecordOp``
+    appending nnvm nodes, SURVEY.md §3.1)."""
+
+    _tls = threading.local()
+
+    def __enter__(self):
+        self._prev = getattr(capture._tls, "value", None)
+        capture._tls.value = _Capture()
+        return capture._tls.value
+
+    def __exit__(self, *a):
+        capture._tls.value = self._prev
+
+
+def current_capture():
+    return getattr(capture._tls, "value", None)

@@ -151,6 +151,10 @@ struct Writer {
   }
 
   bool write(const uint8_t* buf, int64_t len) {
+    if ((uint64_t)len > kLenMask) {
+      set_error("record exceeds 2^29-1 bytes");
+      return false;
+    }
     int64_t pos = ftell(f);
     uint32_t head[2] = {kMagic, (uint32_t)len & kLenMask};
     if (fwrite(head, 1, 8, f) != 8) return false;
@@ -183,7 +187,8 @@ uint8_t* decode_jpeg(const uint8_t* buf, int64_t len, int want_color,
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
-  uint8_t* out = nullptr;
+  // volatile: modified between setjmp and longjmp, read in the error path
+  uint8_t* volatile out = nullptr;
   if (setjmp(jerr.jb)) {
     jpeg_destroy_decompress(&cinfo);
     free(out);
@@ -286,8 +291,12 @@ struct Prefetcher {
         it.ok = rec != nullptr;
       }
       std::unique_lock<std::mutex> lk(mu);
-      cv_space.wait(lk, [this] {
-        return stop_flag || ready.size() + stash.size() < capacity;
+      // always admit the item the consumer is waiting for (index ==
+      // next_emit), even at capacity — otherwise a slow record 0 plus a
+      // full queue of later indices deadlocks the pipeline
+      cv_space.wait(lk, [this, &it] {
+        return stop_flag || ready.size() + stash.size() < capacity ||
+               (size_t)it.index == next_emit;
       });
       if (stop_flag) {
         free(it.data);

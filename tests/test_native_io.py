@@ -103,3 +103,28 @@ def test_record_file_dataset_uses_native(packed_rec):
     assert ds._native is not None
     h, _ = rio.unpack(ds[5])
     assert float(h.label) == 5.0
+
+
+def test_prefetch_no_deadlock_small_capacity(tmp_path):
+    """Regression: a slow first record + full queue must not deadlock
+    (the consumer-awaited index is always admitted)."""
+    rec = str(tmp_path / "big.rec")
+    idx = str(tmp_path / "big.idx")
+    w = rio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(40):
+        w.write_idx(i, bytes([i % 251]) * (200000 if i == 0 else 50))
+    w.close()
+    r = _native.NativeRecordReader(rec, idx)
+    pf = _native.NativePrefetcher(r, list(range(40)), num_threads=4,
+                                  capacity=4)
+    out = list(pf)
+    assert len(out) == 40
+    assert len(out[0]) == 200000 and out[1] == bytes([1]) * 50
+
+
+def test_writer_rejects_oversized_record(tmp_path):
+    w = _native.NativeRecordWriter(str(tmp_path / "o.rec"), "")
+    w.write(b"ok")
+    with pytest.raises(IOError):
+        # 2^29 exceeds the 29-bit length field; must error, not corrupt
+        w.write(bytes(1 << 29))

@@ -1,0 +1,206 @@
+"""Symbol module tests (reference tests/python/unittest/test_symbol.py
+coverage; SURVEY.md §3.2 "symbol module", §5.4b export formats)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    w1, b1 = mx.sym.var("w1"), mx.sym.var("b1")
+    fc = mx.sym.FullyConnected(data, w1, b1, num_hidden=4, name="fc1")
+    return mx.sym.Activation(fc, act_type="relu", name="relu1")
+
+
+class TestSymbolCompose:
+    def test_arguments_topo_order(self):
+        s = _mlp()
+        assert s.list_arguments() == ["data", "w1", "b1"]
+
+    def test_infer_shape(self):
+        s = _mlp()
+        args, outs, aux = s.infer_shape(data=(5, 8), w1=(4, 8), b1=(4,))
+        assert outs == [(5, 4)]
+        assert aux == []
+
+    def test_infer_shape_missing_raises(self):
+        with pytest.raises(MXNetError):
+            _mlp().infer_shape(data=(5, 8))
+
+    def test_infer_type(self):
+        s = _mlp()
+        args, outs, _ = s.infer_type()
+        assert outs[0] == onp.dtype("float32")
+
+    def test_composition_substitutes_variable(self):
+        first = _mlp()
+        head = mx.sym.FullyConnected(mx.sym.var("x2"), mx.sym.var("w2"),
+                                     None, num_hidden=2, no_bias=True)
+        comp = head(x2=first)
+        names = comp.list_arguments()
+        assert "data" in names and "x2" not in names
+
+    def test_group_and_index(self):
+        a = _mlp()
+        b = a + 2.0
+        grp = mx.sym.Group([a, b])
+        assert len(grp) == 2
+        assert grp[0].list_arguments() == a.list_arguments()
+
+    def test_scalar_arithmetic(self):
+        s = mx.sym.var("x") * 2.0 + 1.0
+        out = s.eval(x=mx.nd.array([1.0, 2.0]))[0]
+        onp.testing.assert_allclose(out.asnumpy(), [3.0, 5.0])
+
+    def test_operator_overloads(self):
+        x = mx.sym.var("x")
+        y = mx.sym.var("y")
+        out = ((x + y) * x / y - x).eval(x=mx.nd.array([4.0]),
+                                         y=mx.nd.array([2.0]))[0]
+        onp.testing.assert_allclose(out.asnumpy(), [8.0])
+
+
+class TestSymbolSerialization:
+    def test_json_roundtrip_eval(self, tmp_path):
+        s = _mlp()
+        x = onp.random.rand(2, 8).astype(onp.float32)
+        W = onp.random.rand(4, 8).astype(onp.float32)
+        b = onp.random.rand(4).astype(onp.float32)
+        ref = s.eval(data=mx.nd.array(x), w1=mx.nd.array(W),
+                     b1=mx.nd.array(b))[0]
+        fname = str(tmp_path / "sym.json")
+        s.save(fname)
+        s2 = mx.sym.load(fname)
+        assert s2.list_arguments() == s.list_arguments()
+        out = s2.eval(data=mx.nd.array(x), w1=mx.nd.array(W),
+                      b1=mx.nd.array(b))[0]
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-6)
+
+
+class TestExecutor:
+    def test_forward_backward(self):
+        s = _mlp()
+        ex = s.simple_bind(grad_req="write", data=(2, 8), w1=(4, 8), b1=(4,))
+        x = onp.random.rand(2, 8).astype(onp.float32)
+        W = onp.random.rand(4, 8).astype(onp.float32)
+        ex.forward(is_train=True, data=x, w1=W, b1=onp.zeros(4, onp.float32))
+        ex.backward(mx.nd.ones((2, 4)))
+        gw = ex.grad_dict["w1"]
+        assert gw.shape == (4, 8)
+        # relu active everywhere (positive inputs) → dW = out_grad^T @ x
+        onp.testing.assert_allclose(gw.asnumpy(),
+                                    onp.ones((2, 4)).T @ x, rtol=1e-4)
+
+    def test_bind_missing_arg_raises(self):
+        with pytest.raises(MXNetError):
+            _mlp().bind(args={"data": mx.nd.zeros((2, 8))})
+
+
+class TestExportImports:
+    def test_dense_roundtrip(self, tmp_path):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(10))
+        net.initialize()
+        x = mx.nd.array(onp.random.rand(3, 20).astype(onp.float32))
+        ref = net(x)
+        prefix = str(tmp_path / "mlp")
+        net.export(prefix)
+        blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                        prefix + "-0000.params")
+        out = blk(x)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+
+    def test_conv_bn_roundtrip(self, tmp_path):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(5))
+        net.initialize()
+        x = mx.nd.array(onp.random.rand(2, 3, 8, 8).astype(onp.float32))
+        net(x)  # one pass to settle shapes
+        ref = net(x)
+        prefix = str(tmp_path / "convnet")
+        net.export(prefix)
+        blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                        prefix + "-0000.params")
+        out = blk(x)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-4, atol=1e-4)
+
+    def test_exported_hybridized_matches(self, tmp_path):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(6))
+        net.initialize()
+        net.hybridize()
+        x = mx.nd.array(onp.random.rand(2, 4).astype(onp.float32))
+        ref = net(x)
+        prefix = str(tmp_path / "h")
+        net.export(prefix)
+        blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                        prefix + "-0000.params")
+        onp.testing.assert_allclose(blk(x).asnumpy(), ref.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+
+    def test_export_before_forward_raises(self, tmp_path):
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        with pytest.raises(MXNetError):
+            net.export(str(tmp_path / "x"))
+
+    def test_symbolblock_trains(self, tmp_path):
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        x = mx.nd.array(onp.random.rand(2, 3).astype(onp.float32))
+        net(x)
+        prefix = str(tmp_path / "t")
+        net.export(prefix)
+        blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                        prefix + "-0000.params")
+        with autograd.record():
+            out = blk(x)
+            loss = (out * out).sum()
+        loss.backward()
+        grads = [p.grad() for p in blk.collect_params().values()
+                 if p.grad_req != "null"]
+        assert any(float(g.abs().sum().asnumpy()) > 0 for g in grads)
+
+
+class TestCapture:
+    def test_capture_records_ops(self):
+        from mxnet_tpu.symbol.symbol import capture
+        x = mx.nd.array(onp.random.rand(2, 3).astype(onp.float32))
+        with capture() as cap:
+            cap.mark_variable("x", x)
+            y = mx.nd.relu(x)
+            z = y + y
+        sym = cap.symbol_for([z])
+        assert sym.list_arguments() == ["x"]
+        out = sym.eval(x=x)[0]
+        onp.testing.assert_allclose(
+            out.asnumpy(), 2 * onp.maximum(x.asnumpy(), 0), rtol=1e-6)
+
+
+class TestMultiOutput:
+    def test_split_heads_and_composition_index(self):
+        x = mx.sym.var("x")
+        parts = mx.sym.split(x, num_outputs=2, axis=0)
+        assert len(parts) == 2
+        net = mx.sym.relu(mx.sym.var("h"))
+        comp = net(h=parts[1])  # must wire to output 1, not output 0
+        res = comp.eval(x=mx.nd.array(onp.array([[-1., 2.], [3., -4.]],
+                                                onp.float32)))[0]
+        onp.testing.assert_allclose(res.asnumpy(), [[3., 0.]])
+
+    def test_group_eval(self):
+        x = mx.sym.var("x")
+        parts = mx.sym.split(x, num_outputs=2, axis=0)
+        outs = parts.eval(x=mx.nd.ones((4, 3)))
+        assert len(outs) == 2 and outs[0].shape == (2, 3)
