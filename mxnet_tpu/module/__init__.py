@@ -1,0 +1,5 @@
+"""Legacy Module API (reference ``python/mxnet/module/``; SURVEY.md §3.2
+"Module API (legacy)" row, §4.3 call stack)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule

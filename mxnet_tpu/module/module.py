@@ -1,0 +1,209 @@
+"""Module — symbol + executor training module.
+
+Reference surface: ``python/mxnet/module/module.py`` (SURVEY.md §4.3):
+``bind`` runs simple_bind (InferShape → allocate), ``init_params``,
+``init_optimizer`` (kvstore), forward/backward/update.
+
+TPU-native: one Executor per Module (no per-GPU ``DataParallelExecutorGroup``
+— data parallelism is a mesh axis, SURVEY.md §3.3); the optimizer update
+runs per-parameter over executor gradients exactly like
+``_update_params_on_kvstore``.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..model import save_checkpoint, load_checkpoint
+from .base_module import BaseModule
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._exec = None
+        self._optimizer = None
+        self._opt_states = {}
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in zip(self.output_names,
+                                             self._exec.outputs)]
+
+    # ------------------------------------------------------------------ #
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write", **kwargs):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = [_as_desc(d) for d in data_shapes]
+        self._label_shapes = [_as_desc(l) for l in (label_shapes or [])]
+        shapes = {d[0]: tuple(d[1]) for d in self._data_shapes}
+        shapes.update({l[0]: tuple(l[1]) for l in self._label_shapes})
+        reqs = {}
+        for n in self._symbol.list_arguments():
+            if n in self._data_names:
+                reqs[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or n in self._fixed_param_names:
+                reqs[n] = "null"
+            else:
+                reqs[n] = grad_req if for_training else "null"
+        if shared_module is not None and shared_module._exec is not None:
+            # bucketing: share parameter arrays with the master module
+            from ..symbol.symbol import infer_args, Executor
+            all_shapes = infer_args(self._symbol, **shapes)
+            args = {}
+            for n in self._symbol.list_arguments():
+                shared = shared_module._exec.arg_dict.get(n)
+                if n in self._param_names and shared is not None:
+                    args[n] = shared
+                else:
+                    args[n] = nd.zeros(all_shapes[n])
+            self._exec = Executor(self._symbol, self._context, args,
+                                  None, reqs)
+        else:
+            self._exec = self._symbol.simple_bind(ctx=self._context,
+                                                  grad_req=reqs, **shapes)
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, **kwargs):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params: call bind first")
+        initializer = initializer or init_mod.Uniform(0.01)
+        for n in self._param_names:
+            arr = self._exec.arg_dict[n]
+            if arg_params is not None and n in arg_params:
+                arr._rebind(nd.array(arg_params[n].asnumpy()
+                                     if hasattr(arg_params[n], "asnumpy")
+                                     else arg_params[n])._data)
+            else:
+                if arg_params is not None and not allow_missing:
+                    raise MXNetError(f"init_params: missing {n}")
+                initializer(init_mod.InitDesc(n), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        return arg, {}
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        idx2name = dict(enumerate(self._param_names))
+        self._optimizer.param_idx2name = idx2name
+        self._opt_states = {}
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------ #
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for n, arr in zip(self._data_names, data_batch.data):
+            feed[n] = arr
+        if self._label_names and data_batch.label is not None:
+            for n, arr in zip(self._label_names, data_batch.label):
+                feed[n] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        if self._optimizer is None:
+            raise MXNetError("update: init_optimizer first")
+        for i, n in enumerate(self._param_names):
+            w = self._exec.arg_dict[n]
+            g = w.grad
+            if g is None:
+                continue
+            if i not in self._opt_states:
+                self._opt_states[i] = self._optimizer.create_state(i, w)
+            self._optimizer.update(i, w, g, self._opt_states[i])
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.arg_dict[n].grad for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg, aux)
+        _orig_init = mod.init_params
+
+        def init_with_loaded(initializer=None, arg_params=None,
+                             aux_params=None, **kw):
+            _orig_init(initializer=initializer,
+                       arg_params=arg_params or arg,
+                       aux_params=aux_params or aux, **kw)
+        mod.init_params = init_with_loaded
+        return mod
+
+
+def _as_desc(d):
+    """Accept DataDesc or (name, shape) tuples."""
+    if hasattr(d, "name"):
+        return (d.name, tuple(d.shape))
+    return (d[0], tuple(d[1]))

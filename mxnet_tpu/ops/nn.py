@@ -411,9 +411,44 @@ def Embedding(data, weight, *, input_dim=0, output_dim=0, dtype="float32",
 def SoftmaxOutput(data, label, *, grad_scale=1.0, ignore_label=-1,
                   multi_output=False, use_ignore=False, preserve_shape=False,
                   normalization="null", out_grad=False, smooth_alpha=0.0):
-    # forward = softmax; the custom gradient of the reference is modeled by
-    # the loss layers instead (gluon.loss.SoftmaxCrossEntropyLoss)
-    return jax.nn.softmax(data, axis=-1)
+    """Reference anchor ``SoftmaxOutput``: forward = softmax; BACKWARD is the
+    cross-entropy gradient ``(p - onehot(label)) * grad_scale`` regardless of
+    the incoming cotangent (unless ``out_grad``) — the semantics the legacy
+    Module training loop relies on (backward with implicit ones)."""
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.softmax(d, axis=-1)
+
+    def fwd(d, l):
+        return jax.nn.softmax(d, axis=-1), (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        p = jax.nn.softmax(d, axis=-1)
+        v = d.shape[-1]
+        if l.shape == d.shape:  # distribution labels
+            onehot = l.astype(d.dtype)
+        else:
+            onehot = jax.nn.one_hot(l.astype(jnp.int32), v, dtype=d.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / v
+        grad = p - onehot
+        scale = grad_scale
+        if use_ignore and l.shape != d.shape:
+            mask = (l.astype(jnp.int32) != int(ignore_label))
+            grad = grad * mask[..., None].astype(d.dtype)
+            if normalization == "valid":
+                scale = scale / jnp.maximum(mask.sum(), 1).astype(d.dtype)
+        if normalization == "batch":
+            scale = scale / d.shape[0]
+        grad = grad * scale
+        if out_grad:
+            grad = grad * g
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
 
 
 @op("CTCLoss")

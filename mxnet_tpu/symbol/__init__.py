@@ -5,7 +5,8 @@ Op builders (``mx.sym.FullyConnected`` …) are generated from the same
 registry that serves ``mx.nd`` — one table, three namespaces (SURVEY.md §7).
 """
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
-                     Executor, capture, current_capture, _make_builder)
+                     Executor, capture, current_capture, infer_args,
+                     _make_builder)
 from ..ops import registry as _registry
 
 # ensure the op corpus is registered before namespace generation

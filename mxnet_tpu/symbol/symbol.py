@@ -269,13 +269,16 @@ class Symbol:
         return Executor(self, ctx, args, args_grad, grad_req)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate arguments and bind (reference ``simple_bind``): shapes
+        for parameters are DEDUCED from the data shapes via the InferShape
+        pass (``infer_args``); only data/label shapes need to be given."""
         from .. import ndarray as nd
         arg_names = self.list_arguments()
-        args = {}
-        for nm in arg_names:
-            if nm not in shapes:
-                raise MXNetError(f"simple_bind: missing shape for {nm}")
-            args[nm] = nd.zeros(shapes[nm])
+        if any(nm not in shapes for nm in arg_names):
+            all_shapes = infer_args(self, **shapes)
+        else:
+            all_shapes = shapes
+        args = {nm: nd.zeros(all_shapes[nm]) for nm in arg_names}
         return Executor(self, ctx, args, None, grad_req)
 
     # -- operators ----------------------------------------------------- #
@@ -441,6 +444,114 @@ def _abstract_eval(heads, feed_structs):
 
 
 # --------------------------------------------------------------------- #
+# forward shape inference (the reference "InferShape" pass, SURVEY.md L4
+# graph passes): walk the graph with known data shapes, deducing parameter
+# shapes from per-op rules (the role FInferShape plays per op), then
+# eval_shape each node for its outputs.
+# --------------------------------------------------------------------- #
+
+def _rule_fc(in_shapes, attrs):
+    d = in_shapes[0]
+    nh = int(attrs.get("num_hidden", 0))
+    flatten = attrs.get("flatten", True)
+    in_units = int(onp.prod(d[1:])) if flatten else d[-1]
+    out = {1: (nh, in_units)}
+    if len(in_shapes) > 2:
+        out[2] = (nh,)
+    return out
+
+
+def _rule_conv(in_shapes, attrs):
+    d = in_shapes[0]  # NCHW
+    nf = int(attrs.get("num_filter", 0))
+    kernel = tuple(attrs.get("kernel", ()))
+    ng = int(attrs.get("num_group", 1))
+    out = {1: (nf, d[1] // ng) + kernel}
+    if len(in_shapes) > 2:
+        out[2] = (nf,)
+    return out
+
+
+def _rule_channel(in_shapes, attrs):
+    c = in_shapes[0][int(attrs.get("axis", 1))]
+    return {i: (c,) for i in range(1, len(in_shapes))}
+
+
+def _rule_lastdim(in_shapes, attrs):
+    c = in_shapes[0][-1]
+    return {i: (c,) for i in range(1, len(in_shapes))}
+
+
+def _rule_embedding(in_shapes, attrs):
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _rule_fc,
+    "Convolution": _rule_conv,
+    "_BatchNormStats": _rule_channel,
+    "InstanceNorm": lambda s, a: {i: (s[0][1],) for i in range(1, len(s))},
+    "GroupNorm": lambda s, a: {i: (s[0][1],) for i in range(1, len(s))},
+    "LayerNorm": _rule_lastdim,
+    "RMSNorm": _rule_lastdim,
+    "Embedding": _rule_embedding,
+}
+
+
+def infer_args(symbol, dtype="float32", **known_shapes):
+    """Deduce every argument's shape given the data/label shapes.  Returns
+    an OrderedDict name -> shape covering all ``list_arguments()``."""
+    known = {k: tuple(v) for k, v in known_shapes.items()}
+    shapes = {}   # id(node) -> [out shapes]
+    arg_shapes = OrderedDict()
+    for node in _topo(symbol._heads):
+        if node.op is None:
+            shp = known.get(node.name) or node.attrs.get("__shape__")
+            if shp is not None:
+                shapes[id(node)] = [tuple(shp)]
+                arg_shapes[node.name] = tuple(shp)
+            else:
+                shapes[id(node)] = [None]
+                arg_shapes[node.name] = None
+            continue
+        in_shapes = []
+        unknown = []
+        for pos, (inp, idx) in enumerate(node.inputs):
+            s = shapes[id(inp)][idx]
+            in_shapes.append(s)
+            if s is None:
+                unknown.append(pos)
+        if unknown:
+            rule = _PARAM_SHAPE_RULES.get(node.op)
+            if rule is None or any(s is None for s in in_shapes[:1]):
+                raise MXNetError(
+                    f"infer_args: cannot deduce shapes of inputs {unknown} "
+                    f"of op {node.op} ({node.name}); provide them explicitly")
+            deduced = rule(in_shapes, node.attrs)
+            for pos in unknown:
+                if pos not in deduced:
+                    raise MXNetError(
+                        f"infer_args: op {node.op} rule left input {pos} "
+                        f"unknown")
+                in_shapes[pos] = deduced[pos]
+                var_node = node.inputs[pos][0]
+                shapes[id(var_node)] = [in_shapes[pos]]
+                if var_node.op is None:
+                    arg_shapes[var_node.name] = in_shapes[pos]
+        # outputs via abstract eval of this single node
+        structs = [jax.ShapeDtypeStruct(s, onp.dtype(dtype))
+                   for s in in_shapes]
+        outs = jax.eval_shape(
+            lambda *xs: _node_outputs_from_invoke(node, list(xs),
+                                                  as_ndarray=False), *structs)
+        shapes[id(node)] = [tuple(o.shape) for o in outs]
+    missing = [k for k, v in arg_shapes.items() if v is None]
+    if missing:
+        raise MXNetError(f"infer_args: unresolved arguments {missing}")
+    return arg_shapes
+
+
+# --------------------------------------------------------------------- #
 # Executor (reference GraphExecutor, src/executor/ — SURVEY.md L4):
 # bind arguments, forward/backward.  Memory planning/fusion = XLA's job.
 # --------------------------------------------------------------------- #
@@ -535,7 +646,7 @@ class Executor:
 # registry's num_outputs attr); callable receives the static attrs
 _MULTI_OUTPUT = {
     "split": lambda attrs: int(attrs.get("num_outputs", 1)),
-    "_BatchNormStats": lambda attrs: 3,
+    "_BatchNormStats": lambda attrs: 5,  # out, new_mm, new_mv, mean, var
     "topk": lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1,
 }
 

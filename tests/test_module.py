@@ -1,0 +1,140 @@
+"""Legacy Module API tests (reference tests/python/unittest/test_module.py
+coverage; SURVEY.md §3.2 Module row, §4.3 call stack)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _mlp_sym(num_classes=5):
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    h = mx.sym.FullyConnected(data, mx.sym.var("fc1_weight"),
+                              mx.sym.var("fc1_bias"), num_hidden=32,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, mx.sym.var("fc2_weight"),
+                              mx.sym.var("fc2_bias"), num_hidden=num_classes,
+                              name="fc2")
+    return mx.sym.SoftmaxOutput(h, label, name="softmax")
+
+
+@pytest.fixture
+def toy_iter():
+    rng = onp.random.RandomState(0)
+    X = rng.rand(200, 20).astype(onp.float32)
+    w = rng.rand(20, 5).astype(onp.float32)
+    y = (X @ w).argmax(axis=1).astype(onp.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=25, shuffle=True)
+
+
+class TestModule:
+    def test_fit_learns(self, toy_iter):
+        mod = Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(toy_iter, num_epoch=6, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.5),),
+                initializer=mx.init.Xavier())
+        acc = mod.score(toy_iter, "acc")[0][1]
+        assert acc > 0.75, acc
+
+    def test_bind_infers_param_shapes(self):
+        mod = Module(_mlp_sym(), context=mx.cpu())
+        mod.bind([("data", (4, 20))], [("softmax_label", (4,))])
+        assert mod._exec.arg_dict["fc1_weight"].shape == (32, 20)
+        assert mod._exec.arg_dict["fc2_weight"].shape == (5, 32)
+
+    def test_forward_shape_and_predict(self, toy_iter):
+        mod = Module(_mlp_sym(), context=mx.cpu())
+        mod.bind(toy_iter.provide_data, toy_iter.provide_label,
+                 for_training=False)
+        mod.init_params(initializer=mx.init.Xavier())
+        preds = mod.predict(toy_iter)
+        assert preds.shape == (200, 5)
+        # rows are softmax distributions
+        onp.testing.assert_allclose(preds.asnumpy().sum(axis=1),
+                                    onp.ones(200), rtol=1e-4)
+
+    def test_checkpoint_roundtrip(self, toy_iter, tmp_path):
+        mod = Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(toy_iter, num_epoch=2, initializer=mx.init.Xavier())
+        ref = mod.score(toy_iter, "acc")[0][1]
+        prefix = str(tmp_path / "ck")
+        mod.save_checkpoint(prefix, 2)
+        mod2 = Module.load(prefix, 2, context=mx.cpu())
+        mod2.bind(toy_iter.provide_data, toy_iter.provide_label,
+                  for_training=False)
+        mod2.init_params()
+        assert abs(mod2.score(toy_iter, "acc")[0][1] - ref) < 1e-6
+
+    def test_score_before_bind_raises(self, toy_iter):
+        mod = Module(_mlp_sym(), context=mx.cpu())
+        with pytest.raises(MXNetError):
+            mod.score(toy_iter, "acc")
+
+    def test_fixed_params_not_updated(self, toy_iter):
+        mod = Module(_mlp_sym(), context=mx.cpu(),
+                     fixed_param_names=["fc1_weight"])
+        mod.bind(toy_iter.provide_data, toy_iter.provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.5),))
+        before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+        batch = next(iter(toy_iter))
+        mod.forward_backward(batch)
+        mod.update()
+        onp.testing.assert_array_equal(
+            mod._exec.arg_dict["fc1_weight"].asnumpy(), before)
+
+
+class TestSoftmaxOutputGrad:
+    def test_ce_gradient_semantics(self):
+        """backward(ones) through SoftmaxOutput == p - onehot (reference)."""
+        from mxnet_tpu import autograd
+        x = mx.nd.array(onp.random.rand(3, 4).astype(onp.float32))
+        y = mx.nd.array(onp.array([1, 3, 0], onp.float32))
+        x.attach_grad()
+        with autograd.record():
+            out = mx.nd.SoftmaxOutput(x, y)
+        out.backward()
+        p = onp.exp(x.asnumpy()) / onp.exp(x.asnumpy()).sum(1, keepdims=True)
+        onehot = onp.eye(4, dtype=onp.float32)[[1, 3, 0]]
+        onp.testing.assert_allclose(x.grad.asnumpy(), p - onehot, rtol=1e-4,
+                                    atol=1e-5)
+
+
+class TestBucketing:
+    @staticmethod
+    def _sym_gen(seq_len):
+        d = mx.sym.var("data")
+        l = mx.sym.var("softmax_label")
+        f = mx.sym.FullyConnected(d, mx.sym.var("fc_weight"),
+                                  mx.sym.var("fc_bias"), num_hidden=4,
+                                  flatten=False, name="fc")
+        return (mx.sym.SoftmaxOutput(f, l, multi_output=True),
+                ("data",), ("softmax_label",))
+
+    def test_buckets_share_params(self):
+        bm = BucketingModule(self._sym_gen, default_bucket_key=10,
+                             context=mx.cpu())
+        bm.bind([("data", (8, 10, 5))], [("softmax_label", (8, 10))])
+        bm.init_params(initializer=mx.init.Xavier())
+        bm.init_optimizer(optimizer="sgd",
+                          optimizer_params=(("learning_rate", 0.1),))
+        rng = onp.random.RandomState(0)
+        for key in (10, 6, 10, 6):
+            b = DataBatch(
+                data=[mx.nd.array(rng.rand(8, key, 5).astype(onp.float32))],
+                label=[mx.nd.array(rng.randint(0, 4, (8, key))
+                                   .astype(onp.float32))],
+                bucket_key=key,
+                provide_data=[("data", (8, key, 5))],
+                provide_label=[("softmax_label", (8, key))])
+            bm.forward(b, is_train=True)
+            bm.backward()
+            bm.update()
+        assert sorted(bm._buckets) == [6, 10]
+        assert (bm._buckets[6]._exec.arg_dict["fc_weight"]
+                is bm._buckets[10]._exec.arg_dict["fc_weight"])
