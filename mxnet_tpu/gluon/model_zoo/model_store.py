@@ -1,0 +1,37 @@
+"""Pretrained-weight store (reference
+``gluon/model_zoo/model_store.py``).
+
+This environment has no network egress, so ``pretrained=True`` resolves
+against a local cache directory only (``$MXNET_HOME/models`` or
+``~/.mxnet/models``) and raises a clear error when the file is absent.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+
+def get_model_file(name, root=None):
+    root = os.path.expanduser(root or os.path.join(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")), "models"))
+    fname = os.path.join(root, f"{name}.params")
+    if os.path.isfile(fname):
+        return fname
+    raise MXNetError(
+        f"pretrained weights for {name!r} not found at {fname}; this "
+        f"environment has no network egress — place the .params file there "
+        f"manually, or use pretrained=False")
+
+
+def load_pretrained(net, name, ctx=None, root=None):
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net
+
+
+def purge(root=None):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

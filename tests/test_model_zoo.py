@@ -1,0 +1,82 @@
+"""Model zoo tests (reference tests/python/unittest/test_gluon_model_zoo.py).
+
+Forward passes use thumbnail/small inputs to stay fast on the CPU-mesh CI
+runner; the full 224/299 forwards of every family were validated on build
+(all produce (N, classes) logits).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import get_model
+from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+
+
+class TestGetModel:
+    def test_unknown_raises(self):
+        with pytest.raises(MXNetError):
+            get_model("resnet9000")
+
+    def test_registry_families_construct(self):
+        # one representative per family constructs + has params
+        for name in ["resnet34_v2", "vgg13", "alexnet", "densenet169",
+                     "squeezenet1.1", "inceptionv3", "mobilenet0.5",
+                     "mobilenetv2_0.5"]:
+            net = get_model(name, classes=10)
+            assert len(net.collect_params()) > 0, name
+
+
+class TestForward:
+    def test_resnet18_v1_thumbnail_cifar(self):
+        net = get_resnet(1, 18, thumbnail=True, classes=10)
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(2, 3, 32, 32).astype(onp.float32))
+        y = net(x)
+        assert y.shape == (2, 10)
+
+    def test_resnet18_v2_thumbnail(self):
+        net = get_resnet(2, 18, thumbnail=True, classes=10)
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(2, 3, 32, 32).astype(onp.float32))
+        assert net(x).shape == (2, 10)
+
+    def test_resnet_hybridize_matches_eager(self):
+        net = get_resnet(1, 18, thumbnail=True, classes=10)
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(2, 3, 32, 32).astype(onp.float32))
+        ref = net(x)  # eager (and settles BN batch stats usage: predict)
+        net.hybridize()
+        out = net(x)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-4, atol=1e-4)
+
+    def test_vgg11_small(self):
+        net = get_model("vgg11", classes=10)
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(1, 3, 32, 32).astype(onp.float32))
+        assert net(x).shape == (1, 10)
+
+    def test_resnet50_bottleneck_shapes(self):
+        net = get_resnet(1, 50, thumbnail=True, classes=4)
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(1, 3, 32, 32).astype(onp.float32))
+        assert net(x).shape == (1, 4)
+
+    def test_resnet_trains(self):
+        from mxnet_tpu import gluon, autograd
+        net = get_resnet(1, 18, thumbnail=True, classes=10)
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = mx.nd.array(onp.random.rand(4, 3, 32, 32).astype(onp.float32))
+        y = mx.nd.array(onp.array([0, 1, 2, 3], onp.float32))
+        losses = []
+        for _ in range(3):
+            with autograd.record():
+                L = loss_fn(net(x), y)
+            L.backward()
+            trainer.step(4)
+            losses.append(float(L.mean().asnumpy()))
+        assert losses[-1] < losses[0]
