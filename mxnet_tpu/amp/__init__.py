@@ -1,0 +1,6 @@
+"""``mx.amp`` — automatic mixed precision (reference
+``python/mxnet/contrib/amp/``; SURVEY.md §3.2 "AMP" row)."""
+from .amp import (init, init_trainer, scale_loss, convert_model,
+                  convert_hybrid_block, _uninit)
+from .loss_scaler import LossScaler
+from . import lists

@@ -804,3 +804,36 @@ def ones_like(data):
 @op("full_like")
 def full_like(data, *, fill_value=0.0):
     return jnp.full_like(data, fill_value)
+
+
+# ----------------------------------------------------------------------- #
+# AMP support ops (reference anchors ``all_finite`` / ``multi_all_finite``
+# in src/operator/contrib — the overflow probes the dynamic LossScaler uses)
+# ----------------------------------------------------------------------- #
+
+@op("all_finite", differentiable=False)
+def all_finite(data, *, init_output=True):
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@op("multi_all_finite", differentiable=False, variadic=True)
+def multi_all_finite(*arrays, num_arrays=0, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@op("amp_cast")
+def amp_cast(data, *, dtype="float16"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@op("amp_multicast", differentiable=True, variadic=True)
+def amp_multicast(*arrays, num_outputs=0, cast_narrow=False):
+    """Cast all inputs to the widest (or narrowest) common float dtype."""
+    dtypes = [a.dtype for a in arrays]
+    pick = min if cast_narrow else max
+    target = pick(dtypes, key=lambda d: jnp.finfo(d).bits
+                  if jnp.issubdtype(d, jnp.floating) else 0)
+    return tuple(a.astype(target) for a in arrays)

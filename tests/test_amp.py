@@ -1,0 +1,119 @@
+"""AMP tests (reference tests/python/gpu/test_amp.py coverage;
+SURVEY.md §3.2 "AMP")."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture
+def amp_env():
+    amp.init(target_dtype="bfloat16")
+    yield
+    amp._uninit()
+
+
+class TestCastPolicy:
+    def test_target_ops_cast_to_bf16(self, amp_env):
+        a = mx.nd.array(onp.random.rand(4, 8).astype(onp.float32))
+        b = mx.nd.array(onp.random.rand(8, 5).astype(onp.float32))
+        out = mx.nd.dot(a, b)
+        assert str(out.dtype) == "bfloat16"
+
+    def test_fp32_ops_stay_fp32(self, amp_env):
+        x = mx.nd.array(onp.random.rand(4, 8).astype(onp.float32))
+        low = x.astype("bfloat16")
+        out = mx.nd.softmax(low)
+        assert str(out.dtype) == "float32"
+
+    def test_widest_cast(self, amp_env):
+        lo = mx.nd.array(onp.ones((3,), onp.float32)).astype("bfloat16")
+        hi = mx.nd.array(onp.ones((3,), onp.float32))
+        out = mx.nd.broadcast_add(lo, hi)
+        assert str(out.dtype) == "float32"
+
+    def test_double_init_is_noop(self, amp_env):
+        amp.init()  # second call must not re-wrap
+        a = mx.nd.array(onp.random.rand(2, 2).astype(onp.float32))
+        assert str(mx.nd.dot(a, a).dtype) == "bfloat16"
+
+    def test_init_rejects_bad_dtype(self):
+        with pytest.raises(MXNetError):
+            amp.init(target_dtype="int8")
+
+
+class TestLossScaler:
+    def test_dynamic_scaling(self):
+        ls = amp.LossScaler(init_scale=1024, scale_window=2)
+        ls.update_scale(False)
+        ls.update_scale(False)
+        assert ls.loss_scale == 2048
+        ls.update_scale(True)
+        assert ls.loss_scale == 1024
+
+    def test_overflow_detection(self):
+        from mxnet_tpu.gluon import Parameter
+        p = Parameter("w", shape=(3,))
+        p.initialize()
+        p._data._grad = mx.nd.array(onp.array([1.0, onp.inf, 2.0],
+                                              onp.float32))
+        ls = amp.LossScaler()
+        assert ls.has_overflow([p])
+        p._data._grad = mx.nd.array(onp.ones(3, onp.float32))
+        assert not ls.has_overflow([p])
+
+
+class TestTrainerIntegration:
+    def test_fp16_training_with_scaler(self, amp_env):
+        net = gluon.nn.Dense(4)
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        amp.init_trainer(trainer)
+        x = mx.nd.array(onp.random.rand(8, 6).astype(onp.float32))
+        y = mx.nd.array(onp.random.rand(8, 4).astype(onp.float32))
+        loss_fn = gluon.loss.L2Loss()
+        losses = []
+        for _ in range(5):
+            with autograd.record():
+                out = net(x)
+                L = loss_fn(out.astype("float32"), y)
+            with amp.scale_loss(L, trainer) as scaled:
+                scaled.backward()
+            trainer.step(8)
+            losses.append(float(L.mean().asnumpy()))
+        assert losses[-1] < losses[0]
+
+    def test_overflow_skips_update(self, amp_env):
+        net = gluon.nn.Dense(2)
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        amp.init_trainer(trainer)
+        scaler = trainer._amp_loss_scaler
+        scaler.loss_scale = 4.0
+        x = mx.nd.array(onp.random.rand(2, 3).astype(onp.float32))
+        with autograd.record():
+            out = net(x)
+            L = (out * out).sum()
+        L.backward()
+        # poison one gradient with inf
+        params = list(net.collect_params().values())
+        w = params[0]
+        w._data._grad = w.grad() * onp.inf
+        before = w.data().asnumpy().copy()
+        trainer.step(2)
+        onp.testing.assert_array_equal(w.data().asnumpy(), before)
+        assert scaler.loss_scale == 2.0  # halved on overflow
+
+
+class TestConvert:
+    def test_convert_hybrid_block(self):
+        net = gluon.nn.Dense(4)
+        net.initialize(mx.init.Xavier())
+        amp.convert_hybrid_block(net, target_dtype="bfloat16")
+        x = mx.nd.array(onp.random.rand(2, 3).astype(onp.float32))
+        out = net(x.astype("bfloat16"))
+        assert str(out.dtype) == "bfloat16"
