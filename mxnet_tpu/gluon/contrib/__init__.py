@@ -1,0 +1,5 @@
+"""Gluon contrib (reference ``python/mxnet/gluon/contrib/``; SURVEY.md §3.2
+"Gluon contrib" row)."""
+from . import nn
+from . import rnn
+from . import estimator

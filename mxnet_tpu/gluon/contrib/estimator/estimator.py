@@ -1,0 +1,121 @@
+"""Estimator (reference ``gluon/contrib/estimator/estimator.py``):
+``est.fit(train_data, val_data, epochs, event_handlers)`` — the high-level
+fit loop with an event-handler system."""
+from __future__ import annotations
+
+import logging
+
+from ....base import MXNetError
+from .... import metric as metric_mod
+from ... import Trainer
+from ... import loss as loss_mod
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, LoggingHandler, ValidationHandler)
+
+
+class Estimator:
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        from .... import init as init_mod, context as ctx_mod
+        self.net = net
+        if not isinstance(loss, loss_mod.Loss):
+            raise MXNetError("loss must be a gluon Loss")
+        self.loss = loss
+        metrics = metrics or []
+        self.train_metrics = metrics if isinstance(metrics, list) \
+            else [metrics]
+        self.context = context or ctx_mod.current_context()
+        if not self._net_initialized():
+            self.net.initialize(initializer or init_mod.Xavier(),
+                                ctx=self.context)
+        self.trainer = trainer or Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 1e-3})
+        self.val_metrics = [type(m)() for m in self.train_metrics]
+        self.logger = logging.getLogger("estimator")
+
+    def _net_initialized(self):
+        for p in self.net.collect_params().values():
+            if p._data is None and p._deferred_init is None:
+                return False
+        return True
+
+    # ------------------------------------------------------------------ #
+    def evaluate(self, val_data, batch_axis=0):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._unpack(batch)
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+            for m in self.val_metrics:
+                if getattr(m, "name", "").startswith("loss"):
+                    m.update(0, loss)
+                else:
+                    m.update(label, pred)
+        return [(m.name, m.get()[1]) for m in self.val_metrics]
+
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        if hasattr(batch, "data"):
+            return batch.data[0], batch.label[0]
+        raise MXNetError("cannot unpack batch")
+
+    def _sorted(self, handlers, kind):
+        hs = [h for h in handlers if isinstance(h, kind)]
+        return sorted(hs, key=lambda h: getattr(h, "priority", 0))
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        from .... import autograd
+        if epochs is None and batches is None:
+            raise MXNetError("fit: give epochs or batches")
+        handlers = list(event_handlers or [])
+        stopper = StoppingHandler(epochs, batches)
+        handlers.append(stopper)
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+
+        train_begin = self._sorted(handlers, TrainBegin)
+        epoch_begin = self._sorted(handlers, EpochBegin)
+        batch_begin = self._sorted(handlers, BatchBegin)
+        batch_end = self._sorted(handlers, BatchEnd)
+        epoch_end = self._sorted(handlers, EpochEnd)
+        train_end = self._sorted(handlers, TrainEnd)
+
+        for h in train_begin:
+            h.train_begin(self)
+        while not stopper.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                data, label = self._unpack(batch)
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[batch_axis])
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=pred, label=label,
+                                loss=loss)
+                if stopper.stop_training:
+                    break
+            for h in epoch_end:
+                h.epoch_end(self)
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            for h in [x for x in handlers
+                      if getattr(x, "stop_training", False)]:
+                stopper.stop_training = True
+        for h in train_end:
+            h.train_end(self)
+        return self

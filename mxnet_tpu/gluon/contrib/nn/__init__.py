@@ -1,0 +1,5 @@
+"""Contrib layers (reference ``gluon/contrib/nn/basic_layers.py``)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, PixelShuffle1D, PixelShuffle2D,
+                           PixelShuffle3D)
+from ...nn import SyncBatchNorm  # reference exposes it under contrib.nn

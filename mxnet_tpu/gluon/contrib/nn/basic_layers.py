@@ -1,0 +1,111 @@
+"""Contrib layers (reference ``gluon/contrib/nn/basic_layers.py``):
+Concurrent/HybridConcurrent, Identity, SparseEmbedding, PixelShuffle{1,2,3}D.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential, Embedding
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs on ``axis``."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        return self.forward(x)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Reference: embedding with ``sparse_grad=True`` (row_sparse gradient
+    pulled row-wise from the PS).  XLA is dense-only (SURVEY.md §3.3 sparse
+    row): gradients here are dense; the API is kept so reference code runs,
+    and large tables should instead be GSPMD-sharded over the mesh."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer, **kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factor = tuple(int(f) for f in factor)
+        self._ndim = ndim
+
+    def __repr__(self):
+        return f"{type(self).__name__}(factor={self._factor})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) → (N, C, W*f) (reference ``PixelShuffle1D``)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f, = self._factor
+        n, cf, w = x.shape
+        x = x.reshape((n, cf // f, f, w))
+        x = F.transpose(x, axes=(0, 1, 3, 2))
+        return x.reshape((n, cf // f, w * f))
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) → (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factor
+        n, c, h, w = x.shape
+        co = c // (f1 * f2)
+        x = x.reshape((n, co, f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return x.reshape((n, co, h * f1, w * f2))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) → (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factor
+        n, c, d, h, w = x.shape
+        co = c // (f1 * f2 * f3)
+        x = x.reshape((n, co, f1, f2, f3, d, h, w))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return x.reshape((n, co, d * f1, h * f2, w * f3))

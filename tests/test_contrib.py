@@ -1,0 +1,129 @@
+"""gluon.contrib tests (reference tests/python/unittest/test_gluon_contrib.py
+coverage; SURVEY.md §3.2 "Gluon contrib")."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.gluon.contrib.estimator import (Estimator, CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               StoppingHandler)
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+
+class TestContribNN:
+    def test_pixel_shuffle_2d_matches_torch(self):
+        import torch
+        ps = cnn.PixelShuffle2D(2)
+        x = mx.nd.array(onp.arange(72).reshape(1, 8, 3, 3)
+                        .astype(onp.float32))
+        ref = torch.pixel_shuffle(torch.tensor(x.asnumpy()), 2).numpy()
+        onp.testing.assert_allclose(ps(x).asnumpy(), ref)
+
+    def test_pixel_shuffle_1d_3d_shapes(self):
+        assert cnn.PixelShuffle1D(3)(mx.nd.ones((2, 6, 5))).shape == (2, 2, 15)
+        assert cnn.PixelShuffle3D((2, 2, 2))(
+            mx.nd.ones((1, 8, 2, 3, 4))).shape == (1, 1, 4, 6, 8)
+
+    def test_concurrent_and_identity(self):
+        hc = cnn.HybridConcurrent(axis=1)
+        hc.add(cnn.Identity())
+        hc.add(cnn.Identity())
+        assert hc(mx.nd.ones((2, 3))).shape == (2, 6)
+
+    def test_sparse_embedding_forward(self):
+        emb = cnn.SparseEmbedding(10, 4)
+        emb.initialize(mx.init.Xavier())
+        out = emb(mx.nd.array(onp.array([1, 3], onp.float32)))
+        assert out.shape == (2, 4)
+
+
+class TestConvRNN:
+    def test_conv2d_lstm_unroll(self):
+        cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=5,
+                                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize(mx.init.Xavier())
+        seq = mx.nd.array(onp.random.rand(2, 4, 3, 8, 8).astype(onp.float32))
+        outputs, states = cell.unroll(4, seq, layout="NTC")
+        assert outputs.shape == (2, 4, 5, 8, 8)
+        assert states[0].shape == (2, 5, 8, 8)
+        assert states[1].shape == (2, 5, 8, 8)
+
+    def test_conv1d_gru_unroll(self):
+        cell = crnn.Conv1DGRUCell(input_shape=(2, 6), hidden_channels=4,
+                                  i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize(mx.init.Xavier())
+        o, s = cell.unroll(3, mx.nd.ones((2, 3, 2, 6)), layout="NTC")
+        assert o.shape == (2, 3, 4, 6)
+
+    def test_even_h2h_kernel_rejected(self):
+        from mxnet_tpu.base import MXNetError
+        with pytest.raises(MXNetError):
+            crnn.Conv2DRNNCell(input_shape=(3, 8, 8), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=2)
+
+    def test_variational_dropout_cell(self):
+        from mxnet_tpu import autograd
+        from mxnet_tpu.gluon.rnn import LSTMCell
+        base = LSTMCell(8)
+        cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+        cell.initialize(mx.init.Xavier())
+        x = mx.nd.ones((2, 5, 4))
+        with autograd.record():
+            out, _ = cell.unroll(5, x, layout="NTC")
+        assert out.shape == (2, 5, 8)
+
+
+class TestEstimator:
+    def _data(self):
+        rng = onp.random.RandomState(0)
+        X = rng.rand(80, 10).astype(onp.float32)
+        Y = (X.sum(1) > 5).astype(onp.float32)
+        return DataLoader(ArrayDataset(X, Y), batch_size=16)
+
+    def test_fit_and_evaluate(self):
+        dl = self._data()
+        net = gluon.nn.Dense(2)
+        net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.5})
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=[mx.metric.Accuracy()], trainer=trainer,
+                        context=mx.cpu())
+        est.fit(dl, epochs=8)
+        res = dict(est.evaluate(dl))
+        assert res["accuracy"] > 0.7
+
+    def test_checkpoint_handler(self, tmp_path):
+        dl = self._data()
+        net = gluon.nn.Dense(2)
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=[mx.metric.Accuracy()], context=mx.cpu())
+        ck = str(tmp_path / "ckpts")
+        est.fit(dl, epochs=2,
+                event_handlers=[CheckpointHandler(ck, save_best=True,
+                                                  monitor=est.train_metrics[0])])
+        files = os.listdir(ck)
+        assert any("epoch" in f for f in files)
+        assert any("best" in f for f in files)
+
+    def test_stopping_by_batches(self):
+        dl = self._data()
+        net = gluon.nn.Dense(2)
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=[mx.metric.Accuracy()], context=mx.cpu())
+        est.fit(dl, batches=3)
+
+    def test_early_stopping(self):
+        dl = self._data()
+        net = gluon.nn.Dense(2)
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        metrics=[mx.metric.Accuracy()], context=mx.cpu())
+        es = EarlyStoppingHandler(monitor=est.train_metrics[0], patience=1)
+        est.fit(dl, epochs=20, event_handlers=[es])
+        # with patience 1 on a tiny problem, must stop well before 20
+        assert es.current_epoch < 20
