@@ -1,0 +1,46 @@
+"""Gradient compression (reference ``GradientCompression`` in
+``src/kvstore/gradient_compression.cc``; SURVEY.md §3.1 KVStore row:
+"2-bit with error-feedback residual").
+
+2-bit scheme: each gradient element quantizes to {-threshold, 0,
++threshold}; the quantization error is kept in a per-key residual and added
+to the next gradient (error feedback).  On TPU the quantize/dequantize pair
+compiles to one fused XLA kernel; the wire benefit applies on the DCN hop
+(SURVEY.md §3.3 "int8/bf16 compression before DCN allreduce").
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
+        if type not in ("2bit", "1bit"):
+            raise MXNetError(f"unsupported compression type {type!r}")
+        self.type = type
+        self.threshold = float(threshold)
+        if self.type == "2bit" and self.threshold <= 0:
+            raise MXNetError("2bit compression needs threshold > 0")
+        self._residual = {}
+
+    def compress(self, key, grad):
+        """→ quantized gradient (same shape, values in {-t, 0, +t} for 2bit
+        or {-t, +t} for 1bit); residual updated with the quantization
+        error."""
+        r = self._residual.get(key)
+        g = grad + r if r is not None else grad
+        t = self.threshold
+        if self.type == "2bit":
+            q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0))
+        else:  # 1bit: sign * threshold
+            q = jnp.where(g >= 0, t, -t)
+        self._residual[key] = g - q
+        return q.astype(grad.dtype)
+
+    def decompress(self, key, q):
+        return q  # values are already in gradient units
+
+    def reset(self):
+        self._residual.clear()

@@ -11,6 +11,17 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["KVStore", "create"]
 
+
+def _cross_process_sum(x):
+    """Sum an array across processes (the reference's worker→server→worker
+    hop; here one DCN allreduce via a psum over a global process mesh).
+
+    Requires ``jax.distributed.initialize`` to have run (see
+    ``mxnet_tpu.parallel.init_distributed`` / ``tools/launch.py``)."""
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)
+    return jnp.sum(gathered, axis=0)
+
 _KNOWN_TYPES = ("local", "device", "nccl", "tpu", "dist_sync", "dist_async",
                 "dist_device_sync", "dist")
 
@@ -32,6 +43,7 @@ class KVStore:
         self._optimizer = None
         self._opt_states: dict = {}
         self._compression_params = None
+        self._compression = None
 
     # -- identity ---------------------------------------------------------- #
     @property
@@ -68,16 +80,24 @@ class KVStore:
                 self._optimizer.create_state_multi_precision(
                     key, self._store[key])
 
-    def _merge(self, value):
+    def _merge(self, value, key=None):
         """Sum a per-device value list (reference: CommDevice tree-reduce /
-        NCCL ring; here one fused add chain — on one chip it's identity)."""
+        NCCL ring; here one fused add chain — on one chip it's identity).
+        For ``dist_*`` stores the local sum is then reduced ACROSS
+        PROCESSES (the ps-lite hop → DCN allreduce, SURVEY.md §5.8), with
+        optional 2-bit compression + error feedback on the wire value."""
         if not isinstance(value, (list, tuple)):
-            return value._data
-        if len(value) == 1:
-            return value[0]._data
-        acc = value[0]._data
-        for v in value[1:]:
-            acc = acc + v._data
+            acc = value._data
+        elif len(value) == 1:
+            acc = value[0]._data
+        else:
+            acc = value[0]._data
+            for v in value[1:]:
+                acc = acc + v._data
+        if self._compression is not None and key is not None:
+            acc = self._compression.compress(key, acc)
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            acc = _cross_process_sum(acc)
         return acc
 
     def push(self, key, value, priority=0):
@@ -88,7 +108,7 @@ class KVStore:
         key = str(key)
         if key not in self._store:
             raise MXNetError(f"kvstore key {key} not initialized")
-        merged = self._merge(value)
+        merged = self._merge(value, key)
         if self._optimizer is not None:
             # optimizer-on-server semantics (KVStoreDistServer)
             w = self._store[key]
@@ -129,7 +149,7 @@ class KVStore:
                 self.pull(key, out, priority)
             return
         # pure allreduce path (Trainer update_on_kvstore=False)
-        merged = self._merge(value)
+        merged = self._merge(value, key)
         if out is None:
             if key not in self._store:
                 raise MXNetError(f"kvstore key {key} not initialized")
@@ -194,14 +214,21 @@ class KVStore:
             self._optimizer = payload["optimizer"]
 
     def set_gradient_compression(self, compression_params):
-        """Accepted for API parity; on-wire compression maps to bf16/int8
-        cast before DCN allreduce (SURVEY.md §3.3) — applied in the
-        dist path."""
+        """Enable 2-bit gradient compression with error-feedback residual
+        (reference ``GradientCompression``; SURVEY.md §3.1 KVStore row)."""
+        from .compression import GradientCompression
         self._compression_params = compression_params
+        params = dict(compression_params or {})
+        self._compression = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
 
     def barrier(self):
         from ..ndarray.ndarray import waitall
         waitall()
+        if self._kind.startswith("dist") and self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
 
     def _wait(self, keys):
         for k in (keys if isinstance(keys, (list, tuple)) else [keys]):

@@ -1,0 +1,48 @@
+"""KVStore server role (reference ``python/mxnet/kvstore/kvstore_server.py``;
+SURVEY.md §4.4: server processes run an event loop applying pushes and
+serving pulls).
+
+TPU-native reality: there IS no separate server process — the parameter
+server collapses into XLA collectives over the device mesh (SURVEY.md §5.8),
+so the reference's worker/server/scheduler roles map onto the single
+``jax.distributed`` process group.  This module keeps the reference's import
+surface and launch protocol working:
+
+- ``DMLC_ROLE=worker`` (or unset): no-op, training proceeds.
+- ``DMLC_ROLE=server`` / ``scheduler``: the process joins the
+  ``jax.distributed`` group (so barriers and coordination work for code
+  that still launches dedicated server ranks) and then parks in the
+  reference server loop shape until the job ends.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+
+class KVStoreServer:
+    """API-compatible stand-in for the reference ``KVStoreServer``."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handlers = {}
+
+    def run(self):
+        logging.info(
+            "mxnet_tpu kvstore server role: parameter-server duties are "
+            "subsumed by XLA collectives; this process idles for protocol "
+            "compatibility. Launch workers only (tools/launch.py -s 0) to "
+            "avoid paying for this process.")
+        while os.environ.get("DMLC_ROLE") in ("server", "scheduler"):
+            time.sleep(60)
+
+
+def _init_kvstore_server_module():
+    """Reference import hook: start the server loop when this process was
+    launched in a server role."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        from . import create
+        server = KVStoreServer(create("dist_sync"))
+        server.run()

@@ -1,0 +1,137 @@
+"""Distributed kvstore machinery tests: gradient compression, dist kinds,
+launcher protocol (reference tests/nightly/dist_sync_kvstore.py coverage;
+SURVEY.md §3.1 KVStore row, §4.4).
+
+Real multi-process DCN runs need multiple hosts; here we verify the
+single-process degradation (dist == local semantics) and the compression
+math, mirroring the reference's localhost nightly pattern.
+"""
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore import create
+from mxnet_tpu.kvstore.compression import GradientCompression
+
+
+class TestGradientCompression:
+    def test_2bit_quantization_levels(self):
+        gc = GradientCompression(threshold=0.5)
+        g = onp.array([0.7, -0.7, 0.1, -0.1, 0.5], onp.float32)
+        q = onp.asarray(gc.compress("k", mx.nd.array(g)._data))
+        onp.testing.assert_allclose(q, [0.5, -0.5, 0.0, 0.0, 0.5])
+
+    def test_error_feedback_accumulates(self):
+        """Small gradients must not be lost — the residual carries them
+        until they cross the threshold (reference error-feedback)."""
+        gc = GradientCompression(threshold=0.5)
+        g = mx.nd.array(onp.full(4, 0.2, onp.float32))._data
+        total = onp.zeros(4, onp.float32)
+        for _ in range(10):
+            total += onp.asarray(gc.compress("k", g))
+        # 10 * 0.2 = 2.0 sent in units of 0.5 → exactly 4 pulses worth ± one
+        onp.testing.assert_allclose(total, onp.full(4, 2.0), atol=0.5)
+
+    def test_1bit_signs(self):
+        gc = GradientCompression(type="1bit", threshold=0.25)
+        q = onp.asarray(gc.compress(
+            "k", mx.nd.array(onp.array([3.0, -3.0], onp.float32))._data))
+        onp.testing.assert_allclose(q, [0.25, -0.25])
+
+    def test_bad_type_rejected(self):
+        with pytest.raises(MXNetError):
+            GradientCompression(type="4bit")
+
+
+class TestDistKVStore:
+    def test_dist_sync_single_process_is_local(self):
+        kv = create("dist_sync")
+        assert kv.num_workers == 1
+        kv.init(0, mx.nd.array(onp.zeros(3, onp.float32)))
+        out = mx.nd.zeros(3)
+        kv.pushpull(0, [mx.nd.ones(3), mx.nd.ones(3)], out=out)
+        onp.testing.assert_allclose(out.asnumpy(), onp.full(3, 2.0))
+
+    def test_compression_in_store(self):
+        kv = create("dist_sync")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("w", mx.nd.zeros(3))
+        out = mx.nd.zeros(3)
+        kv.pushpull("w", mx.nd.array(onp.array([0.9, -0.9, 0.1],
+                                               onp.float32)), out=out)
+        onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0])
+
+    def test_optimizer_on_server_semantics(self):
+        kv = create("dist_sync")
+        kv.init(0, mx.nd.ones(2))
+        opt = mx.optimizer.create("sgd", learning_rate=0.5)
+        kv.set_optimizer(opt)
+        kv.push(0, mx.nd.ones(2))  # w <- w - 0.5*1
+        out = mx.nd.zeros(2)
+        kv.pull(0, out)
+        onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.5])
+
+
+class TestLauncher:
+    def test_dry_run_env_protocol(self):
+        out = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "3", "--dry-run",
+             "python", "train.py"],
+            capture_output=True, text=True, cwd="/root/repo")
+        lines = [l for l in out.stdout.splitlines() if l.startswith("[rank")]
+        assert len(lines) == 3
+        assert "MXNET_NUM_WORKERS=3" in lines[0]
+        assert "MXNET_WORKER_ID=2" in lines[2]
+        assert "MXNET_COORDINATOR=127.0.0.1:" in lines[0]
+        assert "DMLC_ROLE=worker" in lines[0]
+
+    def test_local_launch_runs_processes(self):
+        code = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "2", "--launcher",
+             "local", sys.executable, "-c",
+             "import os; assert os.environ['MXNET_NUM_WORKERS']=='2'; "
+             "print('RANK%s' % os.environ['MXNET_WORKER_ID'], flush=True)"],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert code.returncode == 0, code.stderr
+        assert "RANK0" in code.stdout and "RANK1" in code.stdout
+
+    def test_missing_command_errors(self):
+        out = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "1"],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert out.returncode != 0
+
+    def test_real_two_process_allreduce(self, tmp_path):
+        """The reference's nightly localhost multi-process pattern
+        (SURVEY.md §4 test strategy): two processes join via the launcher
+        and pushpull must sum across them."""
+        script = tmp_path / "dist_prog.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import mxnet_tpu as mx\n"
+            "from mxnet_tpu.parallel import init_distributed\n"
+            "init_distributed()\n"
+            "import jax, numpy as onp\n"
+            "rank = jax.process_index()\n"
+            "kv = mx.kv.create('dist_sync')\n"
+            "kv.init(0, mx.nd.zeros(4))\n"
+            "out = mx.nd.zeros(4)\n"
+            "kv.pushpull(0, mx.nd.array(onp.full(4, float(rank + 1),\n"
+            "                                    onp.float32)), out=out)\n"
+            "assert float(out.asnumpy()[0]) == 3.0, out.asnumpy()\n"
+            "kv.barrier()\n"
+            "print('rank', rank, 'OK')\n")
+        import os
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        out = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "2", "--launcher",
+             "local", sys.executable, str(script)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=180)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "rank 0 OK" in out.stdout and "rank 1 OK" in out.stdout

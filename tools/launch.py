@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Distributed job launcher (reference ``tools/launch.py`` +
+``dmlc_tracker``; SURVEY.md §4.4, L10).
+
+Reference protocol: start a scheduler, then ssh/local-exec N workers and S
+servers with ``DMLC_*`` env vars pointing at it.
+
+TPU-native protocol: there are no server/scheduler roles — one process per
+host joins a ``jax.distributed`` group via a coordinator address.  This
+launcher keeps the reference CLI shape::
+
+    python tools/launch.py -n 4 --launcher local  python train.py ...
+    python tools/launch.py -n 4 --launcher ssh -H hosts  python train.py ...
+
+and sets, for each rank:
+
+    MXNET_COORDINATOR       host:port of rank 0 (feeds
+                            jax.distributed.initialize; read by
+                            mxnet_tpu.parallel.init_distributed)
+    MXNET_NUM_WORKERS       total ranks
+    MXNET_WORKER_ID         this rank
+    DMLC_ROLE=worker        reference compat (server/scheduler ranks can be
+                            requested with -s but are deprecated no-ops)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rank_env(args, coordinator, rank):
+    env = dict(os.environ)
+    env.update({
+        "MXNET_COORDINATOR": coordinator,
+        "MXNET_NUM_WORKERS": str(args.num_workers),
+        "MXNET_WORKER_ID": str(rank),
+        # reference-compatible names (SURVEY.md §4.4 env protocol)
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "DMLC_PS_ROOT_URI": coordinator.split(":")[0],
+        "DMLC_PS_ROOT_PORT": coordinator.split(":")[1],
+    })
+    return env
+
+
+def launch_local(args, command):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = _rank_env(args, coordinator, rank)
+        if args.dry_run:
+            kv = " ".join(f"{k}={env[k]}" for k in sorted(env)
+                          if k.startswith(("MXNET_", "DMLC")))
+            print(f"[rank {rank}] {kv} {' '.join(command)}")
+            continue
+        procs.append(subprocess.Popen(command, env=env))
+    if args.dry_run:
+        return 0
+    code = 0
+
+    def _kill_all(*_a):
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGINT, _kill_all)
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def launch_ssh(args, command):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        print(f"hostfile has {len(hosts)} hosts < -n {args.num_workers}",
+              file=sys.stderr)
+        return 1
+    coordinator = f"{hosts[0]}:{args.port or _free_port()}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = _rank_env(args, coordinator, rank)
+        exports = " ".join(
+            f"{k}={shlex.quote(env[k])}" for k in sorted(env)
+            if k.startswith(("MXNET_", "DMLC")))
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+            " ".join(shlex.quote(c) for c in command)
+        full = ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank],
+                remote_cmd]
+        if args.dry_run:
+            print(f"[rank {rank}] {' '.join(full)}")
+            continue
+        procs.append(subprocess.Popen(full))
+    if args.dry_run:
+        return 0
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job "
+                    "(reference tools/launch.py workalike)")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes (one per host)")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="[deprecated] PS server count; servers are "
+                             "no-ops on TPU (XLA collectives)")
+    parser.add_argument("--launcher", choices=["local", "ssh"],
+                        default="local")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for --launcher ssh")
+    parser.add_argument("--port", type=int, default=None,
+                        help="coordinator port (ssh mode)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print the per-rank commands without running")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("missing training command")
+    if args.num_servers:
+        print("note: -s/--num-servers is a no-op on TPU (parameter-server "
+              "roles are subsumed by XLA collectives)", file=sys.stderr)
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            parser.error("--launcher ssh requires -H/--hostfile")
+        return launch_ssh(args, args.command)
+    return launch_local(args, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
