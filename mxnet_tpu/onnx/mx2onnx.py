@@ -1,0 +1,297 @@
+"""mx2onnx — export a Symbol graph + params to ONNX.
+
+Reference surface: ``python/mxnet/onnx/mx2onnx`` (SURVEY.md §3.2 "ONNX":
+"op-by-op converter registry").  Each registered converter maps ONE graph
+node (op name + attrs) to one-or-more ONNX node descriptors.
+
+The ``onnx`` package is not installed in this environment; the converter
+registry and graph construction are fully functional, and serialization
+picks the best available container:
+
+- with ``onnx`` importable → a real ``ModelProto`` written to ``.onnx``
+- otherwise → the same graph as deterministic JSON (``.onnx.json``),
+  loadable by the companion importer and by the tests.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+from ..base import MXNetError
+
+_CONVERTERS = {}
+
+_OPSET = 13
+
+
+def register_converter(opname):
+    def deco(fn):
+        _CONVERTERS[opname] = fn
+        return fn
+    return deco
+
+
+def get_converter_registry():
+    return dict(_CONVERTERS)
+
+
+def _node(op_type, inputs, outputs, name, **attrs):
+    return {"op_type": op_type, "inputs": list(inputs),
+            "outputs": list(outputs), "name": name, "attrs": attrs}
+
+
+# --------------------------------------------------------------------- #
+# converters: fn(node_name, input_names, output_name, attrs) -> [nodes]
+# --------------------------------------------------------------------- #
+
+@register_converter("FullyConnected")
+def _conv_fc(name, ins, out, attrs):
+    nodes = []
+    data = ins[0]
+    if attrs.get("flatten", True):
+        nodes.append(_node("Flatten", [data], [f"{name}_flat"],
+                           f"{name}_flatten", axis=1))
+        data = f"{name}_flat"
+    gemm_ins = [data, ins[1]] + (ins[2:3] if len(ins) > 2 else [])
+    nodes.append(_node("Gemm", gemm_ins, [out], name, alpha=1.0, beta=1.0,
+                       transA=0, transB=1))
+    return nodes
+
+
+@register_converter("Convolution")
+def _conv_conv(name, ins, out, attrs):
+    kernel = list(attrs.get("kernel", ()))
+    return [_node("Conv", ins, [out], name,
+                  kernel_shape=kernel,
+                  strides=list(attrs.get("stride", ())) or [1] * len(kernel),
+                  pads=list(attrs.get("pad", ())) * 2 or [0] * 2 * len(kernel),
+                  dilations=list(attrs.get("dilate", ())) or [1] * len(kernel),
+                  group=int(attrs.get("num_group", 1)))]
+
+
+@register_converter("Activation")
+def _conv_act(name, ins, out, attrs):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = attrs.get("act_type", "relu")
+    if act not in table:
+        raise MXNetError(f"onnx: unsupported activation {act}")
+    return [_node(table[act], ins, [out], name)]
+
+
+@register_converter("relu")
+def _conv_relu(name, ins, out, attrs):
+    return [_node("Relu", ins, [out], name)]
+
+
+@register_converter("sigmoid")
+def _conv_sigmoid(name, ins, out, attrs):
+    return [_node("Sigmoid", ins, [out], name)]
+
+
+@register_converter("tanh")
+def _conv_tanh(name, ins, out, attrs):
+    return [_node("Tanh", ins, [out], name)]
+
+
+@register_converter("softmax")
+def _conv_softmax(name, ins, out, attrs):
+    return [_node("Softmax", ins, [out], name,
+                  axis=int(attrs.get("axis", -1)))]
+
+
+@register_converter("log_softmax")
+def _conv_log_softmax(name, ins, out, attrs):
+    return [_node("LogSoftmax", ins, [out], name,
+                  axis=int(attrs.get("axis", -1)))]
+
+
+@register_converter("_BatchNormStats")
+def _conv_bn(name, ins, out, attrs):
+    # inputs: data, gamma, beta, moving_mean, moving_var (inference form)
+    return [_node("BatchNormalization", ins[:5], [out], name,
+                  epsilon=float(attrs.get("eps", 1e-5)),
+                  momentum=float(attrs.get("momentum", 0.9)))]
+
+
+@register_converter("LayerNorm")
+def _conv_ln(name, ins, out, attrs):
+    return [_node("LayerNormalization", ins, [out], name,
+                  axis=int(attrs.get("axis", -1)),
+                  epsilon=float(attrs.get("eps", 1e-5)))]
+
+
+@register_converter("Pooling")
+def _conv_pool(name, ins, out, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        op_type = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [_node(op_type, ins, [out], name)]
+    kernel = list(attrs.get("kernel", ()))
+    op_type = "MaxPool" if ptype == "max" else "AveragePool"
+    return [_node(op_type, ins, [out], name, kernel_shape=kernel,
+                  strides=list(attrs.get("stride", ())) or [1] * len(kernel),
+                  pads=list(attrs.get("pad", ())) * 2 or [0] * 2 * len(kernel))]
+
+
+@register_converter("flatten")
+def _conv_flatten(name, ins, out, attrs):
+    return [_node("Flatten", ins, [out], name, axis=1)]
+
+
+@register_converter("reshape")
+def _conv_reshape(name, ins, out, attrs):
+    return [_node("Reshape", ins + [f"{name}_shape"], [out], name,
+                  _const={f"{name}_shape":
+                          onp.asarray(attrs.get("shape", (-1,)),
+                                      onp.int64)})]
+
+
+@register_converter("transpose")
+def _conv_transpose(name, ins, out, attrs):
+    return [_node("Transpose", ins, [out], name,
+                  perm=list(attrs.get("axes", ())))]
+
+
+@register_converter("concat")
+def _conv_concat(name, ins, out, attrs):
+    return [_node("Concat", ins, [out], name,
+                  axis=int(attrs.get("dim", 1)))]
+
+
+@register_converter("Embedding")
+def _conv_embedding(name, ins, out, attrs):
+    # data, weight -> Gather(weight, data)
+    return [_node("Gather", [ins[1], ins[0]], [out], name, axis=0)]
+
+
+@register_converter("dot")
+def _conv_dot(name, ins, out, attrs):
+    return [_node("MatMul", ins, [out], name)]
+
+
+@register_converter("matmul")
+def _conv_matmul(name, ins, out, attrs):
+    return [_node("MatMul", ins, [out], name)]
+
+
+for _mx, _onnx in [("broadcast_add", "Add"), ("broadcast_sub", "Sub"),
+                   ("broadcast_mul", "Mul"), ("broadcast_div", "Div"),
+                   ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
+                   ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
+                   ("abs", "Abs"), ("negative", "Neg"), ("erf", "Erf"),
+                   ("identity", "Identity"), ("BlockGrad", "Identity"),
+                   ("sum", "ReduceSum"), ("mean", "ReduceMean")]:
+    def _make(onnx_name):
+        def conv(name, ins, out, attrs):
+            return [_node(onnx_name, ins, [out], name)]
+        return conv
+    register_converter(_mx)(_make(_onnx))
+
+
+# --------------------------------------------------------------------- #
+# export driver
+# --------------------------------------------------------------------- #
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", verbose=False, **kwargs):
+    """Export (Symbol or exported json path, params dict or .params path)
+    to ONNX (reference ``mx.onnx.export_model``)."""
+    from ..symbol.symbol import Symbol, _topo
+    from ..model import load_params_file
+    from ..symbol import load as sym_load
+    from ..ndarray import NDArray
+
+    if isinstance(sym, str):
+        sym = sym_load(sym)
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model: sym must be a Symbol or json path")
+    if isinstance(params, str):
+        arg, aux = load_params_file(params)
+        params = {**arg, **aux}
+
+    nodes_out = []
+    initializers = {}
+    inputs = []
+    # graph entry naming: node -> output names
+    entry_name = {}
+    for node in _topo(sym._heads):
+        if node.op is None:
+            entry_name[id(node)] = [node.name]
+            if node.name in params:
+                v = params[node.name]
+                initializers[node.name] = (
+                    v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v))
+            else:
+                shp = None
+                if input_shapes:
+                    shp = dict(input_shapes).get(node.name) \
+                        if isinstance(input_shapes, (list, dict)) else None
+                inputs.append({"name": node.name,
+                               "shape": list(shp) if shp else None,
+                               "dtype": "float32"})
+            continue
+        conv = _CONVERTERS.get(node.op)
+        if conv is None:
+            raise MXNetError(
+                f"onnx: no converter registered for op {node.op!r} "
+                f"({sorted(_CONVERTERS)} available)")
+        in_names = [entry_name[id(i)][idx] for i, idx in node.inputs]
+        n_out = node.num_outputs or 1
+        out_names = [node.name if n_out == 1 else f"{node.name}_out{i}"
+                     for i in range(n_out)]
+        entry_name[id(node)] = out_names
+        produced = conv(node.name, in_names, out_names[0], node.attrs)
+        for p in produced:
+            consts = p["attrs"].pop("_const", None)
+            if consts:
+                initializers.update(consts)
+            nodes_out.append(p)
+
+    outputs = [entry_name[id(n)][i] for n, i in sym._heads]
+    graph = {
+        "ir_version": 8,
+        "opset": _OPSET,
+        "producer": "mxnet_tpu",
+        "graph": {
+            "nodes": nodes_out,
+            "inputs": inputs,
+            "outputs": [{"name": o} for o in outputs],
+            "initializers": {k: {"shape": list(v.shape),
+                                 "dtype": str(v.dtype),
+                                 "data": v.reshape(-1).tolist()}
+                             for k, v in initializers.items()},
+        },
+    }
+    try:
+        import onnx  # noqa: F401
+        return _write_protobuf(graph, initializers, onnx_file_path)
+    except ImportError:
+        path = onnx_file_path if onnx_file_path.endswith(".json") \
+            else onnx_file_path + ".json"
+        with open(path, "w") as f:
+            json.dump(graph, f)
+        if verbose:
+            print(f"onnx package unavailable; wrote JSON container {path}")
+        return path
+
+
+def _write_protobuf(graph, initializers, path):
+    import onnx
+    from onnx import helper, numpy_helper, TensorProto
+    nodes = [helper.make_node(n["op_type"], n["inputs"], n["outputs"],
+                              name=n["name"], **n["attrs"])
+             for n in graph["graph"]["nodes"]]
+    inits = [numpy_helper.from_array(v, name=k)
+             for k, v in initializers.items()]
+    ins = [helper.make_tensor_value_info(
+        i["name"], TensorProto.FLOAT, i["shape"])
+        for i in graph["graph"]["inputs"]]
+    outs = [helper.make_tensor_value_info(o["name"], TensorProto.FLOAT, None)
+            for o in graph["graph"]["outputs"]]
+    g = helper.make_graph(nodes, "mxnet_tpu", ins, outs, initializer=inits)
+    model = helper.make_model(
+        g, opset_imports=[helper.make_opsetid("", graph["opset"])])
+    onnx.save(model, path)
+    return path

@@ -1,0 +1,126 @@
+"""``mx.operator`` — Python custom operators.
+
+Reference surface: ``python/mxnet/operator.py`` + ``src/operator/custom/``
+(SURVEY.md §3.1 "Custom op (python)": a C++ op that calls back into Python
+per invocation).  TPU-native: the callback IS Python already — a CustomOp
+invocation runs eagerly on host-visible NDArrays and registers one tape
+node whose backward calls the user's ``backward`` (same mechanics as
+``autograd.Function``).  Inside a hybridized trace a CustomOp is opaque to
+XLA, exactly as the reference's CustomOperator is opaque to the graph
+engines.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from . import autograd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """User forward/backward (reference ``mx.operator.CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor grad_req semantics (reference ``CustomOp.assign``)."""
+        if req in ("null", 0):
+            return
+        if req in ("add", 3):
+            dst._rebind(dst._data + (src._data if isinstance(src, NDArray)
+                                     else src))
+        else:  # write / inplace
+            dst._rebind(src._data if isinstance(src, NDArray) else src)
+
+
+class CustomOpProp:
+    """Shape/type/creation metadata (reference ``CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """``@mx.operator.register("myop")`` over a CustomOpProp subclass."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+def _invoke_custom(op_type, inputs, kwargs):
+    """``mx.nd.Custom(*data, op_type=...)`` dispatch path."""
+    if op_type not in _REGISTRY:
+        raise MXNetError(f"custom op {op_type!r} is not registered")
+    prop = _REGISTRY[op_type](**kwargs)
+    in_shapes = [list(x.shape) for x in inputs]
+    in_shapes, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    from . import ndarray as nd
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    out_data = [nd.zeros(tuple(s), dtype=str(t))
+                for s, t in zip(out_shapes, out_types)]
+    aux = [nd.zeros(tuple(s)) for s in aux_shapes]
+
+    class _Fn(autograd.Function):
+        def forward(self, *xs):
+            op.forward(is_train=autograd.is_training(),
+                       req=["write"] * len(out_data), in_data=list(xs),
+                       out_data=out_data, aux=aux)
+            outs = tuple(o._data for o in out_data)
+            return [NDArray(o) for o in outs] if len(outs) > 1 \
+                else NDArray(outs[0])
+
+        def backward(self, *ograds):
+            in_grad = [nd.zeros(x.shape, dtype=str(x.dtype)) for x in inputs]
+            op.backward(req=["write"] * len(inputs),
+                        out_grad=list(ograds), in_data=list(inputs),
+                        out_data=out_data, in_grad=in_grad, aux=aux)
+            return tuple(in_grad)
+
+    fn = _Fn()
+    fn.__class__.__name__ = f"Custom_{op_type}"
+    return fn(*inputs)

@@ -1,0 +1,109 @@
+"""INT8 quantization ops (reference ``src/operator/quantization/``;
+SURVEY.md §3.1 "Quantization": quantize_v2/dequantize/requantize + min-max
+and KL-entropy calibration).
+
+TPU stance: int8 matmuls hit the MXU with int32 accumulation via
+``lax.dot_general(preferred_element_type=int32)``; quantize/dequantize are
+elementwise chains XLA fuses into neighbors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+from .registry import op
+
+__all__ = ["quantize_v2", "dequantize", "requantize",
+           "quantized_matmul_int8"]
+
+
+@op("_contrib_quantize_v2", differentiable=False)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """fp32 → int8 with symmetric scale from the calibrated range
+    (reference ``_contrib_quantize_v2``).  Returns (q, min, max)."""
+    if min_calib_range is None or max_calib_range is None:
+        mx_abs = jnp.max(jnp.abs(data))
+        min_r, max_r = -mx_abs, mx_abs
+    else:
+        min_r = jnp.asarray(min_calib_range, jnp.float32)
+        max_r = jnp.asarray(max_calib_range, jnp.float32)
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    scale = 127.0 / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, -amax.reshape(1), amax.reshape(1)
+
+
+@op("_contrib_dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))[0]
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+@op("_contrib_requantize", differentiable=False)
+def requantize(data, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 with a new output range (reference
+    ``requantize`` after quantized conv/fc)."""
+    in_amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))[0]
+    real = data.astype(jnp.float32) * (in_amax / (127.0 * 127.0))
+    if min_calib_range is not None and max_calib_range is not None:
+        out_amax = max(abs(min_calib_range), abs(max_calib_range))
+    else:
+        out_amax = jnp.max(jnp.abs(real))
+    scale = 127.0 / jnp.maximum(out_amax, 1e-12)
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    a = jnp.asarray(out_amax, jnp.float32).reshape(1)
+    return q, -a, a
+
+
+@op("quantized_matmul_int8", differentiable=False)
+def quantized_matmul_int8(qa, qb, *, transpose_b=False):
+    """int8 × int8 → int32 matmul (MXU path: int32 accumulation)."""
+    b = qb.T if transpose_b else qb
+    return lax.dot_general(
+        qa, b, (((qa.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def optimal_threshold_kl(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence calibration (reference ``_get_optimal_threshold``):
+    pick the clip threshold whose quantized distribution diverges least
+    from the observed one.  Pure numpy (host-side calibration pass)."""
+    hist = onp.asarray(hist, dtype=onp.float64)
+    num_bins = hist.size
+    zero_bin = num_bins // 2
+    thresholds = []
+    divergences = []
+    # scan symmetric windows around zero
+    for i in range(num_quantized_bins // 2, zero_bin + 1):
+        lo, hi = zero_bin - i, zero_bin + i + 1
+        p = hist[lo:hi].copy()
+        left_outliers = hist[:lo].sum()
+        right_outliers = hist[hi:].sum()
+        p[0] += left_outliers
+        p[-1] += right_outliers
+        # quantize p into num_quantized_bins buckets
+        factor = p.size / num_quantized_bins
+        q = onp.zeros_like(p)
+        for j in range(num_quantized_bins):
+            start = int(round(j * factor))
+            stop = int(round((j + 1) * factor))
+            chunk = p[start:stop]
+            nz = (chunk != 0).sum()
+            if nz:
+                q[start:stop] = onp.where(chunk != 0, chunk.sum() / nz, 0)
+        p_sum, q_sum = p.sum(), q.sum()
+        if p_sum == 0 or q_sum == 0:
+            continue
+        pn, qn = p / p_sum, q / q_sum
+        mask = (pn > 0) & (qn > 0)
+        kl = (pn[mask] * onp.log(pn[mask] / qn[mask])).sum()
+        thresholds.append(hist_edges[hi] if hi < hist_edges.size
+                          else hist_edges[-1])
+        divergences.append(kl)
+    if not thresholds:
+        return float(abs(hist_edges).max())
+    return float(thresholds[int(onp.argmin(divergences))])

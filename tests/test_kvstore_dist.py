@@ -125,7 +125,7 @@ class TestLauncher:
             "                                    onp.float32)), out=out)\n"
             "assert float(out.asnumpy()[0]) == 3.0, out.asnumpy()\n"
             "kv.barrier()\n"
-            "print('rank', rank, 'OK')\n")
+            "print('RANK%d_OK' % rank, flush=True)\n")
         import os
         env = dict(os.environ, PYTHONPATH="/root/repo")
         out = subprocess.run(
@@ -134,4 +134,4 @@ class TestLauncher:
             capture_output=True, text=True, cwd="/root/repo", env=env,
             timeout=180)
         assert out.returncode == 0, out.stderr[-2000:]
-        assert "rank 0 OK" in out.stdout and "rank 1 OK" in out.stdout
+        assert "RANK0_OK" in out.stdout and "RANK1_OK" in out.stdout

@@ -1,0 +1,162 @@
+"""Quantization + ONNX export + custom-op tests (reference
+tests/python/quantization/, tests/python-pytest/onnx/,
+tests/python/unittest/test_operator.py::test_custom_op coverage)."""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as mop
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib.quantization import quantize_net, QuantizedDense
+from mxnet_tpu.ops.quantization import optimal_threshold_kl
+
+
+class TestQuantizeOps:
+    def test_quantize_dequantize_roundtrip(self):
+        x = mx.nd.array(onp.linspace(-2, 2, 16).astype(onp.float32))
+        q, mn, mxr = mx.nd._contrib_quantize_v2(x)
+        assert str(q.dtype) == "int8"
+        deq = mx.nd._contrib_dequantize(q, mn, mxr)
+        onp.testing.assert_allclose(deq.asnumpy(), x.asnumpy(), atol=0.02)
+
+    def test_calibrated_range_clips(self):
+        x = mx.nd.array(onp.array([0.1, 5.0], onp.float32))
+        q, mn, mxr = mx.nd._contrib_quantize_v2(x, min_calib_range=-1.0,
+                                                max_calib_range=1.0)
+        assert int(q.asnumpy()[1]) == 127  # clipped at the calib range
+
+    def test_int8_matmul_matches_fp32(self):
+        rng = onp.random.RandomState(0)
+        a = rng.rand(8, 16).astype(onp.float32) - 0.5
+        b = rng.rand(4, 16).astype(onp.float32) - 0.5
+        qa, _, amax_a = mx.nd._contrib_quantize_v2(mx.nd.array(a))
+        qb, _, amax_b = mx.nd._contrib_quantize_v2(mx.nd.array(b))
+        acc = mx.nd.quantized_matmul_int8(qa, qb, transpose_b=True)
+        scale = (float(amax_a.asnumpy()[0]) * float(amax_b.asnumpy()[0])
+                 / (127.0 * 127.0))
+        out = acc.asnumpy().astype(onp.float32) * scale
+        onp.testing.assert_allclose(out, a @ b.T, atol=0.05)
+
+    def test_kl_threshold_reasonable(self):
+        rng = onp.random.RandomState(0)
+        data = rng.normal(0, 1, 100000)
+        hist, edges = onp.histogram(data, bins=1001, range=(-8, 8))
+        t = optimal_threshold_kl(hist, edges)
+        # optimal clip for a unit gaussian is far below the 8-sigma tail
+        assert 1.0 < t < 8.0
+
+
+class TestQuantizeNet:
+    def test_mlp_accuracy_preserved(self):
+        rng = onp.random.RandomState(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        X = mx.nd.array(rng.rand(64, 20).astype(onp.float32))
+        ref = net(X).asnumpy()
+        qnet = quantize_net(net, calib_data=[X], calib_mode="naive")
+        assert any(isinstance(c, QuantizedDense)
+                   for c in qnet._children.values())
+        out = qnet(X).asnumpy()
+        rel = onp.abs(out - ref).max() / onp.abs(ref).max()
+        assert rel < 0.05
+        assert (out.argmax(1) == ref.argmax(1)).mean() > 0.9
+
+    def test_entropy_mode_runs(self):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8))
+        net.initialize(mx.init.Xavier())
+        X = mx.nd.array(onp.random.rand(32, 6).astype(onp.float32))
+        qnet = quantize_net(net, calib_data=[X], calib_mode="entropy")
+        assert qnet(X).shape == (32, 8)
+
+    def test_requires_calib_data(self):
+        net = gluon.nn.Dense(4)
+        with pytest.raises(MXNetError):
+            quantize_net(net)
+
+
+class TestONNXExport:
+    def test_export_conv_net(self, tmp_path):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"), gluon.nn.MaxPool2D(2),
+                gluon.nn.Flatten(), gluon.nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(onp.random.rand(1, 3, 8, 8).astype(onp.float32))
+        net(x)
+        prefix = str(tmp_path / "m")
+        net.export(prefix)
+        out = mx.onnx.export_model(
+            prefix + "-symbol.json", prefix + "-0000.params",
+            input_shapes=[("data", (1, 3, 8, 8))],
+            onnx_file_path=str(tmp_path / "m.onnx"))
+        g = json.load(open(out))
+        ops = [n["op_type"] for n in g["graph"]["nodes"]]
+        assert {"Conv", "BatchNormalization", "Relu", "MaxPool",
+                "Gemm"} <= set(ops)
+        assert g["graph"]["inputs"][0]["name"] == "data"
+        assert len(g["graph"]["initializers"]) >= 6
+
+    def test_unsupported_op_raises(self, tmp_path):
+        s = mx.sym.erfinv(mx.sym.var("x"))
+        with pytest.raises(MXNetError):
+            mx.onnx.export_model(s, {}, onnx_file_path=str(tmp_path / "x"))
+
+
+class TestCustomOp:
+    def test_forward_backward(self):
+        @mop.register("t_sigmoid")
+        class P(mop.CustomOpProp):
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class O(mop.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        x = in_data[0]
+                        self.assign(out_data[0], req[0],
+                                    1.0 / (1.0 + (-x).exp()))
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        y = out_data[0]
+                        self.assign(in_grad[0], req[0],
+                                    out_grad[0] * y * (1 - y))
+                return O()
+
+        x = mx.nd.array(onp.array([0.0, 1.0, -1.0], onp.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.Custom(x, op_type="t_sigmoid")
+        y.backward(mx.nd.ones(3))
+        sig = 1 / (1 + onp.exp(-x.asnumpy()))
+        onp.testing.assert_allclose(y.asnumpy(), sig, rtol=1e-6)
+        onp.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig),
+                                    rtol=1e-5)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(MXNetError):
+            mx.nd.Custom(mx.nd.ones(2), op_type="nope")
+
+    def test_grad_req_add(self):
+        @mop.register("t_double")
+        class P(mop.CustomOpProp):
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class O(mop.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        self.assign(out_data[0], req[0], in_data[0] * 2)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        self.assign(in_grad[0], req[0], out_grad[0] * 2)
+                return O()
+
+        x = mx.nd.ones(3)
+        x.attach_grad(grad_req="add")
+        for _ in range(2):
+            with autograd.record():
+                y = mx.nd.Custom(x, op_type="t_double")
+            y.backward(mx.nd.ones(3))
+        onp.testing.assert_allclose(x.grad.asnumpy(), onp.full(3, 4.0))
