@@ -43,13 +43,24 @@ class LayerOutputCollector:
             st = self.stats.setdefault(
                 name, {"amax": 0.0, "hist": None, "edges": None})
             amax = float(onp.abs(arr).max())
+            grew = amax > st["amax"]
             st["amax"] = max(st["amax"], amax)
             if self.mode == "entropy":
-                hist, edges = onp.histogram(
-                    arr, bins=self.num_bins,
-                    range=(-st["amax"] - 1e-12, st["amax"] + 1e-12))
-                if st["hist"] is None or st["hist"].size != hist.size:
-                    st["hist"], st["edges"] = hist.astype(onp.float64), edges
+                rng = (-st["amax"] - 1e-12, st["amax"] + 1e-12)
+                hist, edges = onp.histogram(arr, bins=self.num_bins,
+                                            range=rng)
+                hist = hist.astype(onp.float64)
+                if st["hist"] is None:
+                    st["hist"], st["edges"] = hist, edges
+                elif grew:
+                    # range widened: re-bin the accumulated histogram onto
+                    # the new edges (old bin centers carry old counts)
+                    centers = (st["edges"][:-1] + st["edges"][1:]) / 2
+                    rebinned, _ = onp.histogram(centers, bins=self.num_bins,
+                                                range=rng,
+                                                weights=st["hist"])
+                    st["hist"] = rebinned + hist
+                    st["edges"] = edges
                 else:
                     st["hist"] += hist
         return _pre_hook
@@ -80,13 +91,13 @@ class QuantizedDense(HybridBlock):
         self._act = dense.act  # keep the fused activation, if any
 
     def hybrid_forward(self, F, x):
-        from .. import ndarray as ndm
         if self._flatten and x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
-        scale_x = 127.0 / self._x_amax
-        qx = ndm.clip(ndm.round(x * scale_x), a_min=-127.0,
-                      a_max=127.0).astype("int8")
-        acc = ndm.quantized_matmul_int8(qx, self._qweight, transpose_b=True)
+        # the same symmetric-int8 scheme as quantize_v2, with the calibrated
+        # activation range
+        qx, _, _ = F._contrib_quantize_v2(x, min_calib_range=-self._x_amax,
+                                          max_calib_range=self._x_amax)
+        acc = F.quantized_matmul_int8(qx, self._qweight, transpose_b=True)
         out = acc.astype("float32") * (self._x_amax * self._w_amax /
                                        (127.0 * 127.0))
         if self._bias is not None:
@@ -130,8 +141,14 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     collector = LayerOutputCollector(mode=calib_mode)
 
     hooks = []
+    hybrid_state = []  # (block, was_active) — calibration must see real
+    # arrays, not tracers, so hybridized blocks run imperatively during it
 
     def attach(block):
+        if isinstance(block, HybridBlock):
+            hybrid_state.append((block, block._active))
+            block._active = False
+            block._cached_op = None
         for child in block._children.values():
             if isinstance(child, nn.Dense):
                 hooks.append(child.register_forward_pre_hook(
@@ -139,13 +156,18 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             attach(child)
 
     attach(network)
-    for i, batch in enumerate(calib_data):
-        if num_calib_batches is not None and i >= num_calib_batches:
-            break
-        x = batch[0] if isinstance(batch, (list, tuple)) else batch
-        network(x)
-    for h in hooks:
-        h.detach()
+    try:
+        for i, batch in enumerate(calib_data):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            network(x)
+    finally:
+        for h in hooks:
+            h.detach()
+        for block, was_active in hybrid_state:
+            block._active = was_active
+            block._cached_op = None  # stale fp32 trace must not survive
     _walk_replace(network, collector, exclude)
     logger.info("quantize_net: %d layers calibrated (%s mode)",
                 len(collector.stats), calib_mode)

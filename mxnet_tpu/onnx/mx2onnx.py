@@ -181,13 +181,43 @@ for _mx, _onnx in [("broadcast_add", "Add"), ("broadcast_sub", "Sub"),
                    ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
                    ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
                    ("abs", "Abs"), ("negative", "Neg"), ("erf", "Erf"),
-                   ("identity", "Identity"), ("BlockGrad", "Identity"),
-                   ("sum", "ReduceSum"), ("mean", "ReduceMean")]:
+                   ("identity", "Identity"), ("BlockGrad", "Identity")]:
     def _make(onnx_name):
         def conv(name, ins, out, attrs):
             return [_node(onnx_name, ins, [out], name)]
         return conv
     register_converter(_mx)(_make(_onnx))
+
+
+def _reduce_converter(onnx_name, axes_as_input):
+    """sum/mean carry axis+keepdims; MXNet default keepdims=False differs
+    from ONNX's keepdims=1, and opset 13 ReduceSum takes axes as an INPUT
+    tensor while ReduceMean still uses the attr."""
+
+    def conv(name, ins, out, attrs):
+        axis = attrs.get("axis")
+        if axis is not None and not isinstance(axis, (list, tuple)):
+            axis = [axis]
+        keepdims = 1 if attrs.get("keepdims") else 0
+        if axes_as_input:
+            if axis is None:
+                return [_node(onnx_name, ins, [out], name,
+                              keepdims=keepdims)]
+            return [_node(onnx_name, ins + [f"{name}_axes"], [out], name,
+                          keepdims=keepdims,
+                          _const={f"{name}_axes":
+                                  onp.asarray(axis, onp.int64)})]
+        kw = {"keepdims": keepdims}
+        if axis is not None:
+            kw["axes"] = [int(a) for a in axis]
+        return [_node(onnx_name, ins, [out], name, **kw)]
+
+    return conv
+
+
+register_converter("sum")(_reduce_converter("ReduceSum", axes_as_input=True))
+register_converter("mean")(_reduce_converter("ReduceMean",
+                                             axes_as_input=False))
 
 
 # --------------------------------------------------------------------- #
@@ -228,9 +258,14 @@ def export_model(sym, params, input_shapes=None, input_types=None,
                 if input_shapes:
                     shp = dict(input_shapes).get(node.name) \
                         if isinstance(input_shapes, (list, dict)) else None
+                dt = "float32"
+                if input_types:
+                    dt = str(dict(input_types).get(node.name, "float32")) \
+                        if isinstance(input_types, (list, dict)) \
+                        else str(input_types)
                 inputs.append({"name": node.name,
                                "shape": list(shp) if shp else None,
-                               "dtype": "float32"})
+                               "dtype": onp.dtype(dt).name})
             continue
         conv = _CONVERTERS.get(node.op)
         if conv is None:
@@ -285,8 +320,16 @@ def _write_protobuf(graph, initializers, path):
              for n in graph["graph"]["nodes"]]
     inits = [numpy_helper.from_array(v, name=k)
              for k, v in initializers.items()]
+    from onnx import mapping
+    dtype_enum = {onp.dtype(k).name: v
+                  for k, v in mapping.NP_TYPE_TO_TENSOR_TYPE.items()} \
+        if hasattr(mapping, "NP_TYPE_TO_TENSOR_TYPE") else {}
+
+    def _enum(dt):
+        return dtype_enum.get(onp.dtype(dt).name, TensorProto.FLOAT)
+
     ins = [helper.make_tensor_value_info(
-        i["name"], TensorProto.FLOAT, i["shape"])
+        i["name"], _enum(i.get("dtype", "float32")), i["shape"])
         for i in graph["graph"]["inputs"]]
     outs = [helper.make_tensor_value_info(o["name"], TensorProto.FLOAT, None)
             for o in graph["graph"]["outputs"]]
