@@ -138,7 +138,14 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             return
         if isinstance(optimizer, str):
-            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+            params = dict(optimizer_params)
+            # reference Module.init_optimizer: default grad rescale is
+            # 1/batch_size (grads are summed over the batch)
+            if "rescale_grad" not in params and self._data_shapes:
+                batch = self._data_shapes[0][1][0]
+                if batch:
+                    params["rescale_grad"] = 1.0 / batch
+            optimizer = opt_mod.create(optimizer, **params)
         self._optimizer = optimizer
         idx2name = dict(enumerate(self._param_names))
         self._optimizer.param_idx2name = idx2name
