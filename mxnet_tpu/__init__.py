@@ -7,6 +7,19 @@ reference's ``import mxnet as mx``: ``mx.nd``, ``mx.autograd``, ``mx.gluon``,
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor JAX_PLATFORMS even when a sitecustomize-injected PJRT plugin
+    # (the TPU tunnel) pinned jax.config.jax_platforms at import time —
+    # otherwise CPU-only runs dial the tunnel (and hang when it's down).
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
                       num_gpus, num_tpus, current_context, gpu_memory_info)

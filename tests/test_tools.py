@@ -1,0 +1,101 @@
+"""Tools + opperf tests (reference tools/ and benchmark/opperf coverage;
+SURVEY.md L10, §6)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _run(args, **kw):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, cwd="/root/repo", env=_ENV, **kw)
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = (onp.random.rand(20, 20, 3) * 255).astype(onp.uint8)
+            Image.fromarray(arr).save(str(d / f"{i}.jpg"))
+    return str(root)
+
+
+class TestIm2Rec:
+    def test_list_mode(self, image_tree, tmp_path):
+        prefix = str(tmp_path / "d")
+        r = _run(["tools/im2rec.py", prefix, image_tree, "--recursive",
+                  "--list"])
+        assert r.returncode == 0, r.stderr
+        lines = open(prefix + ".lst").read().strip().splitlines()
+        assert len(lines) == 6
+        labels = {l.split("\t")[1] for l in lines}
+        assert labels == {"0", "1"}
+
+    def test_pack_and_read_back(self, image_tree, tmp_path):
+        prefix = str(tmp_path / "d")
+        r = _run(["tools/im2rec.py", prefix, image_tree, "--recursive",
+                  "--resize", "16"])
+        assert r.returncode == 0, r.stderr
+        from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+        ds = ImageRecordDataset(prefix + ".rec")
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert min(img.shape[:2]) == 16
+        assert label in (0.0, 1.0)
+
+
+class TestParseLog:
+    def test_parses_metrics(self, tmp_path):
+        log = tmp_path / "t.log"
+        log.write_text(
+            "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+            "INFO:root:Epoch[0] Time cost=10.1\n"
+            "INFO:root:Epoch[1] Train-accuracy=0.8\n"
+            "INFO:root:Epoch[1] Validation-accuracy=0.75\n")
+        r = _run(["tools/parse_log.py", str(log), "--format", "csv"])
+        assert r.returncode == 0
+        assert "train-accuracy" in r.stdout
+        assert "0.75" in r.stdout
+
+    def test_empty_log_errors(self, tmp_path):
+        log = tmp_path / "e.log"
+        log.write_text("nothing here\n")
+        assert _run(["tools/parse_log.py", str(log)]).returncode == 1
+
+
+class TestDiagnose:
+    def test_runs(self):
+        r = _run(["tools/diagnose.py"])
+        assert r.returncode == 0
+        assert "mxnet_tpu" in r.stdout
+        assert "features" in r.stdout
+
+
+class TestBandwidth:
+    def test_kvstore_bandwidth(self):
+        r = _run(["tools/bandwidth/measure.py", "--sizes", "65536",
+                  "--repeats", "2"], timeout=180)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "GB/s" in r.stdout
+
+
+class TestOpperf:
+    def test_subset_runs(self):
+        r = _run(["benchmark/opperf/opperf.py", "--ops", "dot", "relu",
+                  "--runs", "2"], timeout=240)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "dot" in r.stdout and "relu" in r.stdout
+
+    def test_python_api(self):
+        from benchmark.opperf.opperf import run_op_benchmark
+        res = run_op_benchmark(["sigmoid"], warmup=1, runs=2)
+        assert res[0]["op"] == "sigmoid"
+        assert "jit_ms" in res[0]

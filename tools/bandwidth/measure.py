@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Allreduce bandwidth measurement (reference ``tools/bandwidth/measure.py``:
+kvstore push/pull bandwidth across devices).
+
+Measures the kvstore pushpull path (data-parallel gradient allreduce) for a
+range of tensor sizes; on one chip the reduce is local (measures dispatch +
+memory), on a mesh it exercises ICI collectives via the parallel package.
+
+Usage: ``python tools/bandwidth/measure.py [--kvstore local] [--sizes ...]``
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def measure_kvstore(kv_type, sizes, repeats):
+    import mxnet_tpu as mx
+    kv = mx.kv.create(kv_type)
+    rows = []
+    for size in sizes:
+        n = size // 4  # fp32 elements
+        val = mx.nd.array(onp.random.rand(n).astype(onp.float32))
+        out = mx.nd.zeros(n)
+        kv.init(size, val)
+        kv.pushpull(size, val, out=out)  # warmup
+        out.asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            kv.pushpull(size, val, out=out)
+        out.asnumpy()
+        dt = (time.perf_counter() - t0) / repeats
+        rows.append({"bytes": size, "ms": dt * 1e3,
+                     "GB/s": size / dt / 1e9})
+    return rows
+
+
+def measure_collective(sizes, repeats):
+    """all_reduce over the device mesh (the ICI path)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    ndev = len(jax.devices())
+    mesh = parallel.make_mesh({"dp": ndev})
+    rows = []
+    for size in sizes:
+        n = size // 4
+        val = mx.nd.array(onp.random.rand(n).astype(onp.float32))
+        out = parallel.all_reduce(val, mesh=mesh, axis="dp")
+        out.asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = parallel.all_reduce(val, mesh=mesh, axis="dp")
+        out.asnumpy()
+        dt = (time.perf_counter() - t0) / repeats
+        # ring allreduce moves 2*(n-1)/n of the buffer per link
+        rows.append({"bytes": size, "ms": dt * 1e3,
+                     "algo GB/s": size / dt / 1e9 * 2 * (ndev - 1) /
+                     max(ndev, 1)})
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--kvstore", default="local")
+    p.add_argument("--collective", action="store_true",
+                   help="measure mesh all_reduce instead of kvstore")
+    p.add_argument("--sizes", type=int, nargs="*",
+                   default=[1 << 16, 1 << 20, 1 << 24])
+    p.add_argument("--repeats", type=int, default=10)
+    args = p.parse_args(argv)
+    rows = measure_collective(args.sizes, args.repeats) if args.collective \
+        else measure_kvstore(args.kvstore, args.sizes, args.repeats)
+    keys = list(rows[0].keys())
+    print("".join(f"{k:>14}" for k in keys))
+    for r in rows:
+        print("".join(f"{r[k]:>14.3f}" if isinstance(r[k], float)
+                      else f"{r[k]:>14}" for k in keys))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

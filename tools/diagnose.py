@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Environment diagnosis dump (reference ``tools/diagnose.py``): python /
+platform / framework / device / env-var report for bug reports."""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("----------Platform Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("machine      :", platform.machine())
+    print("----------Environment----------")
+    for k in sorted(os.environ):
+        if k.startswith(("MXNET_", "JAX_", "XLA_", "TPU_", "DMLC_",
+                         "LIBTPU")):
+            print(f"{k}={os.environ[k]}")
+    print("----------Framework Info----------")
+    try:
+        import mxnet_tpu as mx
+        print("mxnet_tpu    :", mx.__version__)
+        feats = mx.runtime.Features()
+        enabled = [f for f in feats.keys() if feats.is_enabled(f)]
+        print("features     :", " ".join(sorted(enabled)))
+        from mxnet_tpu import _native
+        print("native io    :", "built" if _native.available() else "absent")
+    except Exception as e:
+        print("mxnet_tpu import failed:", e)
+    try:
+        import jax
+        print("jax          :", jax.__version__)
+        print("devices      :", jax.devices())
+        print("process      :", f"{jax.process_index()}/{jax.process_count()}")
+    except Exception as e:
+        print("jax device probe failed:", e)
+    try:
+        import numpy
+        print("numpy        :", numpy.__version__)
+    except ImportError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""im2rec — pack an image folder (or .lst file) into RecordIO shards.
+
+Reference surface: ``tools/im2rec.py`` (SURVEY.md L10): makes ``.lst``
+listings from a folder tree and packs ``.rec``+``.idx`` files with
+IRHeader-tagged JPEG records consumable by ImageRecordIter /
+ImageRecordDataset.
+
+Usage::
+
+    python tools/im2rec.py prefix image_root --recursive --list   # make .lst
+    python tools/im2rec.py prefix image_root                      # pack .rec
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive):
+    i = 0
+    cat = {}
+    if recursive:
+        for path in sorted(os.listdir(root)):
+            full = os.path.join(root, path)
+            if not os.path.isdir(full):
+                continue
+            if path not in cat:
+                cat[path] = len(cat)
+            for fname in sorted(os.listdir(full)):
+                if fname.lower().endswith(_EXTS):
+                    yield (i, os.path.join(path, fname), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if fname.lower().endswith(_EXTS):
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as f:
+        for idx, fname, label in image_list:
+            f.write(f"{idx}\t{label}\t{fname}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def pack(args, lst_path):
+    from mxnet_tpu import recordio as rio
+    from mxnet_tpu.image import imdecode_np, imencode
+    from mxnet_tpu.image.image import _resize_np
+    rec_path = lst_path[:-4] + ".rec"
+    idx_path = lst_path[:-4] + ".idx"
+    writer = rio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    n = 0
+    for idx, fname, label in read_list(lst_path):
+        full = os.path.join(args.root, fname)
+        try:
+            with open(full, "rb") as f:
+                buf = f.read()
+            if args.resize or args.quality != 95 or args.center_crop:
+                img = imdecode_np(buf)
+                if args.resize:
+                    h, w = img.shape[:2]
+                    if h > w:
+                        img = _resize_np(img, args.resize,
+                                         int(h * args.resize / w))
+                    else:
+                        img = _resize_np(img, int(w * args.resize / h),
+                                         args.resize)
+                if args.center_crop:
+                    h, w = img.shape[:2]
+                    s = min(h, w)
+                    y0, x0 = (h - s) // 2, (w - s) // 2
+                    img = img[y0:y0 + s, x0:x0 + s]
+                buf = imencode(img, quality=args.quality)
+        except Exception as e:
+            print(f"skip {fname}: {e}", file=sys.stderr)
+            continue
+        lbl = label[0] if len(label) == 1 else label
+        writer.write_idx(idx, rio.pack(rio.IRHeader(0, lbl, idx, 0), buf))
+        n += 1
+    writer.close()
+    print(f"packed {n} records -> {rec_path}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="make image lists / pack RecordIO "
+                    "(reference tools/im2rec.py workalike)")
+    p.add_argument("prefix", help="output prefix (or existing .lst)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="generate .lst only (no packing)")
+    p.add_argument("--recursive", action="store_true",
+                   help="folders under root are label categories")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    args = p.parse_args(argv)
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        if args.train_ratio < 1.0:
+            k = int(len(images) * args.train_ratio)
+            write_list(args.prefix + "_train.lst", images[:k])
+            write_list(args.prefix + "_val.lst", images[k:])
+        else:
+            write_list(args.prefix + ".lst", images)
+        print(f"listed {len(images)} images")
+        return 0
+
+    lst = args.prefix if args.prefix.endswith(".lst") else args.prefix + ".lst"
+    if not os.path.isfile(lst):
+        images = list(list_images(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        write_list(lst, images)
+    pack(args, lst)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Parse training logs into a metric table (reference ``tools/parse_log.py``:
+scrapes accuracy/speed from fit-loop logs).
+
+Understands the Module/Estimator log shapes::
+
+    Epoch[3] Train-accuracy=0.83
+    Epoch[3] Validation-accuracy=0.81
+    Epoch[3] Time cost=12.3
+    Epoch[3] Batch [20]	Speed: 493.81 samples/sec
+
+Usage: ``python tools/parse_log.py train.log [--format csv|md]``
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+_PATTERNS = {
+    "train": re.compile(r"Epoch\[(\d+)\].*Train-([\w-]+)=([\d.eE+-]+)"),
+    "val": re.compile(r"Epoch\[(\d+)\].*Validation-([\w-]+)=([\d.eE+-]+)"),
+    "time": re.compile(r"Epoch\[(\d+)\].*Time cost=([\d.eE+-]+)"),
+    "speed": re.compile(r"Epoch\[(\d+)\].*Speed[:=]\s*([\d.eE+-]+)"),
+}
+
+
+def parse(lines):
+    rows = defaultdict(dict)
+    for line in lines:
+        m = _PATTERNS["train"].search(line)
+        if m:
+            rows[int(m.group(1))][f"train-{m.group(2)}"] = float(m.group(3))
+            continue
+        m = _PATTERNS["val"].search(line)
+        if m:
+            rows[int(m.group(1))][f"val-{m.group(2)}"] = float(m.group(3))
+            continue
+        m = _PATTERNS["time"].search(line)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+            continue
+        m = _PATTERNS["speed"].search(line)
+        if m:
+            e = int(m.group(1))
+            rows[e].setdefault("_speeds", []).append(float(m.group(2)))
+    out = []
+    for epoch in sorted(rows):
+        r = dict(rows[epoch])
+        speeds = r.pop("_speeds", None)
+        if speeds:
+            r["speed"] = sum(speeds) / len(speeds)
+        out.append({"epoch": epoch, **r})
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=["csv", "md"], default="md")
+    args = p.parse_args(argv)
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        print("no metrics found", file=sys.stderr)
+        return 1
+    cols = ["epoch"] + sorted({k for r in rows for k in r} - {"epoch"})
+    if args.format == "csv":
+        print(",".join(cols))
+        for r in rows:
+            print(",".join(str(r.get(c, "")) for c in cols))
+    else:
+        print("| " + " | ".join(cols) + " |")
+        print("|" + "---|" * len(cols))
+        for r in rows:
+            print("| " + " | ".join(str(r.get(c, "")) for c in cols) + " |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
