@@ -45,6 +45,7 @@ def __getattr__(name):
         "kv": ".kvstore",
         "profiler": ".profiler",
         "runtime": ".runtime",
+        "rtc": ".rtc",
         "util": ".util",
         "image": ".image",
         "recordio": ".recordio",

@@ -1,0 +1,96 @@
+"""``mx.rtc`` — runtime kernel compilation.
+
+Reference surface: ``src/common/rtc.cc`` + ``python/mxnet/rtc.py``
+(SURVEY.md §3.1 "RTC": ``mx.rtc.CudaModule(source).get_kernel(...)`` via
+NVRTC).
+
+TPU-native redesign: the runtime-compiled-kernel facility on TPU is
+**Pallas** — Python kernel functions compiled to Mosaic at trace time, the
+exact role NVRTC-compiled CUDA strings play on GPU.  :class:`PallasModule`
+mirrors the CudaModule surface (construct with kernel source, get a named
+kernel, launch on arrays); ``CudaModule`` itself raises with a pointer here,
+since there is no CUDA on this target.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["PallasModule", "CudaModule"]
+
+
+class _PallasKernel:
+    def __init__(self, fn, name, out_shape_fn):
+        self._fn = fn
+        self._name = name
+        self._out_shape_fn = out_shape_fn
+        self._compiled = {}
+
+    def launch(self, args, grid=(1,), block_shapes=None, out_shapes=None):
+        """Run the kernel on NDArray inputs; returns NDArray output(s).
+
+        ``out_shapes``: list of (shape, dtype) for the outputs (defaults to
+        the module's out_shape_fn applied to the inputs)."""
+        import jax
+        from jax.experimental import pallas as pl
+        import jax.numpy as jnp
+
+        arrays = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                  for a in args]
+        if out_shapes is None:
+            out_shapes = self._out_shape_fn(arrays)
+        out_struct = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                      for s, d in out_shapes]
+        if len(out_struct) == 1:
+            out_struct = out_struct[0]
+        key = tuple((a.shape, str(a.dtype)) for a in arrays) + (grid,)
+        if key not in self._compiled:
+            kw = {} if grid == (1,) else {"grid": grid}
+            # CPU backend only supports pallas in interpret mode (tests /
+            # fake-mesh runs); real Mosaic lowering on TPU
+            if jax.default_backend() != "tpu":
+                kw["interpret"] = True
+            call = pl.pallas_call(self._fn, out_shape=out_struct, **kw)
+            self._compiled[key] = jax.jit(call)
+        res = self._compiled[key](*arrays)
+        if isinstance(res, (tuple, list)):
+            return [NDArray(r) for r in res]
+        return NDArray(res)
+
+    __call__ = launch
+
+
+class PallasModule:
+    """TPU runtime-compiled kernels (the NVRTC/CudaModule analog).
+
+    ``kernels``: dict name -> Pallas kernel function (refs in, refs out) —
+    the Python function IS the kernel source on this target.  An optional
+    ``out_shape_fns`` dict maps name -> fn(input_arrays) -> [(shape, dtype)]
+    (default: first input's shape/dtype, elementwise-style).
+    """
+
+    def __init__(self, kernels, out_shape_fns=None):
+        if not isinstance(kernels, dict) or not kernels:
+            raise MXNetError("PallasModule needs a dict of kernel functions")
+        self._kernels = dict(kernels)
+        self._out_shape_fns = dict(out_shape_fns or {})
+
+    def get_kernel(self, name, signature=None):
+        """Mirror ``CudaModule.get_kernel(name, signature)`` — the signature
+        string is accepted and ignored (shapes/dtypes are inferred at
+        launch)."""
+        if name not in self._kernels:
+            raise MXNetError(f"no kernel {name!r} in module "
+                             f"(have {sorted(self._kernels)})")
+        fn = self._kernels[name]
+        out_fn = self._out_shape_fns.get(
+            name, lambda arrs: [(arrs[0].shape, arrs[0].dtype)])
+        return _PallasKernel(fn, name, out_fn)
+
+
+class CudaModule:
+    def __init__(self, *a, **kw):
+        raise MXNetError(
+            "mx.rtc.CudaModule requires CUDA/NVRTC, which this TPU-native "
+            "build does not target; use mx.rtc.PallasModule — Pallas kernel "
+            "functions are the TPU analog of runtime-compiled CUDA strings")

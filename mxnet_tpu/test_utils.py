@@ -166,3 +166,50 @@ class environment:
                 self._os.environ.pop(k, None)
             else:
                 self._os.environ[k] = v
+
+
+def check_symbolic_forward(sym, args, expected, rtol=None, atol=None,
+                           ctx=None):
+    """Bind a symbol with ``args`` (list in list_arguments order or dict)
+    and compare outputs against ``expected`` (reference
+    ``check_symbolic_forward``)."""
+    from . import ndarray as nd
+    arg_names = sym.list_arguments()
+    if isinstance(args, (list, tuple)):
+        args = dict(zip(arg_names, args))
+    args = {k: v if hasattr(v, "_data") else nd.array(v)
+            for k, v in args.items()}
+    ex = sym.bind(ctx=ctx, args=args, grad_req="null")
+    outputs = ex.forward(is_train=False)
+    assert len(outputs) == len(expected), \
+        f"{len(outputs)} outputs != {len(expected)} expected"
+    for o, e in zip(outputs, expected):
+        assert_almost_equal(o, e, rtol, atol)
+    return outputs
+
+
+def check_symbolic_backward(sym, args, out_grads, expected_grads, rtol=None,
+                            atol=None, grad_req="write", ctx=None):
+    """Bind, forward, backward with ``out_grads``, compare argument
+    gradients (reference ``check_symbolic_backward``)."""
+    from . import ndarray as nd
+    arg_names = sym.list_arguments()
+    if isinstance(args, (list, tuple)):
+        args = dict(zip(arg_names, args))
+    args = {k: v if hasattr(v, "_data") else nd.array(v)
+            for k, v in args.items()}
+    if isinstance(expected_grads, (list, tuple)):
+        expected_grads = dict(zip(arg_names, expected_grads))
+    ex = sym.bind(ctx=ctx, args=args, grad_req=grad_req)
+    ex.forward(is_train=True)
+    ex.backward([g if hasattr(g, "_data") else nd.array(g)
+                 for g in (out_grads if isinstance(out_grads, (list, tuple))
+                           else [out_grads])])
+    grads = ex.grad_dict
+    for name, e in expected_grads.items():
+        if e is None:
+            continue
+        assert name in grads, f"no gradient computed for {name}"
+        assert_almost_equal(grads[name], e, rtol, atol,
+                            names=(f"grad({name})", "expected"))
+    return grads

@@ -99,3 +99,38 @@ class TestOpperf:
         res = run_op_benchmark(["sigmoid"], warmup=1, runs=2)
         assert res[0]["op"] == "sigmoid"
         assert "jit_ms" in res[0]
+
+
+class TestRTC:
+    def test_pallas_module_kernel(self):
+        import mxnet_tpu as mx
+
+        def addmul(x_ref, y_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+        mod = mx.rtc.PallasModule({"addmul": addmul})
+        k = mod.get_kernel("addmul")
+        x = mx.nd.array(onp.arange(8, dtype=onp.float32).reshape(2, 4))
+        out = k([x, mx.nd.ones((2, 4))])
+        onp.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 2 + 1)
+
+    def test_unknown_kernel_and_cuda_gate(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.base import MXNetError
+        mod = mx.rtc.PallasModule({"k": lambda x_ref, o_ref: None})
+        with pytest.raises(MXNetError):
+            mod.get_kernel("missing")
+        with pytest.raises(MXNetError):
+            mx.rtc.CudaModule("source")
+
+
+class TestSymbolicCheckers:
+    def test_check_symbolic_forward_backward(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                          check_symbolic_backward)
+        x = onp.random.rand(3, 4).astype(onp.float32) - 0.5
+        s = mx.sym.relu(mx.sym.var("x"))
+        check_symbolic_forward(s, [x], [onp.maximum(x, 0)])
+        check_symbolic_backward(s, [x], [onp.ones_like(x)],
+                                [(x > 0).astype(onp.float32)])
