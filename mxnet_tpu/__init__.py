@@ -46,6 +46,7 @@ def __getattr__(name):
         "profiler": ".profiler",
         "runtime": ".runtime",
         "rtc": ".rtc",
+        "checkpoint": ".checkpoint",
         "util": ".util",
         "image": ".image",
         "recordio": ".recordio",

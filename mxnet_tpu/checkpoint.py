@@ -1,0 +1,144 @@
+"""``mx.checkpoint`` — orbax-backed sharded/async checkpointing.
+
+Reference context (SURVEY.md §5.3/§5.4): the reference's fault-tolerance
+story is "checkpoint every epoch and restart the launcher"; its formats are
+the ``.params`` binary (kept, ndarray/serialization.py) + optimizer-state
+pickles.  The TPU-native upgrade specified in SURVEY.md is "orbax
+checkpoints (sharded, async) + auto-resume" — this module is that:
+
+- :class:`CheckpointManager` — step-indexed directory of checkpoints with
+  retention, async save (training continues while the previous step
+  serializes), and sharding-aware restore (multi-host: each host writes its
+  own shards).
+- :func:`save` / :func:`restore` / :func:`latest_step` — functional API
+  over a Gluon block (+ optional Trainer state).
+- auto-resume: ``restore(...)`` with ``step=None`` loads the newest
+  complete checkpoint, the launcher-restart recovery loop in one call.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CheckpointManager", "save", "restore", "latest_step"]
+
+
+def _block_tree(block):
+    """Block params as a flat name->jax.Array dict (structured names)."""
+    params = block._collect_params_with_prefix()
+    out = {}
+    for name, p in params.items():
+        if p._data is None:
+            raise MXNetError(f"checkpoint: parameter {name} uninitialized")
+        out[name] = p.data()._data
+    return out
+
+
+def _trainer_tree(trainer):
+    if trainer is None:
+        return None
+    states = [s for s, made in zip(trainer._states, trainer._states_created)]
+    return {
+        "states": states,
+        "created": list(trainer._states_created),
+        "num_update": trainer._optimizer.num_update,
+        "index_update_count": dict(trainer._optimizer._index_update_count),
+    }
+
+
+class CheckpointManager:
+    """Step-indexed async checkpoints (orbax CheckpointManager facade)."""
+
+    def __init__(self, directory, max_to_keep=5, async_save=True):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                            enable_async_checkpointing=
+                                            async_save)
+        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+
+    def save(self, step, block, trainer=None, extra=None):
+        """Async-save params (+ trainer optimizer state, + extra numpy
+        pytree) at ``step``."""
+        import orbax.checkpoint as ocp
+        tree = {"params": _block_tree(block)}
+        t = _trainer_tree(trainer)
+        if t is not None:
+            tree["trainer"] = t
+        if extra is not None:
+            tree["extra"] = extra
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        return step
+
+    def restore(self, block, trainer=None, step=None):
+        """Restore into ``block`` (and ``trainer``); ``step=None`` resumes
+        from the newest complete checkpoint.  Returns the step restored, or
+        None if the directory has no checkpoints (fresh start)."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                return None
+        restored = self._mgr.restore(step)
+        params = block._collect_params_with_prefix()
+        loaded = restored["params"]
+        for name, p in params.items():
+            if name not in loaded:
+                raise MXNetError(f"checkpoint missing parameter {name}")
+            p._load_init(NDArray(jax.numpy.asarray(loaded[name])))
+        if trainer is not None and "trainer" in restored:
+            t = restored["trainer"]
+            trainer._states = list(t["states"])
+            trainer._states_created = [bool(x) for x in t["created"]]
+            trainer._optimizer.num_update = int(t["num_update"])
+            trainer._optimizer._index_update_count = {
+                int(k) if str(k).isdigit() else k: int(v)
+                for k, v in t["index_update_count"].items()}
+        return step
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def wait_until_finished(self):
+        """Block until pending async saves are durably written."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+def save(directory, step, block, trainer=None):
+    """One-shot save (sync): ``mx.checkpoint.save(dir, step, net, trainer)``."""
+    mgr = CheckpointManager(directory, async_save=False)
+    try:
+        mgr.save(step, block, trainer)
+        mgr.wait_until_finished()
+    finally:
+        mgr.close()
+    return step
+
+
+def restore(directory, block, trainer=None, step=None):
+    """One-shot restore; ``step=None`` = auto-resume from newest."""
+    mgr = CheckpointManager(directory, async_save=False)
+    try:
+        return mgr.restore(block, trainer, step)
+    finally:
+        mgr.close()
+
+
+def latest_step(directory):
+    mgr = CheckpointManager(directory, async_save=False)
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()

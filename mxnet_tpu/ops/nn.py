@@ -552,3 +552,251 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *,
     out = jnp.matmul(attention, v)  # (B*heads, L, hd)
     out = out.reshape(B, heads, L, hd)
     return jnp.transpose(out, (2, 0, 1, 3)).reshape(L, B, E)
+
+
+# ----------------------------------------------------------------------- #
+# vision ops: upsampling / resize / ROI / NMS / spatial sampling
+# (reference src/operator/{nn,contrib}/ — SURVEY.md §3.1 operator corpus)
+# ----------------------------------------------------------------------- #
+
+@op("UpSampling")
+def UpSampling(data, *, scale=2, sample_type="nearest", num_args=1):
+    """Reference anchor ``UpSampling`` (NCHW).  nearest: repeat; bilinear:
+    resize (the reference's bilinear path uses a Deconvolution with a fixed
+    kernel — same result)."""
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        return out
+    return jax.image.resize(data, (n, c, h * scale, w * scale),
+                            method="bilinear")
+
+
+@op("_contrib_BilinearResize2D")
+def BilinearResize2D(data, *, height=0, width=0, scale_height=None,
+                     scale_width=None, mode="size",
+                     align_corners=True):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(round(h * scale_height))
+        width = int(round(w * (scale_width or scale_height)))
+    return jax.image.resize(data, (n, c, int(height), int(width)),
+                            method="bilinear")
+
+
+alias("BilinearResize2D", "_contrib_BilinearResize2D")
+
+
+@op("_contrib_ROIAlign")
+def ROIAlign(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=2, position_sensitive=False, aligned=False):
+    """Reference anchor ``_contrib_ROIAlign`` (RCNN head).  rois:
+    (R, 5) [batch_idx, x1, y1, x2, y2] in image coords.  Bilinear sampling
+    on a fixed grid — vectorized over ROIs/bins, MXU-free but fully fused
+    by XLA."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+    rois = rois.astype(jnp.float32)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    offset = 0.5 if aligned else 0.0
+    x1 = rois[:, 1] * spatial_scale - offset
+    y1 = rois[:, 2] * spatial_scale - offset
+    x2 = rois[:, 3] * spatial_scale - offset
+    y2 = rois[:, 4] * spatial_scale - offset
+    roi_w = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    roi_h = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+    bin_h = roi_h / ph                                   # (R,)
+    bin_w = roi_w / pw
+    s = max(int(sample_ratio), 1)
+    # sample grid: (ph*s) x (pw*s) points per ROI
+    iy = (jnp.arange(ph * s) + 0.5) / s                  # in bin units
+    ix = (jnp.arange(pw * s) + 0.5) / s
+    ys = y1[:, None] + bin_h[:, None] * iy[None, :]      # (R, ph*s)
+    xs = x1[:, None] + bin_w[:, None] * ix[None, :]      # (R, pw*s)
+
+    def bilinear(img, yy, xx):
+        """img: (c,h,w); yy: (ph*s,); xx: (pw*s,) → (c, ph*s, pw*s)."""
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = (yy - y0)[:, None]
+        wx = (xx - x0)[None, :]
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0]
+        v11 = img[:, y1i][:, :, x1i]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def per_roi(b, yy, xx):
+        img = data[b]                                    # (c,h,w)
+        sampled = bilinear(img, yy, xx)                  # (c, ph*s, pw*s)
+        pooled = sampled.reshape(c, ph, s, pw, s).mean(axis=(2, 4))
+        return pooled
+
+    return jax.vmap(per_roi)(batch_idx, ys, xs)          # (R, c, ph, pw)
+
+
+alias("ROIAlign", "_contrib_ROIAlign")
+
+
+@op("ROIPooling")
+def ROIPooling(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0):
+    """Reference anchor ``ROIPooling`` (max-pool variant, Fast-RCNN)."""
+    n, c, h, w = data.shape
+    ph, pw = pooled_size
+    rois = rois.astype(jnp.float32)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 4] * spatial_scale).astype(jnp.int32)
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def per_roi(b, yy1, xx1, yy2, xx2):
+        img = data[b]
+        roi_h = jnp.maximum(yy2 - yy1 + 1, 1)
+        roi_w = jnp.maximum(xx2 - xx1 + 1, 1)
+        # bin index of every pixel, -1 outside the roi
+        ybin = jnp.where((ys >= yy1) & (ys <= yy2),
+                         ((ys - yy1) * ph) // roi_h, -1)
+        xbin = jnp.where((xs >= xx1) & (xs <= xx2),
+                         ((xs - xx1) * pw) // roi_w, -1)
+        onehot_y = (ybin[None, :] == jnp.arange(ph)[:, None])  # (ph, h)
+        onehot_x = (xbin[None, :] == jnp.arange(pw)[:, None])  # (pw, w)
+        mask = onehot_y[:, None, :, None] & onehot_x[None, :, None, :]
+        big = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        out = big.max(axis=(3, 4))                        # (c, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(per_roi)(batch_idx, y1, x1, y2, x2)
+
+
+@op("_contrib_box_nms", differentiable=False)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Reference anchor ``_contrib_box_nms`` (SSD/RCNN post-processing).
+    data: (..., N, K) rows [id?, score, x1, y1, x2, y2, ...]; suppressed
+    rows have score set to -1 (reference convention).  Static-shape NMS via
+    a fori-loop over the score-sorted boxes."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(batch, coord_start, 4, axis=1)
+        ids = batch[:, id_index] if id_index >= 0 else None
+        order = jnp.argsort(-scores)
+        n = scores.shape[0]
+        keep_lim = n if topk < 0 else builtins.min(topk, n)
+
+        x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+        area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+
+        def iou(i, j):
+            xx1 = jnp.maximum(x1[i], x1[j])
+            yy1 = jnp.maximum(y1[i], y1[j])
+            xx2 = jnp.minimum(x2[i], x2[j])
+            yy2 = jnp.minimum(y2[i], y2[j])
+            inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+            return inter / jnp.maximum(area[i] + area[j] - inter, 1e-12)
+
+        def body(k, suppressed):
+            i = order[k]
+            valid_i = jnp.logical_and(~suppressed[i],
+                                      scores[i] >= valid_thresh)
+            valid_i = jnp.logical_and(valid_i, k < keep_lim)
+            others = order
+            ious = jax.vmap(lambda j: iou(i, j))(others)
+            same_class = jnp.ones_like(ious, bool) if (
+                force_suppress or ids is None) else (ids[others] == ids[i])
+            kill = (ious > overlap_thresh) & same_class & \
+                (jnp.arange(n) > k)
+            kill_idx = jnp.where(kill, others, i)
+            new_sup = suppressed.at[kill_idx].set(
+                jnp.where(kill, valid_i | suppressed[kill_idx],
+                          suppressed[kill_idx]))
+            return new_sup
+
+        suppressed = lax.fori_loop(0, n, body,
+                                   jnp.zeros(n, bool))
+        new_scores = jnp.where(suppressed | (scores < valid_thresh),
+                               -1.0, scores)
+        return batch.at[:, score_index].set(new_scores)
+
+    return jax.vmap(one)(flat).reshape(shape)
+
+
+alias("box_nms", "_contrib_box_nms")
+
+
+@op("GridGenerator")
+def GridGenerator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """Reference anchor ``GridGenerator``: affine (N,6) → sampling grid
+    (N, 2, H, W) in [-1, 1] coords (pairs with BilinearSampler — the STN
+    pipeline)."""
+    h, w = target_shape
+    theta = data.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                       # (h, w)
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, h*w)
+    out = jnp.einsum("nij,jk->nik", theta.astype(jnp.float32), coords)
+    return out.reshape(-1, 2, h, w)
+
+
+@op("BilinearSampler")
+def BilinearSampler(data, grid, *, cudnn_off=False):
+    """Reference anchor ``BilinearSampler``: sample NCHW data at grid
+    (N, 2, H', W') of [-1, 1] (x, y) coords."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0             # (n, H', W')
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+
+    def sample(img, yy, xx):
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1 = y0 + 1
+        x1 = x0 + 1
+        wy = yy - y0
+        wx = xx - x0
+
+        def at(yi, xi):
+            inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            yi = jnp.clip(yi, 0, h - 1)
+            xi = jnp.clip(xi, 0, w - 1)
+            v = img[:, yi, xi]                          # (c, H', W')
+            return jnp.where(inside[None], v, 0.0)
+
+        return (at(y0, x0) * (1 - wy) * (1 - wx) +
+                at(y0, x1) * (1 - wy) * wx +
+                at(y1, x0) * wy * (1 - wx) +
+                at(y1, x1) * wy * wx)
+
+    return jax.vmap(sample)(data, gy, gx)
+
+
+# activation stragglers (reference mshadow_op corpus)
+@op("log_sigmoid")
+def log_sigmoid(data):
+    return jax.nn.log_sigmoid(data)
+
+
+@op("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@op("mish")
+def mish(data):
+    return data * jnp.tanh(jax.nn.softplus(data))
+
+
+alias("SliceChannel", "split")

@@ -1,0 +1,62 @@
+"""Orbax checkpoint tests (SURVEY.md §5.3/§5.4 TPU-native answer:
+sharded/async checkpoints + auto-resume)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def _net_and_trainer():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    return net, trainer
+
+
+def _train(net, trainer, x, y, steps):
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(steps):
+        with autograd.record():
+            L = loss_fn(net(x), y)
+        L.backward()
+        trainer.step(x.shape[0])
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        x = mx.nd.array(onp.random.rand(4, 5).astype(onp.float32))
+        y = mx.nd.array(onp.random.rand(4, 3).astype(onp.float32))
+        net, trainer = _net_and_trainer()
+        _train(net, trainer, x, y, 3)
+        ref = net(x).asnumpy()
+        mx.checkpoint.save(str(tmp_path), 3, net, trainer)
+
+        net2, tr2 = _net_and_trainer()
+        net2(x)
+        step = mx.checkpoint.restore(str(tmp_path), net2, tr2)
+        assert step == 3
+        onp.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)
+        # optimizer state restored → continued training is bit-identical
+        _train(net, trainer, x, y, 1)
+        _train(net2, tr2, x, y, 1)
+        onp.testing.assert_allclose(net2(x).asnumpy(), net(x).asnumpy(),
+                                    rtol=1e-6)
+
+    def test_auto_resume_empty_dir(self, tmp_path):
+        net, _ = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path / "none"), net) is None
+
+    def test_manager_retention(self, tmp_path):
+        net, _ = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        mgr = mx.checkpoint.CheckpointManager(str(tmp_path), max_to_keep=2)
+        for s in (1, 2, 3):
+            mgr.save(s, net)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 3
+        assert len(mgr.all_steps()) <= 2
+        mgr.close()

@@ -1,0 +1,118 @@
+"""Vision op tests: UpSampling, BilinearResize2D, ROIAlign/ROIPooling,
+box_nms, GridGenerator/BilinearSampler (reference
+src/operator/{nn,contrib}; SURVEY.md §3.1 operator corpus)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+class TestUpsample:
+    def test_nearest_matches_torch(self):
+        import torch
+        x = mx.nd.array(onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4))
+        up = mx.nd.UpSampling(x, scale=2, sample_type="nearest")
+        ref = torch.nn.functional.interpolate(
+            torch.tensor(x.asnumpy()), scale_factor=2, mode="nearest").numpy()
+        onp.testing.assert_allclose(up.asnumpy(), ref)
+
+    def test_bilinear_shapes(self):
+        x = mx.nd.ones((2, 3, 4, 4))
+        assert mx.nd.UpSampling(x, scale=3,
+                                sample_type="bilinear").shape == (2, 3, 12, 12)
+        assert mx.nd.BilinearResize2D(x, height=7,
+                                      width=5).shape == (2, 3, 7, 5)
+
+
+class TestROI:
+    def test_roialign_constant_region(self):
+        img = mx.nd.array(onp.full((1, 2, 16, 16), 5.0, onp.float32))
+        rois = mx.nd.array(onp.array([[0, 2, 2, 10, 10]], onp.float32))
+        out = mx.nd.ROIAlign(img, rois, pooled_size=(4, 4))
+        assert out.shape == (1, 2, 4, 4)
+        onp.testing.assert_allclose(out.asnumpy(), 5.0)
+
+    def test_roialign_gradient_flows(self):
+        img = mx.nd.array(onp.random.rand(1, 2, 8, 8).astype(onp.float32))
+        rois = mx.nd.array(onp.array([[0, 1, 1, 6, 6]], onp.float32))
+        img.attach_grad()
+        with autograd.record():
+            out = mx.nd.ROIAlign(img, rois, pooled_size=(2, 2))
+        out.backward(mx.nd.ones(out.shape))
+        assert float(onp.asarray(img.grad.abs().sum().asnumpy())) > 0
+
+    def test_roipooling_max(self):
+        img_np = onp.zeros((1, 1, 8, 8), onp.float32)
+        img_np[0, 0, 3, 3] = 9.0
+        rois = mx.nd.array(onp.array([[0, 0, 0, 7, 7]], onp.float32))
+        out = mx.nd.ROIPooling(mx.nd.array(img_np), rois, pooled_size=(2, 2))
+        assert float(out.asnumpy().max()) == 9.0
+
+    def test_batch_index_selects_image(self):
+        data = onp.stack([onp.full((1, 4, 4), 1.0), onp.full((1, 4, 4), 2.0)])
+        rois = mx.nd.array(onp.array([[1, 0, 0, 3, 3]], onp.float32))
+        out = mx.nd.ROIAlign(mx.nd.array(data.astype(onp.float32)), rois,
+                             pooled_size=(2, 2))
+        onp.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+class TestBoxNMS:
+    def test_suppresses_overlap(self):
+        boxes = onp.array([[0, 0.9, 0, 0, 10, 10],
+                           [0, 0.8, 1, 1, 11, 11],
+                           [1, 0.7, 20, 20, 30, 30]], onp.float32)
+        out = mx.nd.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                            coord_start=2, score_index=1, id_index=0,
+                            force_suppress=True)
+        onp.testing.assert_allclose(out.asnumpy()[:, 1], [0.9, -1.0, 0.7])
+
+    def test_per_class_no_suppression(self):
+        # overlapping boxes of DIFFERENT class ids both survive
+        boxes = onp.array([[0, 0.9, 0, 0, 10, 10],
+                           [1, 0.8, 1, 1, 11, 11]], onp.float32)
+        out = mx.nd.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                            coord_start=2, score_index=1, id_index=0)
+        onp.testing.assert_allclose(out.asnumpy()[:, 1], [0.9, 0.8])
+
+    def test_valid_thresh(self):
+        boxes = onp.array([[0, 0.05, 0, 0, 5, 5]], onp.float32)
+        out = mx.nd.box_nms(mx.nd.array(boxes), valid_thresh=0.1,
+                            coord_start=2, score_index=1, id_index=0)
+        assert float(out.asnumpy()[0, 1]) == -1.0
+
+
+class TestSpatialTransformer:
+    def test_identity_transform(self):
+        theta = mx.nd.array(onp.array([[1, 0, 0, 0, 1, 0]], onp.float32))
+        grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                                   target_shape=(4, 4))
+        x = mx.nd.array(onp.random.rand(1, 1, 4, 4).astype(onp.float32))
+        out = mx.nd.BilinearSampler(x, grid)
+        onp.testing.assert_allclose(out.asnumpy(), x.asnumpy(), atol=1e-5)
+
+    def test_translation_shifts(self):
+        # x-shift of a delta image moves the bright pixel
+        theta = mx.nd.array(onp.array([[1, 0, 0.5, 0, 1, 0]], onp.float32))
+        grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                                   target_shape=(1, 5))
+        x = onp.zeros((1, 1, 1, 5), onp.float32)
+        x[0, 0, 0, 4] = 1.0
+        out = mx.nd.BilinearSampler(mx.nd.array(x), grid)
+        assert float(out.asnumpy()[0, 0, 0, 3]) > 0.9
+
+
+class TestActivations:
+    def test_values(self):
+        x = mx.nd.array(onp.array([-1.0, 0.0, 2.0], onp.float32))
+        onp.testing.assert_allclose(
+            mx.nd.hard_sigmoid(x).asnumpy(),
+            onp.clip(0.2 * x.asnumpy() + 0.5, 0, 1), rtol=1e-6)
+        onp.testing.assert_allclose(
+            mx.nd.log_sigmoid(x).asnumpy(),
+            onp.log(1 / (1 + onp.exp(-x.asnumpy()))), rtol=1e-5)
+        import torch
+        onp.testing.assert_allclose(
+            mx.nd.mish(x).asnumpy(),
+            torch.nn.functional.mish(torch.tensor(x.asnumpy())).numpy(),
+            rtol=1e-5)
