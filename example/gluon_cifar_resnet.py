@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Train ResNet-18 (thumbnail) on CIFAR-10 with the Gluon API
+(reference ``example/image-classification`` workflow).
+
+Uses real CIFAR-10 from ``--data-dir`` when present, else deterministic
+synthetic data (the reference's ``--benchmark 1`` dummy-data mode).
+
+    python example/gluon_cifar_resnet.py --epochs 2 --batch-size 64
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--data-dir", default=os.path.join("~", ".mxnet",
+                                                      "datasets", "cifar10"))
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="use N synthetic samples instead of real CIFAR")
+    p.add_argument("--hybridize", type=int, default=1)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import CIFAR10, transforms as T
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    transform = T.Compose([T.ToTensor(),
+                           T.Normalize([0.4914, 0.4822, 0.4465],
+                                       [0.2470, 0.2435, 0.2616])])
+    try:
+        train = CIFAR10(root=args.data_dir, train=True,
+                        synthetic=args.synthetic)
+    except Exception:
+        print("CIFAR-10 not found; falling back to synthetic data")
+        train = CIFAR10(train=True, synthetic=args.synthetic or 512)
+    loader = DataLoader(train.transform_first(transform),
+                        batch_size=args.batch_size, shuffle=True,
+                        num_workers=2, last_batch="discard")
+
+    net = get_resnet(1, 18, thumbnail=True, classes=10)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for x, y in loader:
+            x = x.as_in_context(ctx)
+            y = y.astype("float32").as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+            n += x.shape[0]
+        name, acc = metric.get()
+        dt = time.time() - tic
+        print(f"epoch {epoch}: {name}={acc:.4f} "
+              f"({n / dt:.0f} samples/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

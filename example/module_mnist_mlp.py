@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Legacy Module-API MLP on MNIST (reference
+``example/image-classification/train_mnist.py`` workflow): symbolic graph,
+``mod.fit``, epoch checkpoints via ``mx.callback.do_checkpoint``.
+
+    python example/module_mnist_mlp.py --epochs 3
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_sym():
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    h = mx.sym.FullyConnected(data, mx.sym.var("fc1_weight"),
+                              mx.sym.var("fc1_bias"), num_hidden=128,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, mx.sym.var("fc2_weight"),
+                              mx.sym.var("fc2_bias"), num_hidden=64,
+                              name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, mx.sym.var("fc3_weight"),
+                              mx.sym.var("fc3_bias"), num_hidden=10,
+                              name="fc3")
+    return mx.sym.SoftmaxOutput(h, label, name="softmax")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--synthetic", type=int, default=0)
+    p.add_argument("--checkpoint-prefix", default=None)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data.vision import MNIST
+    from mxnet_tpu.module import Module
+
+    try:
+        ds = MNIST(train=True, synthetic=args.synthetic)
+    except Exception:
+        print("MNIST not found; using synthetic data")
+        ds = MNIST(train=True, synthetic=args.synthetic or 2000)
+    X = ds._data.reshape(len(ds), -1).astype("float32") / 255.0
+    y = ds._label.astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size, shuffle=True)
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mod = Module(build_sym(), context=ctx)
+    cbs = []
+    if args.checkpoint_prefix:
+        cbs.append(mx.callback.do_checkpoint(args.checkpoint_prefix))
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params=(("learning_rate", args.lr),
+                              ("momentum", 0.9)),
+            initializer=mx.init.Xavier(),
+            epoch_end_callback=cbs or None,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    print("final:", mod.score(it, "acc"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

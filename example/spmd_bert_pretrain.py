@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""BERT-base MLM pretraining with the fused SPMD train step over a device
+mesh (the TPU-native form of the reference's GluonNLP BERT recipe;
+SURVEY.md §6 config 3).
+
+On one chip the mesh is {dp:1}; on a pod slice set --dp/--tp to shard.
+Synthetic token streams keep it hermetic (reference --benchmark mode).
+
+    python example/spmd_bert_pretrain.py --steps 20 --batch-size 64
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--dp", type=int, default=0, help="data-parallel size "
+                   "(default: all devices)")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    p.add_argument("--lr", type=float, default=1e-4)
+    args = p.parse_args(argv)
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models import BERTModel, BERTConfig
+
+    mx.random.seed(0)
+    ndev = len(jax.devices())
+    dp = args.dp or max(1, ndev // args.tp)
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    cfg = BERTConfig(vocab_size=30528, max_length=args.seq_len,
+                     num_layers=args.layers, units=768, num_heads=12,
+                     hidden_size=3072,
+                     dtype="bfloat16" if on_tpu else "float32")
+    bert = BERTModel(cfg, use_pooler=False, use_mlm=True)
+
+    class MLMHead(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.bert = bert
+
+        def forward(self, tokens):
+            return self.bert(tokens)[-1]
+
+    net = MLMHead()
+    net.initialize(mx.init.Normal(0.02))
+    axes = {"dp": dp}
+    if args.tp > 1:
+        axes["tp"] = args.tp
+    mesh = parallel.make_mesh(axes)
+    trainer = parallel.SPMDTrainer(net,
+                                   gluon.loss.SoftmaxCrossEntropyLoss(),
+                                   "adamw", {"learning_rate": args.lr},
+                                   mesh=mesh)
+
+    rng = onp.random.RandomState(0)
+    toks = mx.nd.array(rng.randint(0, cfg.vocab_size,
+                                   (args.batch_size, args.seq_len)))
+    labels = mx.nd.array(rng.randint(0, cfg.vocab_size,
+                                     (args.batch_size, args.seq_len)))
+    # warmup/compile
+    float(onp.asarray(trainer.step(toks, labels).asnumpy()).reshape(()))
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(args.steps):
+        loss = trainer.step(toks, labels)
+    final = float(onp.asarray(loss.asnumpy()).reshape(()))
+    dt = time.perf_counter() - t0
+    toks_per_s = args.batch_size * args.seq_len * args.steps / dt
+    print(f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"loss={final:.4f} {toks_per_s:.0f} tokens/s "
+          f"({toks_per_s / max(ndev,1):.0f}/device)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

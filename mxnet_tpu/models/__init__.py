@@ -13,10 +13,14 @@ from .transformer import (MultiHeadAttention, PositionwiseFFN,
 from .gpt import GPT, GPTConfig, gpt2_small, gpt2_medium, gpt2_large, \
     gpt2_774m, gpt_tp_rules
 from .bert import BERTModel, BERTConfig, bert_base, bert_large
+from .seq2seq import (CrossAttention, Seq2SeqEncoder, Seq2SeqDecoder,
+                      Seq2SeqDecoderCell, TransformerSeq2Seq)
 
 __all__ = [
     "MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
     "TransformerDecoderCell", "GPT", "GPTConfig", "gpt2_small",
     "gpt2_medium", "gpt2_large", "gpt2_774m", "gpt_tp_rules",
     "BERTModel", "BERTConfig", "bert_base", "bert_large",
+    "CrossAttention", "Seq2SeqEncoder", "Seq2SeqDecoder",
+    "Seq2SeqDecoderCell", "TransformerSeq2Seq",
 ]

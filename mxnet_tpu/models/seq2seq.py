@@ -1,0 +1,217 @@
+"""Transformer seq2seq — full encoder-decoder (SURVEY.md §6 config 4
+"Transformer seq2seq"; the reference serves this via GluonNLP's
+``nlp.model.transformer``).
+
+TPU-native: all attention goes through the flash kernel; the whole model
+is hybridizable into one XLA program; greedy decode runs length-static
+steps (compiler-friendly — no dynamic shapes inside jit).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense, Dropout, Embedding, LayerNorm
+from .transformer import MultiHeadAttention, PositionwiseFFN
+
+__all__ = ["CrossAttention", "Seq2SeqEncoder", "Seq2SeqDecoderCell",
+           "Seq2SeqDecoder", "TransformerSeq2Seq"]
+
+
+class CrossAttention(HybridBlock):
+    """Decoder→encoder attention: queries from x, keys/values from memory."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise MXNetError(f"units {units} % heads {num_heads} != 0")
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.q = Dense(units, flatten=False, use_bias=use_bias,
+                           in_units=units, dtype=dtype, prefix="q_")
+            self.kv = Dense(2 * units, flatten=False, use_bias=use_bias,
+                            in_units=units, dtype=dtype, prefix="kv_")
+            self.proj = Dense(units, flatten=False, use_bias=use_bias,
+                              in_units=units, dtype=dtype, prefix="out_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, memory, mem_mask=None):
+        B, Lq, U = x.shape
+        Lk = memory.shape[1]
+        H, D = self._heads, self._units // self._heads
+        q = F.transpose(F.reshape(self.q(x), shape=(B, Lq, H, D)),
+                        axes=(0, 2, 1, 3))                    # (B,H,Lq,D)
+        kv = F.reshape(self.kv(memory), shape=(B, Lk, 2, H, D))
+        kv = F.transpose(kv, axes=(2, 0, 3, 1, 4))            # (2,B,H,Lk,D)
+        k = F.reshape(F.slice_axis(kv, axis=0, begin=0, end=1),
+                      shape=(B, H, Lk, D))
+        v = F.reshape(F.slice_axis(kv, axis=0, begin=1, end=2),
+                      shape=(B, H, Lk, D))
+        out = F.flash_attention(q, k, v, mem_mask, causal=False)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, Lq, U))
+        out = self.proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class Seq2SeqDecoderCell(HybridBlock):
+    """Pre-norm decoder layer: causal self-attn + cross-attn + FFN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=units, prefix="ln1_")
+            self.self_attn = MultiHeadAttention(units, num_heads, dropout,
+                                                causal=True, dtype=dtype,
+                                                prefix="self_")
+            self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
+            self.cross_attn = CrossAttention(units, num_heads, dropout,
+                                             dtype=dtype, prefix="cross_")
+            self.ln3 = LayerNorm(in_channels=units, prefix="ln3_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       dtype=dtype, prefix="ffn_")
+
+    def hybrid_forward(self, F, x, memory, mem_mask=None):
+        x = x + self.self_attn(self.ln1(x))
+        x = x + self.cross_attn(self.ln2(x), memory, mem_mask)
+        return x + self.ffn(self.ln3(x))
+
+
+class _EmbeddingStack(HybridBlock):
+    def __init__(self, vocab_size, units, max_length, dropout, dtype,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.word = Embedding(vocab_size, units, dtype=dtype,
+                                  prefix="word_")
+            self.pos = Embedding(max_length, units, dtype=dtype,
+                                 prefix="pos_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, tokens):
+        B, L = tokens.shape
+        pos = F.arange(L).reshape((1, L))
+        x = self.word(tokens) * (self._units ** 0.5) + self.pos(pos)
+        if self.drop is not None:
+            x = self.drop(x)
+        return x
+
+
+class Seq2SeqEncoder(HybridBlock):
+    def __init__(self, vocab_size, units, hidden_size, num_heads, num_layers,
+                 max_length=512, dropout=0.0, dtype="float32", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        from .transformer import TransformerEncoderCell
+        with self.name_scope():
+            self.embed = _EmbeddingStack(vocab_size, units, max_length,
+                                         dropout, dtype, prefix="emb_")
+            self.layers = []
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                              dropout, dtype=dtype,
+                                              prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.layers.append(cell)
+            self.ln = LayerNorm(in_channels=units, prefix="ln_")
+
+    def hybrid_forward(self, F, src_tokens, src_mask=None):
+        x = self.embed(src_tokens)
+        for cell in self.layers:
+            x = cell(x, src_mask) if src_mask is not None else cell(x)
+        return self.ln(x)
+
+
+class Seq2SeqDecoder(HybridBlock):
+    def __init__(self, vocab_size, units, hidden_size, num_heads, num_layers,
+                 max_length=512, dropout=0.0, dtype="float32", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.embed = _EmbeddingStack(vocab_size, units, max_length,
+                                         dropout, dtype, prefix="emb_")
+            self.layers = []
+            for i in range(num_layers):
+                cell = Seq2SeqDecoderCell(units, hidden_size, num_heads,
+                                          dropout, dtype=dtype,
+                                          prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.layers.append(cell)
+            self.ln = LayerNorm(in_channels=units, prefix="ln_")
+
+    def hybrid_forward(self, F, tgt_tokens, memory, mem_mask=None):
+        x = self.embed(tgt_tokens)
+        for cell in self.layers:
+            x = cell(x, memory, mem_mask)
+        return self.ln(x)
+
+
+class TransformerSeq2Seq(HybridBlock):
+    """Full encoder-decoder with a tied-or-free output projection.
+
+    forward(src, tgt) → (B, L_tgt, vocab) logits (teacher forcing);
+    ``greedy_decode(src, max_len, bos, eos)`` runs inference.
+    """
+
+    def __init__(self, vocab_size, units=512, hidden_size=2048, num_heads=8,
+                 num_enc_layers=6, num_dec_layers=6, max_length=512,
+                 dropout=0.1, tie_embeddings=True, dtype="float32",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._tie = tie_embeddings
+        with self.name_scope():
+            self.encoder = Seq2SeqEncoder(vocab_size, units, hidden_size,
+                                          num_heads, num_enc_layers,
+                                          max_length, dropout, dtype,
+                                          prefix="enc_")
+            self.decoder = Seq2SeqDecoder(vocab_size, units, hidden_size,
+                                          num_heads, num_dec_layers,
+                                          max_length, dropout, dtype,
+                                          prefix="dec_")
+            if not tie_embeddings:
+                self.out_proj = Dense(vocab_size, flatten=False,
+                                      in_units=units, use_bias=False,
+                                      dtype=dtype, prefix="outproj_")
+
+    def _project(self, F, x):
+        if self._tie:
+            w = self.decoder.embed.word.weight.data()
+            return F.FullyConnected(x, w, None, num_hidden=w.shape[0],
+                                    no_bias=True, flatten=False)
+        return self.out_proj(x)
+
+    def hybrid_forward(self, F, src_tokens, tgt_tokens, src_mask=None):
+        memory = self.encoder(src_tokens, src_mask)
+        dec = self.decoder(tgt_tokens, memory, src_mask)
+        return self._project(F, dec)
+
+    def greedy_decode(self, src_tokens, max_len=32, bos=1, eos=2):
+        """Host-driven greedy decoding (clear, allocation-free per step);
+        each step re-runs the decoder on the growing prefix — jit caches
+        one program per prefix length like the reference's BucketingModule
+        caches per-bucket graphs."""
+        from .. import ndarray as nd
+        import numpy as np
+        B = src_tokens.shape[0]
+        memory = self.encoder(src_tokens)
+        out = np.full((B, 1), bos, dtype=np.int32)
+        finished = np.zeros(B, dtype=bool)
+        for _ in range(max_len - 1):
+            tgt = nd.array(out, dtype="int32")
+            dec = self.decoder(tgt, memory)
+            from .. import ndarray as F
+            logits = self._project(F, dec)
+            nxt = onp.asarray(logits.asnumpy()[:, -1].argmax(-1),
+                              dtype=np.int32)
+            nxt = np.where(finished, eos, nxt)
+            out = np.concatenate([out, nxt[:, None]], axis=1)
+            finished |= (nxt == eos)
+            if finished.all():
+                break
+        return out
