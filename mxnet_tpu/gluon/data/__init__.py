@@ -10,3 +10,4 @@ from . import vision
 from . import dataset
 from . import sampler
 from . import dataloader
+from . import batchify

@@ -1,0 +1,90 @@
+"""Batchify functions (reference ``gluon/data/batchify.py`` /
+GluonNLP ``nlp.data.batchify``): Stack, Pad, Tuple/Group — composable
+``batchify_fn``s for DataLoader, the variable-length-sequence batching
+surface that feeds BucketingModule-style training."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from ...ndarray import NDArray
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class Stack:
+    """Stack equal-shape samples into a batch tensor."""
+
+    def __call__(self, data):
+        arrs = [_as_np(d) for d in data]
+        out = onp.stack(arrs)
+        if out.dtype == onp.float64:
+            out = out.astype(onp.float32)
+        if out.dtype == onp.int64:
+            out = out.astype(onp.int32)
+        return nd.array(out, dtype=str(out.dtype))
+
+
+class Pad:
+    """Pad variable-length samples to the batch max along ``axis``
+    (reference ``Pad``): optionally also return the valid lengths."""
+
+    def __init__(self, axis=0, pad_val=0, ret_length=False, dtype=None):
+        self._axis = axis
+        self._pad_val = pad_val
+        self._ret_length = ret_length
+        self._dtype = dtype
+
+    def __call__(self, data):
+        arrs = [_as_np(d) for d in data]
+        lengths = onp.array([a.shape[self._axis] for a in arrs],
+                            dtype=onp.int32)
+        max_len = int(lengths.max())
+        padded = []
+        for a in arrs:
+            pad_width = [(0, 0)] * a.ndim
+            pad_width[self._axis] = (0, max_len - a.shape[self._axis])
+            padded.append(onp.pad(a, pad_width, constant_values=self._pad_val))
+        out = onp.stack(padded)
+        if self._dtype:
+            out = out.astype(self._dtype)
+        elif out.dtype == onp.float64:
+            out = out.astype(onp.float32)
+        elif out.dtype == onp.int64:
+            out = out.astype(onp.int32)
+        batch = nd.array(out, dtype=str(out.dtype))
+        if self._ret_length:
+            return batch, nd.array(lengths, dtype="int32")
+        return batch
+
+
+class Tuple:
+    """Apply one batchify fn per sample field: ``Tuple(Pad(), Stack())``."""
+
+    def __init__(self, *fns):
+        if len(fns) == 1 and isinstance(fns[0], (list, tuple)):
+            fns = tuple(fns[0])
+        self._fns = fns
+
+    def __call__(self, data):
+        if len(data[0]) != len(self._fns):
+            raise MXNetError(
+                f"Tuple batchify: sample has {len(data[0])} fields but "
+                f"{len(self._fns)} fns were given")
+        return tuple(fn([sample[i] for sample in data])
+                     for i, fn in enumerate(self._fns))
+
+
+Group = Tuple  # reference alias
+
+
+class List:
+    """Return the samples as a plain python list (no batching)."""
+
+    def __call__(self, data):
+        return list(data)

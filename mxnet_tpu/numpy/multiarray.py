@@ -895,6 +895,127 @@ def expm1x(x):
     return expm1(x)  # noqa: F821
 
 
+def deg2rad(x):
+    return _run1("deg2rad", jnp.deg2rad, x)
+
+
+def rad2deg(x):
+    return _run1("rad2deg", jnp.rad2deg, x)
+
+
+def signbit(x):
+    return _run1("signbit", jnp.signbit, x)
+
+
+def empty_like(prototype, dtype=None, order="C"):
+    p = _coerce_arr(prototype)
+    return ndarray(jnp.empty_like(p._data, dtype=dtype))
+
+
+def diagflat(v, k=0):
+    return _run1("diagflat", lambda x: jnp.diagflat(x, k), v)
+
+
+def diag_indices(n, ndim=2):
+    rs = jnp.diag_indices(n, ndim)
+    return tuple(ndarray(r) for r in rs)
+
+
+def triu_indices(n, k=0, m=None):
+    r, c = jnp.triu_indices(n, k, m)
+    return ndarray(r), ndarray(c)
+
+
+def tri(N, M=None, k=0, dtype=float32):
+    return ndarray(jnp.tri(N, M, k, dtype=jnp.dtype(dtype)))
+
+
+def dsplit(ary, indices_or_sections):
+    a = _coerce_arr(ary)
+    return [ndarray(x) for x in jnp.dsplit(a._data, indices_or_sections)]
+
+
+def row_stack(tup):
+    return _run("row_stack", lambda *xs: jnp.vstack(xs), list(tup))
+
+
+def nanargmax(a, axis=None):
+    return _run("nanargmax", lambda x: jnp.nanargmax(x, axis=axis), [a])
+
+
+def nanargmin(a, axis=None):
+    return _run("nanargmin", lambda x: jnp.nanargmin(x, axis=axis), [a])
+
+
+def nancumsum(a, axis=None, dtype=None):
+    return _run("nancumsum",
+                lambda x: jnp.nancumsum(x, axis=axis, dtype=dtype), [a])
+
+
+def nancumprod(a, axis=None, dtype=None):
+    return _run("nancumprod",
+                lambda x: jnp.nancumprod(x, axis=axis, dtype=dtype), [a])
+
+
+def nanstd(a, axis=None, ddof=0, keepdims=False):
+    return _run("nanstd", lambda x: jnp.nanstd(x, axis=axis, ddof=ddof,
+                                               keepdims=keepdims), [a])
+
+
+def nanvar(a, axis=None, ddof=0, keepdims=False):
+    return _run("nanvar", lambda x: jnp.nanvar(x, axis=axis, ddof=ddof,
+                                               keepdims=keepdims), [a])
+
+
+def nanpercentile(a, q, axis=None, keepdims=False):
+    return _run("nanpercentile",
+                lambda x: jnp.nanpercentile(x, q, axis=axis,
+                                            keepdims=keepdims), [a])
+
+
+def corrcoef(x, y=None, rowvar=True):
+    arrs = [x] if y is None else [x, y]
+    if y is None:
+        return _run("corrcoef",
+                    lambda a: jnp.corrcoef(a, rowvar=rowvar), arrs)
+    return _run("corrcoef",
+                lambda a, b: jnp.corrcoef(a, b, rowvar=rowvar), arrs)
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    # jnp.trapezoid in current jax; trapz removed upstream
+    fn = getattr(jnp, "trapezoid", None) or getattr(jnp, "trapz")
+    if x is None:
+        return _run("trapz", lambda yy: fn(yy, dx=dx, axis=axis), [y])
+    return _run("trapz", lambda yy, xx: fn(yy, x=xx, axis=axis), [y, x])
+
+
+def put(a, ind, v, mode="clip"):
+    """Out-of-place semantics on XLA: returns the updated array AND rebinds
+    ``a``'s handle (mutable-looking surface, SURVEY.md §7 Arrays)."""
+    arr = _coerce_arr(a)
+    idx = _coerce_arr(ind)._data.astype(jnp.int32).reshape(-1)
+    vals = jnp.broadcast_to(jnp.asarray(
+        _coerce_arr(v)._data, arr._data.dtype).reshape(-1), idx.shape) \
+        if onp.ndim(getattr(_coerce_arr(v), "_data", v)) <= 1 else \
+        _coerce_arr(v)._data.reshape(-1)
+    flat = arr._data.reshape(-1)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    elif mode == "wrap":
+        idx = idx % flat.shape[0]
+    new = flat.at[idx].set(vals).reshape(arr._data.shape)
+    if isinstance(a, NDArray):
+        a._rebind(new)
+        return a
+    return ndarray(new)
+
+
+def resize(a, new_shape):
+    arr = _coerce_arr(a)
+    return ndarray(jnp.resize(arr._data, new_shape))
+
+
 # everything public defined in this module (functions, constants, dtypes)
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_")

@@ -236,3 +236,28 @@ class TestImage:
         b = it.next()
         assert b.data[0].shape == (2, 3, 16, 16)
         assert b.label[0].shape[0] == 2 and b.label[0].shape[2] == 5
+
+
+class TestBatchify:
+    def test_pad_variable_lengths(self):
+        from mxnet_tpu.gluon.data import batchify
+        seqs = [onp.arange(3), onp.arange(5), onp.arange(2)]
+        out, lens = batchify.Pad(pad_val=-1, ret_length=True)(seqs)
+        assert out.shape == (3, 5)
+        onp.testing.assert_array_equal(lens.asnumpy(), [3, 5, 2])
+        onp.testing.assert_array_equal(out.asnumpy()[2], [0, 1, -1, -1, -1])
+
+    def test_tuple_composition_with_loader(self):
+        from mxnet_tpu.gluon.data import ArrayDataset, DataLoader, batchify
+        seqs = [onp.arange(n, dtype=onp.float32) for n in (2, 4, 3, 5)]
+        labels = onp.arange(4, dtype=onp.float32)
+        ds = ArrayDataset(seqs, labels)
+        fn = batchify.Tuple(batchify.Pad(), batchify.Stack())
+        xb, yb = next(iter(DataLoader(ds, batch_size=4, batchify_fn=fn)))
+        assert xb.shape == (4, 5)
+        assert yb.shape == (4,)
+
+    def test_stack_casts_64bit(self):
+        from mxnet_tpu.gluon.data import batchify
+        out = batchify.Stack()([onp.array([1, 2]), onp.array([3, 4])])
+        assert str(out.dtype) in ("int32", "int64")

@@ -243,3 +243,29 @@ class TestUtil:
         assert f.is_enabled("SPMD")
         with pytest.raises(RuntimeError):
             f.is_enabled("NOT_A_FEATURE")
+
+
+class TestCoverageAdditions:
+    def test_common_numpy_surface_present(self):
+        import mxnet_tpu as mx
+        names = ("corrcoef deg2rad diag_indices diagflat dsplit empty_like "
+                 "nanargmax nanargmin nancumprod nancumsum nanpercentile "
+                 "nanstd nanvar put rad2deg resize row_stack signbit trapz "
+                 "tri triu_indices").split()
+        missing = [n for n in names if not hasattr(mx.np, n)]
+        assert not missing, missing
+
+    def test_values_match_numpy(self):
+        import numpy as onp
+        import mxnet_tpu as mx
+        np = mx.np
+        onp.testing.assert_allclose(
+            np.trapz(np.array([1., 2., 3.])).asnumpy(), 4.0)
+        onp.testing.assert_allclose(
+            np.nanstd(np.array([1., onp.nan, 3.])).asnumpy(),
+            onp.nanstd([1, onp.nan, 3]), rtol=1e-6)
+        a = np.array([0., 0., 0., 0.])
+        np.put(a, [0, 2], [9., 8.])
+        onp.testing.assert_allclose(a.asnumpy(), [9., 0., 8., 0.])
+        r, _ = np.triu_indices(3)
+        onp.testing.assert_array_equal(r.asnumpy(), onp.triu_indices(3)[0])
