@@ -47,6 +47,7 @@ def __getattr__(name):
         "runtime": ".runtime",
         "rtc": ".rtc",
         "checkpoint": ".checkpoint",
+        "engine": ".engine",
         "util": ".util",
         "image": ".image",
         "recordio": ".recordio",

@@ -1,0 +1,59 @@
+"""``mx.engine`` — execution-engine controls.
+
+Reference surface: ``src/engine/`` + the ``MXNET_ENGINE_TYPE`` /
+``MXNET_EXEC_BULK_EXEC_*`` env vars (SURVEY.md §3.1 "Dependency engine",
+§5.2, §5.6).
+
+TPU-native reality: there is no user-visible dependency engine — JAX async
+dispatch schedules, XLA fuses ("bulking" is automatic).  This module keeps
+the reference's control surface meaningful:
+
+- ``set_bulk_size`` / ``bulk``: accepted; XLA fusion subsumes op bulking,
+  so these record the value and return it (graph-size hints are a no-op by
+  design).
+- NaiveEngine: ``MXNET_ENGINE_TYPE=NaiveEngine`` (read in ``base``) forces
+  a blocking readback after every op — the reference's synchronous
+  debugging engine, for bisecting async/scheduling issues.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .base import is_naive_engine
+
+__all__ = ["set_bulk_size", "bulk", "engine_type", "is_naive_engine",
+           "wait_all"]
+
+_bulk_size = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", "15"))
+
+
+def engine_type() -> str:
+    """'NaiveEngine' (sync debug) or 'ThreadedEnginePerDevice' (the async
+    default — here, JAX async dispatch)."""
+    return "NaiveEngine" if is_naive_engine() else "ThreadedEnginePerDevice"
+
+
+def set_bulk_size(size: int) -> int:
+    """Reference ``mx.engine.set_bulk_size``: returns the previous value.
+    XLA fusion replaces engine-level op bulking, so the value is advisory."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """``with mx.engine.bulk(16):`` — reference bulking scope (advisory)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_all():
+    """Block until all dispatched work is complete (``WaitForAll``)."""
+    from .ndarray import waitall
+    waitall()

@@ -189,3 +189,210 @@ def multibox_detection(*args, **kwargs):
 def waitall():
     from ..ndarray import waitall as _w
     return _w()
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
+    """Reference anchor ``npx.masked_softmax``: softmax with a boolean
+    mask (False = excluded)."""
+    import jax.numpy as jnp
+    from ..ops.registry import Op, invoke
+
+    def fn(x, *m):
+        xs = x / temperature if temperature != 1.0 else x
+        if m:
+            xs = jnp.where(m[0].astype(bool), xs, -jnp.inf)
+        out = jnp.exp(xs - jnp.max(xs, axis=axis, keepdims=True))
+        out = jnp.where(jnp.isfinite(xs), out, 0.0)
+        return out / jnp.maximum(out.sum(axis=axis, keepdims=True), 1e-12)
+
+    args = [data] + ([mask] if mask is not None else [])
+    return _np_out(invoke(Op(name="_npx_masked_softmax", fn=fn), args, {}))
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0):
+    import jax.numpy as jnp
+    from ..ops.registry import Op, invoke
+
+    def fn(x, *m):
+        xs = x / temperature if temperature != 1.0 else x
+        if m:
+            xs = jnp.where(m[0].astype(bool), xs, -jnp.inf)
+        mx_ = jnp.max(xs, axis=axis, keepdims=True)
+        lse = jnp.log(jnp.maximum(
+            jnp.exp(xs - mx_).sum(axis=axis, keepdims=True), 1e-12)) + mx_
+        return xs - lse
+
+    args = [data] + ([mask] if mask is not None else [])
+    return _np_out(invoke(Op(name="_npx_masked_log_softmax", fn=fn),
+                          args, {}))
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    return _call("GroupNorm", data, gamma, beta, num_groups=num_groups,
+                 eps=eps)
+
+
+def instance_norm(data, gamma, beta, eps=1e-3):
+    return _call("InstanceNorm", data, gamma, beta, eps=eps)
+
+
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    return _call("RMSNorm", data, gamma, axis=axis, eps=eps)
+
+
+def gather_nd(data, indices):
+    return _call("gather_nd", data, indices)
+
+
+def scatter_nd(data, indices, shape):
+    return _call("scatter_nd", data, indices, shape=shape)
+
+
+def slice(data, begin, end, step=None):  # noqa: A001
+    return _call("slice", data, begin=tuple(begin), end=tuple(end),
+                 step=tuple(step) if step else None)
+
+
+def slice_axis(data, axis, begin, end):
+    return _call("slice_axis", data, axis=axis, begin=begin, end=end)
+
+
+def stop_gradient(data):
+    return _call("BlockGrad", data)
+
+
+def index_update(data, indices, val):
+    """Functional scatter-update (TPU-native: ``.at[].set``)."""
+    import jax.numpy as jnp
+    from ..ops.registry import Op, invoke
+    idx = indices if isinstance(indices, tuple) else (indices,)
+
+    def fn(x, v):
+        return x.at[tuple(jnp.asarray(i) for i in idx)].set(v)
+
+    return _np_out(invoke(Op(name="_npx_index_update", fn=fn),
+                          [data, val], {}))
+
+
+def index_add(data, indices, val):
+    import jax.numpy as jnp
+    from ..ops.registry import Op, invoke
+    idx = indices if isinstance(indices, tuple) else (indices,)
+
+    def fn(x, v):
+        return x.at[tuple(jnp.asarray(i) for i in idx)].add(v)
+
+    return _np_out(invoke(Op(name="_npx_index_add", fn=fn), [data, val], {}))
+
+
+def foreach(body, data, init_states):
+    """Reference anchor ``npx.foreach`` (control-flow op): scan ``body``
+    over the leading axis.  TPU-native: ``lax.scan`` — compiled loop, no
+    Python unrolling."""
+    import jax
+    from ..ndarray import NDArray
+
+    multi_data = isinstance(data, (list, tuple))
+    multi_states = isinstance(init_states, (list, tuple))
+    xs = [d._data for d in data] if multi_data else data._data
+    init = [s._data for s in init_states] if multi_states \
+        else init_states._data
+
+    def step(carry, x):
+        x_nd = [NDArray(v) for v in x] if multi_data else NDArray(x)
+        c_nd = [NDArray(v) for v in carry] if multi_states else NDArray(carry)
+        out, new_states = body(x_nd, c_nd)
+        out_raw = [o._data for o in out] if isinstance(out, (list, tuple)) \
+            else out._data
+        ns_raw = [s._data for s in new_states] if multi_states \
+            else new_states._data
+        return ns_raw, out_raw
+
+    final, outs = jax.lax.scan(step, init, xs)
+    wrap = lambda v: [_np_out_arr(x) for x in v] \
+        if isinstance(v, (list, tuple)) else _np_out_arr(v)
+    return wrap(outs), wrap(final)
+
+
+def _np_out_arr(x):
+    from ..numpy import ndarray as _np_ndarray
+    return _np_ndarray(x)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference anchor ``npx.while_loop`` → ``lax.while_loop`` (with an
+    iteration cap when given, matching the reference semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray import NDArray
+
+    raw = [v._data for v in loop_vars]
+
+    def c(state):
+        i, vs = state
+        ok = cond([NDArray(v) for v in vs])
+        ok = ok._data if hasattr(ok, "_data") else jnp.asarray(ok)
+        ok = ok.reshape(()).astype(bool)
+        if max_iterations is not None:
+            ok = jnp.logical_and(ok, i < max_iterations)
+        return ok
+
+    def b(state):
+        i, vs = state
+        new = func([NDArray(v) for v in vs])
+        return i + 1, tuple(v._data if hasattr(v, "_data") else v
+                            for v in new)
+
+    _, out = jax.lax.while_loop(c, b, (jnp.asarray(0), tuple(raw)))
+    return [_np_out_arr(v) for v in out]
+
+
+def cond(pred, then_func, else_func, inputs):
+    """Reference anchor ``npx.cond`` → ``lax.cond``."""
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray import NDArray
+
+    p = pred._data if hasattr(pred, "_data") else jnp.asarray(pred)
+    raw = [v._data for v in inputs]
+
+    def t(vs):
+        out = then_func([NDArray(v) for v in vs])
+        return tuple(o._data for o in out) if isinstance(out, (list, tuple)) \
+            else out._data
+
+    def e(vs):
+        out = else_func([NDArray(v) for v in vs])
+        return tuple(o._data for o in out) if isinstance(out, (list, tuple)) \
+            else out._data
+
+    out = jax.lax.cond(p.reshape(()).astype(bool), t, e, tuple(raw))
+    if isinstance(out, tuple):
+        return [_np_out_arr(v) for v in out]
+    return _np_out_arr(out)
+
+
+def multinomial(data, shape=None, get_prob=False):
+    from ..numpy import random as npr
+    return npr.multinomial(1, data, size=shape)
+
+
+def shuffle(data):
+    from .. import random as _r
+    return _np_out(_r.shuffle(data))
+
+
+def load(fname):
+    from ..ndarray import load as _l
+    out = _l(fname)
+    if isinstance(out, dict):
+        return {k: _np_out(v) for k, v in out.items()}
+    return [_np_out(v) for v in out]
+
+
+def save(fname, data):
+    from ..ndarray import save as _s
+    return _s(fname, data)
+
+
+import jax  # noqa: E402  (used by masked_softmax paths)

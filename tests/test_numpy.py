@@ -269,3 +269,53 @@ class TestCoverageAdditions:
         onp.testing.assert_allclose(a.asnumpy(), [9., 0., 8., 0.])
         r, _ = np.triu_indices(3)
         onp.testing.assert_array_equal(r.asnumpy(), onp.triu_indices(3)[0])
+
+
+class TestNpxControlFlow:
+    def test_masked_softmax(self):
+        import mxnet_tpu as mx
+        x = mx.np.array([[1., 2., 3.]])
+        m = mx.np.array([[1, 1, 0]])
+        out = mx.npx.masked_softmax(x, m)
+        assert abs(float(onp.asarray(out.asnumpy()).sum()) - 1.0) < 1e-5
+        assert float(out.asnumpy()[0, 2]) == 0.0
+        ls = mx.npx.masked_log_softmax(x, m)
+        onp.testing.assert_allclose(
+            onp.exp(ls.asnumpy()[0, :2]).sum(), 1.0, rtol=1e-5)
+
+    def test_foreach_scan(self):
+        import mxnet_tpu as mx
+        data = mx.np.array(onp.ones((4, 2), onp.float32))
+        outs, final = mx.npx.foreach(lambda x, s: (x + s, x + s), data,
+                                     mx.np.zeros((2,)))
+        onp.testing.assert_allclose(final.asnumpy(), [4., 4.])
+        onp.testing.assert_allclose(outs.asnumpy()[:, 0], [1., 2., 3., 4.])
+
+    def test_while_loop_and_cond(self):
+        import mxnet_tpu as mx
+        out = mx.npx.while_loop(lambda vs: vs[0] < 5,
+                                lambda vs: [vs[0] + 1],
+                                [mx.np.array(0)], max_iterations=10)
+        assert int(onp.asarray(out[0].asnumpy())) == 5
+        r = mx.npx.cond(mx.np.array(True), lambda vs: [vs[0] * 2],
+                        lambda vs: [vs[0] * 3], [mx.np.array(4.0)])
+        assert float(onp.asarray(r[0].asnumpy())) == 8.0
+
+    def test_index_update_add(self):
+        import mxnet_tpu as mx
+        a = mx.np.zeros((3, 3))
+        b = mx.npx.index_update(a, (mx.np.array([0]), mx.np.array([1])),
+                                mx.np.array([5.0]))
+        c = mx.npx.index_add(b, (mx.np.array([0]), mx.np.array([1])),
+                             mx.np.array([2.0]))
+        assert float(c.asnumpy()[0, 1]) == 7.0
+
+    def test_engine_facade(self):
+        import mxnet_tpu as mx
+        assert mx.engine.engine_type() in ("NaiveEngine",
+                                           "ThreadedEnginePerDevice")
+        prev = mx.engine.set_bulk_size(4)
+        with mx.engine.bulk(32):
+            pass
+        mx.engine.set_bulk_size(prev)
+        mx.engine.wait_all()
