@@ -504,6 +504,36 @@ class Signum(Optimizer):
 
 
 @register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference anchor ``DCASGD``): the
+    gradient is corrected with a curvature term λ·g⊙g⊙(w − w_prev) to
+    compensate staleness.  On a synchronous TPU step the delay is zero by
+    construction, so this matches SGD+momentum — kept for API parity with
+    async-PS training scripts."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        mom = None if self.momentum == 0.0 else jnp.zeros_like(w)
+        return (mom, jnp.asarray(w))  # (momentum, previous weight)
+
+    def _update_rule(self, w, g, state, lr, wd, t):
+        mom, prev_w = state
+        comp = g + wd * w + self.lamda * g * g * (w - prev_w)
+        if mom is None:
+            new_w = w - lr * comp
+            return new_w, (None, w)
+        mom = self.momentum * mom - lr * comp
+        new_w = w + mom
+        return new_w, (mom, w)
+
+
+@register
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (noise-injected SGD)."""
 
