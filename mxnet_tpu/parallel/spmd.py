@@ -132,6 +132,7 @@ class SPMDTrainer:
             self._opt = opt_mod.create(optimizer, **optimizer_params)
         self._built = False
         self._step_fn = None
+        self._multi_step_fn = None
         self._t = 0
         self._param_names: list = []
         self._train_params: list = []   # Parameter objs with grad_req != null
@@ -224,7 +225,9 @@ class SPMDTrainer:
                         for (p, v) in aux.values()])
         return loss_val
 
-    def _compile(self):
+    def _make_step_fn(self):
+        """The pure one-step body shared by the single-step jit and the
+        multi-step scan."""
         opt = self._opt
         mp_flags = []
         for s, p in zip(self._opt_states, self._train_params):
@@ -277,6 +280,9 @@ class SPMDTrainer:
                           for p, v in zip(self._frozen_params, frozen_vals)]
             return loss, list(new_vals), new_states, new_frozen
 
+        return step_fn
+
+    def _shardings(self):
         mesh = self._mesh
         repl = NamedSharding(mesh, P())
 
@@ -288,6 +294,13 @@ class SPMDTrainer:
             return jax.tree.map(
                 lambda leaf: psh if getattr(leaf, "shape", None)
                 == p._data._data.shape else repl, s)
+
+        return repl, shard_of, state_shardings
+
+    def _compile(self):
+        step_fn = self._make_step_fn()
+        mesh = self._mesh
+        repl, shard_of, state_shardings = self._shardings()
 
         in_shardings = (
             [shard_of(p) for p in self._train_params],
@@ -308,7 +321,77 @@ class SPMDTrainer:
         return jax.jit(step_fn, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)
 
+    def _compile_multi(self):
+        """N steps inside one compiled program via ``lax.scan`` —
+        amortizes host dispatch (and tunnel round-trips) over N steps; the
+        latency-hiding answer to the reference's engine pipelining."""
+        step_fn = self._make_step_fn()
+        mesh = self._mesh
+        repl, shard_of, state_shardings = self._shardings()
+
+        def multi_fn(train_vals, opt_states, frozen_vals, keys, lr, rescale,
+                     t0, datas, labels):
+            def body(carry, xs):
+                tv, os_, fv, t = carry
+                key, d, l = xs
+                loss, ntv, nos, nfv = step_fn(tv, os_, fv, key, lr,
+                                              rescale, t, d, l)
+                return (tuple(ntv), nos, nfv, t + 1), loss
+
+            (tv, os_, fv, _), losses = jax.lax.scan(
+                body, (tuple(train_vals), opt_states, frozen_vals, t0),
+                (keys, datas, labels))
+            return losses, list(tv), os_, fv
+
+        data_sh = NamedSharding(mesh, P(None, self._dp_axis))
+        in_shardings = (
+            [shard_of(p) for p in self._train_params],
+            [state_shardings(s, p)
+             for s, p in zip(self._opt_states, self._train_params)],
+            [shard_of(p) for p in self._frozen_params],
+            repl, repl, repl, repl,
+            data_sh, data_sh,
+        )
+        out_shardings = (repl, in_shardings[0], in_shardings[1],
+                         in_shardings[2])
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(multi_fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+
     # ------------------------------------------------------------------ #
+    def run_steps(self, data, label, batch_size: Optional[int] = None):
+        """Run N fused steps in ONE dispatch.  ``data``/``label`` carry a
+        leading steps axis: (N, batch, ...).  Returns the (N,) loss
+        array as an NDArray."""
+        from ..ndarray.ndarray import NDArray
+        from .. import random as mxrandom
+
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        n = d.shape[0]
+        self._ensure_built(NDArray(d[0]), NDArray(l[0]))
+        if self._multi_step_fn is None:
+            self._multi_step_fn = self._compile_multi()
+        keys = jax.random.split(mxrandom.next_key(), n)
+        lr = jnp.asarray(self._opt.learning_rate, jnp.float32)
+        rescale = jnp.asarray(
+            self._rescale / (batch_size if batch_size else 1.0), jnp.float32)
+        t0 = jnp.asarray(self._t + 1, jnp.int32)
+        sh = NamedSharding(self._mesh, P(None, self._dp_axis))
+        d = jax.device_put(d, sh)
+        l = jax.device_put(l, sh)
+        losses, self._train_vals, self._opt_states, self._frozen_vals = \
+            self._multi_step_fn(self._train_vals, self._opt_states,
+                                self._frozen_vals, keys, lr, rescale, t0,
+                                d, l)
+        self._t += n
+        self._opt.num_update = self._t
+        for p, v in zip(self._train_params, self._train_vals):
+            p._data._data = v
+        for p, v in zip(self._frozen_params, self._frozen_vals):
+            p._data._data = v
+        return NDArray(losses)
+
     def step(self, data, label, batch_size: Optional[int] = None):
         """Run one fused train step; returns the (device-async) loss as an
         NDArray.  ``batch_size`` defaults to the global batch dim (grad is

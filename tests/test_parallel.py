@@ -198,3 +198,34 @@ def test_spmd_trainer_batchnorm_aux_updates():
         tr.step(mx.nd.array(X), mx.nd.array(y))
     after = bn.running_mean.data().asnumpy()
     assert not onp.allclose(before, after), "running stats never updated"
+
+
+def test_run_steps_matches_sequential():
+    """N scanned steps inside one jit == N sequential step() calls."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    mesh = parallel.make_mesh({"dp": 8})
+    rng = onp.random.RandomState(0)
+    xs = rng.rand(4, 8, 5).astype(onp.float32)
+    ys = rng.rand(4, 8, 3).astype(onp.float32)
+
+    def fresh():
+        mx.random.seed(1)
+        n = gluon.nn.Dense(3)
+        n.initialize(mx.init.Xavier())
+        return n, parallel.SPMDTrainer(n, gluon.loss.L2Loss(), "sgd",
+                                       {"learning_rate": 0.1}, mesh=mesh)
+
+    na, ta = fresh()
+    for i in range(4):
+        ta.step(mx.nd.array(xs[i]), mx.nd.array(ys[i]))
+    nb, tb = fresh()
+    tb.step(mx.nd.array(xs[0]), mx.nd.array(ys[0]))
+    losses = tb.run_steps(mx.nd.array(xs[1:]), mx.nd.array(ys[1:]))
+    assert losses.shape == (3,)
+    assert tb._t == 4
+    wa = list(na.collect_params().values())[0].data().asnumpy()
+    wb = list(nb.collect_params().values())[0].data().asnumpy()
+    onp.testing.assert_allclose(wa, wb, rtol=1e-5, atol=1e-6)
