@@ -90,6 +90,37 @@ class GPT(HybridBlock):
                        transpose_b=True)
         return F.reshape(logits, shape=(B, L, self._cfg.vocab_size))
 
+    def generate(self, prompt_tokens, max_new_tokens=32, temperature=1.0,
+                 top_k=0, seed=None):
+        """Autoregressive sampling (greedy when ``temperature==0``;
+        ``top_k>0`` restricts the sample space).  Host-driven loop over the
+        growing prefix — jit caches one program per length like the
+        reference's BucketingModule caches per-bucket graphs."""
+        import numpy as np
+        from .. import ndarray as nd
+
+        rng = np.random.RandomState(seed if seed is not None else 0)
+        out = np.asarray(
+            prompt_tokens.asnumpy() if hasattr(prompt_tokens, "asnumpy")
+            else prompt_tokens, dtype=np.int32)
+        for _ in range(max_new_tokens):
+            window = out[:, -self._cfg.max_length:]
+            logits = self(nd.array(window, dtype="int32"))
+            last = logits.asnumpy()[:, -1].astype(np.float64)   # (B, V)
+            if temperature == 0.0:
+                nxt = last.argmax(-1).astype(np.int32)
+            else:
+                last = last / max(temperature, 1e-6)
+                if top_k and top_k < last.shape[-1]:
+                    kth = np.partition(last, -top_k, axis=-1)[:, -top_k]
+                    last = np.where(last < kth[:, None], -np.inf, last)
+                p = np.exp(last - last.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                nxt = np.asarray([rng.choice(p.shape[-1], p=row)
+                                  for row in p], dtype=np.int32)
+            out = np.concatenate([out, nxt[:, None]], axis=1)
+        return out
+
 
 def gpt_tp_rules(tp_axis: str = "tp"):
     """Megatron-style TP sharding: QKV/fc1 split on the output dim, proj/fc2
