@@ -107,3 +107,38 @@ def retain(rs: RowSparseNDArray, indices):
     keep = onp.isin(onp.asarray(rs._rs_indices), idx)
     return RowSparseNDArray(onp.asarray(rs._rs_data)[keep],
                             onp.asarray(rs._rs_indices)[keep], rs.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """``mx.nd.sparse.zeros('row_sparse', shape)`` (reference surface)."""
+    import jax.numpy as _jnp
+    if stype == "row_sparse":
+        return RowSparseNDArray(_jnp.zeros((0,) + tuple(shape[1:]),
+                                           _jnp.dtype(dtype)),
+                                _jnp.zeros((0,), _jnp.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(onp.zeros((0,), dtype), onp.zeros(shape[0] + 1,
+                                                            onp.int64),
+                          onp.zeros((0,), onp.int64), shape, ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """``mx.nd.sparse.dot`` — csr/row_sparse × dense matmul.  Dense
+    compute under the hood (XLA; PARITY.md sparse row), sparse-typed API."""
+    from . import dot as _dense_dot
+    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _dense_dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def sparse_retain(data, indices):
+    """Reference anchor ``sparse_retain`` op: keep only the listed rows."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects a RowSparseNDArray")
+    return retain(data, indices)
+
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "tostype", "retain",
+           "sparse_retain", "zeros", "dot"]
