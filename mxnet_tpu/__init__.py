@@ -48,6 +48,8 @@ def __getattr__(name):
         "rtc": ".rtc",
         "checkpoint": ".checkpoint",
         "engine": ".engine",
+        "viz": ".visualization",
+        "visualization": ".visualization",
         "util": ".util",
         "image": ".image",
         "recordio": ".recordio",

@@ -1,0 +1,19 @@
+"""``mx.nd.contrib`` — the reference's contrib-op namespace
+(``python/mxnet/ndarray/contrib.py``): friendly names over the registered
+``_contrib_*`` ops (SURVEY.md §3.1 contrib family)."""
+from __future__ import annotations
+
+from . import (boolean_mask, _contrib_quantize_v2 as quantize_v2,
+               _contrib_dequantize as dequantize,
+               _contrib_requantize as requantize,
+               _contrib_interleaved_matmul_selfatt_qk as
+               interleaved_matmul_selfatt_qk,
+               _contrib_interleaved_matmul_selfatt_valatt as
+               interleaved_matmul_selfatt_valatt,
+               BilinearResize2D, ROIAlign, box_nms)
+from . import all_finite, multi_all_finite
+
+__all__ = ["boolean_mask", "quantize_v2", "dequantize", "requantize",
+           "interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt", "BilinearResize2D",
+           "ROIAlign", "box_nms", "all_finite", "multi_all_finite"]
