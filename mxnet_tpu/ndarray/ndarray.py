@@ -210,6 +210,22 @@ class NDArray:
     def copy(self) -> "NDArray":
         return NDArray(jnp.asarray(self._data), self._ctx)
 
+    # -- dlpack interchange (reference: dlpack bridge, SURVEY.md §3.1
+    # "dlpack": zero-copy tensor interchange ABI) ----------------------- #
+    def to_dlpack_for_read(self):
+        """Export as a DLPack capsule (zero-copy where the consumer shares
+        the device; reference ``to_dlpack_for_read``)."""
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read  # values are immutable (XLA)
+
+    def __dlpack__(self, stream=None):
+        return self._data.__dlpack__(stream=stream) if stream is not None \
+            else self._data.__dlpack__()
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     def copyto(self, other):
         if isinstance(other, NDArray):
             if other.shape != self.shape:

@@ -800,3 +800,166 @@ def mish(data):
 
 
 alias("SliceChannel", "split")
+
+
+# ----------------------------------------------------------------------- #
+# SSD MultiBox family (reference src/operator/contrib/multibox_*.cc —
+# SURVEY.md §3.1 contrib: "MultiBox* [SSD]")
+# ----------------------------------------------------------------------- #
+
+@op("_contrib_MultiBoxPrior", differentiable=False)
+def MultiBoxPrior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes for one feature map: data (N, C, H, W) →
+    (1, H*W*(len(sizes)+len(ratios)-1), 4) corner-format boxes in [0,1]."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchor shapes: all sizes at ratio[0], plus size[0] at other ratios
+    whs = [(s * (ratios[0] ** 0.5), s / (ratios[0] ** 0.5)) for s in sizes]
+    whs += [(sizes[0] * (r ** 0.5), sizes[0] / (r ** 0.5))
+            for r in ratios[1:]]
+    whs = jnp.asarray(whs, jnp.float32)                # (A, 2) [w, h]
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
+    centers = jnp.stack([gx, gy], axis=-1).reshape(-1, 1, 2)  # (HW, 1, 2)
+    half = whs.reshape(1, -1, 2) / 2.0
+    mins = centers - half
+    maxs = centers + half
+    boxes = jnp.concatenate([mins, maxs], axis=-1).reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+alias("MultiBoxPrior", "_contrib_MultiBoxPrior")
+
+
+def _iou_matrix(a, b):
+    """a: (A, 4), b: (B, 4) corner boxes → (A, B) IoU."""
+    ax1, ay1, ax2, ay2 = (a[:, i, None] for i in range(4))
+    bx1, by1, bx2, by2 = (b[None, :, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+    area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@op("_contrib_MultiBoxTarget", differentiable=False)
+def MultiBoxTarget(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets: anchors (1, A, 4), labels (N, O, 5)
+    [cls, x1, y1, x2, y2] (−1 pad) → (loc_target (N, A*4),
+    loc_mask (N, A*4), cls_target (N, A))."""
+    A = anchor.shape[1]
+    anc = anchor.reshape(A, 4)
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+    ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+    vx, vy, vw, vh = variances
+
+    def one(lab):
+        valid = lab[:, 0] >= 0                           # (O,)
+        boxes = lab[:, 1:5]
+        iou = _iou_matrix(anc, boxes)                    # (A, O)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_obj = jnp.argmax(iou, axis=1)               # (A,)
+        best_iou = jnp.take_along_axis(iou, best_obj[:, None],
+                                       axis=1)[:, 0]
+        # every gt also claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)            # (O,)
+        forced = jnp.zeros(A, bool).at[best_anchor].set(valid)
+        pos = jnp.logical_or(best_iou >= overlap_threshold, forced)
+        gt = boxes[best_obj]                             # (A, 4)
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-12)
+        gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+        loc = jnp.stack([(gcx - acx) / aw / vx,
+                         (gcy - acy) / ah / vy,
+                         jnp.log(gw / aw) / vw,
+                         jnp.log(gh / ah) / vh], axis=-1)  # (A, 4)
+        loc = jnp.where(pos[:, None], loc, 0.0).reshape(-1)
+        mask = jnp.repeat(pos.astype(jnp.float32), 4)
+        cls = jnp.where(pos, lab[best_obj, 0] + 1.0, 0.0)  # 0 = background
+        return loc, mask, cls
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    return loc_t, loc_m, cls_t
+
+
+alias("MultiBoxTarget", "_contrib_MultiBoxTarget")
+
+
+@op("_contrib_MultiBoxDetection", differentiable=False)
+def MultiBoxDetection(cls_prob, loc_pred, anchor, *, clip=True,
+                      threshold=0.01, nms_threshold=0.5, force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD inference decode: class probs (N, C, A), loc offsets (N, A*4),
+    anchors (1, A, 4) → (N, A, 6) rows [cls_id, score, x1, y1, x2, y2]
+    (cls_id −1 = suppressed/background), NMS applied per class."""
+    N, C, A = cls_prob.shape
+    anc = anchor.reshape(A, 4)
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+    ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+    vx, vy, vw, vh = variances
+
+    def one(probs, loc):
+        loc = loc.reshape(A, 4)
+        cx = loc[:, 0] * vx * aw + acx
+        cy = loc[:, 1] * vy * ah + acy
+        w = jnp.exp(loc[:, 2] * vw) * aw
+        h = jnp.exp(loc[:, 3] * vh) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (class 0 = background)
+        fg = probs[1:]                                   # (C-1, A)
+        best = jnp.argmax(fg, axis=0)                    # (A,)
+        score = jnp.take_along_axis(fg, best[None], axis=0)[0]
+        keep = score > threshold
+        cls_id = jnp.where(keep, best.astype(jnp.float32), -1.0)
+        score = jnp.where(keep, score, -1.0)
+        return jnp.concatenate([cls_id[:, None], score[:, None], boxes],
+                               axis=-1)
+
+    rows = jax.vmap(one)(cls_prob, loc_pred)             # (N, A, 6)
+    from .registry import get_op
+    nms = get_op("_contrib_box_nms")
+    return nms.fn(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  force_suppress=force_suppress)
+
+
+alias("MultiBoxDetection", "_contrib_MultiBoxDetection")
+
+
+@op("fft", differentiable=False)
+def fft(data, *, compute_size=128):
+    """Reference anchor ``_contrib_fft``: real input → interleaved
+    [real, imag] along the last axis (the reference's packed layout)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (data.shape[-1] * 2,))
+
+
+@op("ifft", differentiable=False)
+def ifft(data, *, compute_size=128):
+    """Inverse of :func:`fft` (interleaved [real, imag] input)."""
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real * n
+
+
+alias("_contrib_fft", "fft")
+alias("_contrib_ifft", "ifft")

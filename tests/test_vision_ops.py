@@ -116,3 +116,55 @@ class TestActivations:
             mx.nd.mish(x).asnumpy(),
             torch.nn.functional.mish(torch.tensor(x.asnumpy())).numpy(),
             rtol=1e-5)
+
+
+class TestMultiBox:
+    def test_prior_grid(self):
+        pri = mx.nd.MultiBoxPrior(mx.nd.ones((1, 3, 2, 2)),
+                                  sizes=(0.5, 0.25), ratios=(1, 2))
+        assert pri.shape == (1, 12, 4)
+        a = pri.asnumpy()[0]
+        cx = (a[:, 0] + a[:, 2]) / 2
+        assert abs(cx[0] - 0.25) < 1e-6
+
+    def test_target_matching(self):
+        anchors = mx.nd.array(onp.array([[[0.1, 0.1, 0.4, 0.4],
+                                          [0.6, 0.6, 0.9, 0.9]]],
+                                        onp.float32))
+        labels = mx.nd.array(onp.array([[[0, 0.1, 0.1, 0.42, 0.42],
+                                         [-1, 0, 0, 0, 0]]], onp.float32))
+        loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(anchors, labels,
+                                                   mx.nd.zeros((1, 2, 2)))
+        assert float(cls_t.asnumpy()[0, 0]) == 1.0  # matched -> class+1
+        assert float(cls_t.asnumpy()[0, 1]) == 0.0  # background
+        assert float(loc_m.asnumpy()[0, :4].sum()) == 4.0
+
+    def test_detection_decodes_anchors_at_zero_offset(self):
+        anchors = mx.nd.array(onp.array([[[0.1, 0.1, 0.4, 0.4],
+                                          [0.6, 0.6, 0.9, 0.9]]],
+                                        onp.float32))
+        cls_prob = mx.nd.array(onp.array([[[0.1, 0.2], [0.9, 0.8]]],
+                                         onp.float32))
+        det = mx.nd.MultiBoxDetection(cls_prob, mx.nd.zeros((1, 8)), anchors)
+        d = det.asnumpy()[0]
+        keep = d[d[:, 1] > 0]
+        onp.testing.assert_allclose(keep[0, 2:], [0.1, 0.1, 0.4, 0.4],
+                                    atol=1e-5)
+
+
+class TestFFTDlpack:
+    def test_fft_roundtrip(self):
+        x = mx.nd.array(onp.random.rand(2, 8).astype(onp.float32))
+        f = mx.nd.fft(x)
+        assert f.shape == (2, 16)
+        rec = mx.nd.ifft(f) / 8
+        onp.testing.assert_allclose(rec.asnumpy(), x.asnumpy(), rtol=1e-5,
+                                    atol=1e-6)
+
+    def test_dlpack_torch_roundtrip(self):
+        import torch
+        x = mx.nd.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+        t = torch.from_dlpack(x)
+        onp.testing.assert_array_equal(t.numpy(), x.asnumpy())
+        back = mx.nd.from_dlpack(torch.arange(4, dtype=torch.float32))
+        onp.testing.assert_array_equal(back.asnumpy(), [0, 1, 2, 3])
