@@ -963,3 +963,86 @@ def ifft(data, *, compute_size=128):
 
 alias("_contrib_fft", "fft")
 alias("_contrib_ifft", "ifft")
+
+
+@op("_contrib_Proposal", differentiable=False)
+def Proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RCNN region-proposal op (reference anchor ``Proposal``,
+    src/operator/contrib/proposal.cc): anchors over the feature grid →
+    decode bbox deltas → clip → min-size filter → top-k by score → NMS →
+    top post-NMS.  Static shapes throughout (argsort + box_nms), so the
+    whole RPN head jits.
+
+    cls_prob (N, 2A, H, W), bbox_pred (N, 4A, H, W), im_info (N, 3)
+    [height, width, scale] → rois (N*post_nms, 5) [batch_idx, x1,y1,x2,y2]
+    (+ scores (N*post_nms, 1) when ``output_score``)."""
+    N, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    fs = float(feature_stride)
+    # base anchors centered on (fs-1)/2 with area (fs*scale)^2 per ratio
+    base = []
+    for r in ratios:
+        for s in scales:
+            size = fs * fs * float(s) * float(s)
+            w = jnp.sqrt(size / r)
+            h = w * r
+            cx = (fs - 1) / 2.0
+            cy = (fs - 1) / 2.0
+            base.append([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                         cx + (w - 1) / 2, cy + (h - 1) / 2])
+    base = jnp.asarray(base, jnp.float32)                # (A, 4)
+    sx = jnp.arange(W, dtype=jnp.float32) * fs
+    sy = jnp.arange(H, dtype=jnp.float32) * fs
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([gx, gy, gx, gy], axis=-1).reshape(-1, 1, 4)
+    anchors = (shifts + base[None]).reshape(-1, 4)       # (H*W*A, 4)
+    K = anchors.shape[0]
+
+    def one(probs, deltas, info):
+        # foreground scores: second half of the class channel
+        score = probs[A:].transpose(1, 2, 0).reshape(-1)      # (H*W*A,)
+        d = deltas.transpose(1, 2, 0).reshape(-1, A, 4) \
+            .reshape(H * W, A, 4).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + 0.5 * (aw - 1)
+        acy = anchors[:, 1] + 0.5 * (ah - 1)
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        x1 = jnp.clip(cx - 0.5 * (w - 1), 0, info[1] - 1)
+        y1 = jnp.clip(cy - 0.5 * (h - 1), 0, info[0] - 1)
+        x2 = jnp.clip(cx + 0.5 * (w - 1), 0, info[1] - 1)
+        y2 = jnp.clip(cy + 0.5 * (h - 1), 0, info[0] - 1)
+        min_sz = rpn_min_size * info[2]
+        ok = ((x2 - x1 + 1) >= min_sz) & ((y2 - y1 + 1) >= min_sz)
+        score = jnp.where(ok, score, -1.0)
+        pre = builtins.min(rpn_pre_nms_top_n, K)
+        order = jnp.argsort(-score)[:pre]
+        rows = jnp.stack([jnp.zeros(pre), score[order], x1[order],
+                          y1[order], x2[order], y2[order]], axis=-1)
+        from .registry import get_op
+        nms = get_op("_contrib_box_nms")
+        kept = nms.fn(rows, overlap_thresh=threshold, valid_thresh=0.0,
+                      topk=-1, coord_start=2, score_index=1, id_index=0,
+                      force_suppress=True)
+        post = builtins.min(rpn_post_nms_top_n, pre)
+        order2 = jnp.argsort(-kept[:, 1])[:post]
+        sel = kept[order2]
+        return sel[:, 2:6], sel[:, 1:2]
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    post = boxes.shape[1]
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=jnp.float32), post)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(-1, 4)], axis=-1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+alias("Proposal", "_contrib_Proposal")

@@ -168,3 +168,33 @@ class TestFFTDlpack:
         onp.testing.assert_array_equal(t.numpy(), x.asnumpy())
         back = mx.nd.from_dlpack(torch.arange(4, dtype=torch.float32))
         onp.testing.assert_array_equal(back.asnumpy(), [0, 1, 2, 3])
+
+
+class TestProposal:
+    def test_rpn_proposals(self):
+        rng = onp.random.RandomState(0)
+        N, H, W, A = 1, 4, 4, 2
+        cls_prob = mx.nd.array(rng.rand(N, 2 * A, H, W).astype(onp.float32))
+        bbox_pred = mx.nd.array(
+            (rng.rand(N, 4 * A, H, W).astype(onp.float32) - 0.5) * 0.1)
+        im_info = mx.nd.array(onp.array([[64, 64, 1.0]], onp.float32))
+        rois = mx.nd.Proposal(cls_prob, bbox_pred, im_info, scales=(1, 2),
+                              ratios=(1.0,), feature_stride=16,
+                              rpn_pre_nms_top_n=20, rpn_post_nms_top_n=8,
+                              rpn_min_size=4)
+        r = rois.asnumpy()
+        assert r.shape == (8, 5)
+        assert (r[:, 0] == 0).all()           # batch index
+        assert (r[:, 1] >= 0).all() and (r[:, 3] <= 63.01).all()  # clipped
+        assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+    def test_output_score(self):
+        rng = onp.random.RandomState(1)
+        cls_prob = mx.nd.array(rng.rand(1, 4, 4, 4).astype(onp.float32))
+        bbox_pred = mx.nd.array(onp.zeros((1, 8, 4, 4), onp.float32))
+        im_info = mx.nd.array(onp.array([[64, 64, 1.0]], onp.float32))
+        rois, scores = mx.nd.Proposal(cls_prob, bbox_pred, im_info,
+                                      scales=(1, 2), ratios=(1.0,),
+                                      output_score=True,
+                                      rpn_post_nms_top_n=5, rpn_min_size=4)
+        assert scores.shape == (5, 1)
