@@ -1046,3 +1046,80 @@ def Proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
 
 
 alias("Proposal", "_contrib_Proposal")
+
+
+@op("_contrib_DeformableConvolution")
+def DeformableConvolution(data, offset, weight, bias=None, *, kernel=(),
+                          stride=(), dilate=(), pad=(), num_filter=0,
+                          num_group=1, num_deformable_group=1, no_bias=False,
+                          layout="NCHW", workspace=1024):
+    """Deformable conv v1 (reference anchor ``DeformableConvolution``,
+    src/operator/contrib/deformable_convolution.cc).
+
+    data (N, C, H, W); offset (N, 2*G*kh*kw, Ho, Wo) with (dy, dx) pairs per
+    deformable group G and kernel tap.  TPU-native formulation: bilinear
+    im2col gather at the offset sample points (vectorized — no scalar
+    loops), then ONE big (N·Ho·Wo, C·kh·kw) × (C·kh·kw, F) MXU matmul."""
+    kh, kw = kernel
+    sh, sw = _pair(stride or 1, 2)
+    dh, dw = _pair(dilate or 1, 2)
+    ph, pw = _pair(pad or 0, 2)
+    N, C, H, W = data.shape
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    G = num_deformable_group
+    K = kh * kw
+
+    # base sampling grid per output position and tap (dilated kernel)
+    oy = jnp.arange(Ho) * sh - ph                       # (Ho,)
+    ox = jnp.arange(Wo) * sw - pw
+    ky = jnp.arange(kh) * dh                            # (kh,)
+    kx = jnp.arange(kw) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # (Ho,1,kh,1)
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # (1,Wo,1,kw)
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).reshape(Ho, Wo, K)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).reshape(Ho, Wo, K)
+
+    off = offset.reshape(N, G, K, 2, Ho, Wo)
+    dy = jnp.moveaxis(off[:, :, :, 0], (1, 2), (3, 4))  # (N, Ho, Wo, G, K)
+    dx = jnp.moveaxis(off[:, :, :, 1], (1, 2), (3, 4))
+    sy = base_y[None, :, :, None, :] + dy               # (N, Ho, Wo, G, K)
+    sx = base_x[None, :, :, None, :] + dx
+
+    def sample_image(img, yy, xx):
+        """img (C, H, W); yy/xx (Ho, Wo, G, K) → (C, Ho, Wo, G, K)."""
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        wy = yy - y0
+        wx = xx - x0
+
+        def at(yi, xi):
+            inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yi = jnp.clip(yi, 0, H - 1)
+            xi = jnp.clip(xi, 0, W - 1)
+            v = img[:, yi, xi]                          # (C, Ho, Wo, G, K)
+            return jnp.where(inside[None], v, 0.0)
+
+        return (at(y0, x0) * (1 - wy) * (1 - wx) +
+                at(y0, x0 + 1) * (1 - wy) * wx +
+                at(y0 + 1, x0) * wy * (1 - wx) +
+                at(y0 + 1, x0 + 1) * wy * wx)
+
+    cols = jax.vmap(sample_image)(data, sy, sx)         # (N,C,Ho,Wo,G,K)
+    # deformable groups: channel block g samples with offset group g
+    Cg = C // G
+    cols = cols.reshape(N, G, Cg, Ho, Wo, G, K)
+    cols = jnp.take_along_axis(
+        cols, jnp.arange(G).reshape(1, G, 1, 1, 1, 1, 1), axis=5)[:, :, :, :, :, 0]
+    cols = cols.reshape(N, C, Ho, Wo, K)
+    # one MXU GEMM: (N*Ho*Wo, C*K) x (C*K, F)
+    cols2 = jnp.moveaxis(cols, (2, 3), (1, 2)).reshape(N * Ho * Wo, C * K)
+    wmat = weight.reshape(num_filter, C * K).T
+    out = jnp.matmul(cols2, wmat).reshape(N, Ho, Wo, num_filter)
+    out = jnp.moveaxis(out, 3, 1)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+alias("DeformableConvolution", "_contrib_DeformableConvolution")

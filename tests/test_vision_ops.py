@@ -198,3 +198,33 @@ class TestProposal:
                                       output_score=True,
                                       rpn_post_nms_top_n=5, rpn_min_size=4)
         assert scores.shape == (5, 1)
+
+
+class TestDeformableConv:
+    def test_zero_offset_equals_plain_conv(self):
+        rng = onp.random.RandomState(0)
+        x = mx.nd.array(rng.rand(2, 4, 8, 8).astype(onp.float32))
+        w = mx.nd.array(rng.rand(6, 4, 3, 3).astype(onp.float32) * 0.1)
+        off = mx.nd.zeros((2, 18, 8, 8))
+        out = mx.nd.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                          pad=(1, 1), num_filter=6,
+                                          no_bias=True)
+        ref = mx.nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                num_filter=6, no_bias=True)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                                    atol=1e-5)
+
+    def test_gradients_flow_to_all_inputs(self):
+        rng = onp.random.RandomState(1)
+        x = mx.nd.array(rng.rand(1, 2, 6, 6).astype(onp.float32))
+        w = mx.nd.array(rng.rand(3, 2, 3, 3).astype(onp.float32) * 0.1)
+        off = mx.nd.array(rng.rand(1, 18, 6, 6).astype(onp.float32) * 0.5)
+        for t in (x, w, off):
+            t.attach_grad()
+        with autograd.record():
+            o = mx.nd.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                            pad=(1, 1), num_filter=3,
+                                            no_bias=True)
+        o.backward(mx.nd.ones(o.shape))
+        for t in (x, w, off):
+            assert float(onp.asarray(t.grad.abs().sum().asnumpy())) > 0
