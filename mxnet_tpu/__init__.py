@@ -48,6 +48,7 @@ def __getattr__(name):
         "rtc": ".rtc",
         "checkpoint": ".checkpoint",
         "engine": ".engine",
+        "name": ".name",
         "viz": ".visualization",
         "visualization": ".visualization",
         "util": ".util",
@@ -69,6 +70,10 @@ def __getattr__(name):
         "operator": ".operator",
         "model": ".model",
     }
+    if name == "AttrScope":
+        from .name import AttrScope
+        globals()["AttrScope"] = AttrScope
+        return AttrScope
     if name in _lazy:
         mod = _imp(_lazy[name], __name__)
         globals()[name] = mod

@@ -665,8 +665,16 @@ def _invoke_builder(opname, sym_args, attrs, name=None):
         inputs.append(s._heads[0])
     attrs = {k: v for k, v in attrs.items() if v is not None or k == "axis"}
     n_out = _MULTI_OUTPUT.get(opref.name, lambda a: 1)(attrs)
-    node = _Node(opref.name, name or _auto_name(opname.lower().strip("_")),
-                 inputs, attrs, num_outputs=n_out if n_out > 1 else None)
+    # naming scope + attribute scope (reference NameManager / AttrScope)
+    from ..name import current_name_manager, current_attrs
+    hint = opname.lower().strip("_")
+    nm = current_name_manager()
+    node_name = nm.get(name, hint) if nm is not None else \
+        (name or _auto_name(hint))
+    # user attrs ride along under the __key__ convention (never reach fn)
+    user_attrs = {f"__{k}__": v for k, v in current_attrs().items()}
+    node = _Node(opref.name, node_name, inputs, {**attrs, **user_attrs},
+                 num_outputs=n_out if n_out > 1 else None)
     return Symbol([(node, i) for i in range(n_out)])
 
 

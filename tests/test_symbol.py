@@ -204,3 +204,23 @@ class TestMultiOutput:
         parts = mx.sym.split(x, num_outputs=2, axis=0)
         outs = parts.eval(x=mx.nd.ones((4, 3)))
         assert len(outs) == 2 and outs[0].shape == (2, 3)
+
+
+class TestNameAttrScopes:
+    def test_prefix_names(self):
+        with mx.name.Prefix("scope_"):
+            s = mx.sym.relu(mx.sym.var("x"))
+        assert s.name.startswith("scope_relu")
+
+    def test_attr_scope_rides_and_filters(self):
+        with mx.AttrScope(ctx_group="dev1"):
+            t = mx.sym.relu(mx.sym.var("y"))
+        assert t.attr("__ctx_group__") == "dev1"
+        out = t.eval(y=mx.nd.array([-1.0, 3.0]))[0]
+        assert out.asnumpy().tolist() == [0.0, 3.0]
+
+    def test_attr_scope_nesting_merges(self):
+        with mx.AttrScope(a="1"):
+            with mx.AttrScope(b="2"):
+                u = mx.sym.relu(mx.sym.var("z"))
+        assert u.attr("__a__") == "1" and u.attr("__b__") == "2"
