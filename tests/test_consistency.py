@@ -1,0 +1,71 @@
+"""Cross-dtype consistency sweep over the core op corpus — the reference's
+``check_consistency`` test model (SURVEY.md §4: "same op across
+(ctx,dtype) lists"; here dtype is the axis, ctx being a single virtual
+mesh).  Also finite-difference gradient checks on representative ops
+(``check_numeric_gradient``, the reference's other op-test pillar)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency, check_numeric_gradient
+
+_DT = ("float32", "float16", "bfloat16")
+
+
+def _r(*shape):
+    return onp.random.RandomState(0).rand(*shape).astype(onp.float32)
+
+
+UNARY = ["relu", "sigmoid", "tanh", "exp", "log1p", "sqrt", "square",
+         "abs", "erf", "softsign", "rsqrt", "cbrt", "sin", "cos"]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_unary_consistent_across_dtypes(name):
+    fn = getattr(mx.nd, name)
+    check_consistency(lambda x: fn(x), [_r(4, 5) + 0.1], dtypes=_DT)
+
+
+BINARY = ["broadcast_add", "broadcast_sub", "broadcast_mul",
+          "broadcast_div", "broadcast_maximum", "broadcast_minimum"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_consistent_across_dtypes(name):
+    fn = getattr(mx.nd, name)
+    check_consistency(lambda a, b: fn(a, b), [_r(4, 5), _r(4, 5) + 0.5],
+                      dtypes=_DT)
+
+
+@pytest.mark.parametrize("case", [
+    ("dot", lambda a, b: mx.nd.dot(a, b), [_r(8, 16), _r(16, 4)]),
+    ("FullyConnected",
+     lambda x, w: mx.nd.FullyConnected(x, w, None, num_hidden=4,
+                                       no_bias=True),
+     [_r(8, 16), _r(4, 16)]),
+    ("softmax", lambda x: mx.nd.softmax(x), [_r(4, 10)]),
+    ("LayerNorm",
+     lambda x, g, b: mx.nd.LayerNorm(x, g, b),
+     [_r(4, 8), _r(8), _r(8)]),
+    ("mean", lambda x: mx.nd.mean(x, axis=1), [_r(4, 8)]),
+], ids=lambda c: c[0] if isinstance(c, tuple) else str(c))
+def test_compound_consistent_across_dtypes(case):
+    _, fn, inputs = case
+    check_consistency(fn, inputs, dtypes=_DT)
+
+
+@pytest.mark.parametrize("case", [
+    ("tanh", lambda x: mx.nd.tanh(x).sum(), [(3, 4)]),
+    ("sigmoid", lambda x: mx.nd.sigmoid(x).sum(), [(3, 4)]),
+    ("LayerNorm",
+     lambda x: mx.nd.LayerNorm(x, mx.nd.ones(6),
+                               mx.nd.zeros(6)).sum(), [(2, 6)]),
+    ("GELU", lambda x: mx.nd.Activation(x, act_type="gelu").sum(), [(3, 4)]),
+    ("mish", lambda x: mx.nd.mish(x).sum(), [(3, 4)]),
+    ("hard_sigmoid", lambda x: mx.nd.hard_sigmoid(x).sum(), [(3, 4)]),
+], ids=lambda c: c[0] if isinstance(c, tuple) else str(c))
+def test_numeric_gradient(case):
+    _, fn, shapes = case
+    rng = onp.random.RandomState(0)
+    inputs = [rng.rand(*s).astype(onp.float32) * 2 - 1 for s in shapes]
+    check_numeric_gradient(fn, inputs)
