@@ -14,7 +14,8 @@ __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
            "choice", "shuffle", "permutation", "gamma", "beta",
            "exponential", "poisson", "multinomial", "binomial",
            "lognormal", "laplace", "gumbel", "logistic", "chisquare",
-           "standard_normal", "multivariate_normal"]
+           "standard_normal", "multivariate_normal", "pareto", "power",
+           "rayleigh", "weibull", "geometric", "negative_binomial", "f"]
 
 seed = _base.seed
 
@@ -159,3 +160,52 @@ def multivariate_normal(mean, cov, size=None):
         return jax.random.multivariate_normal(
             k, m, c, shape=_shp(size) if size is not None else None)
     return _sample("multivariate_normal", fn, [mean, cov])
+
+
+def pareto(a, size=None):
+    return _sample("pareto", lambda k: jax.random.pareto(
+        k, a, shape=_shp(size) if size is not None else None) - 1.0)
+
+
+def power(a, size=None):
+    """X = U^(1/a) (numpy power distribution)."""
+    return _sample("power", lambda k: jax.random.uniform(
+        k, _shp(size) if size is not None else ()) ** (1.0 / a))
+
+
+def rayleigh(scale=1.0, size=None):
+    return _sample("rayleigh", lambda k: scale * jnp.sqrt(
+        -2.0 * jnp.log(jax.random.uniform(
+            k, _shp(size) if size is not None else (),
+            minval=jnp.finfo(jnp.float32).tiny))))
+
+
+def weibull(a, size=None):
+    return _sample("weibull", lambda k: jax.random.weibull_min(
+        k, 1.0, a, shape=_shp(size) if size is not None else None))
+
+
+def geometric(p, size=None):
+    return _sample("geometric", lambda k: jax.random.geometric(
+        k, p, shape=_shp(size) if size is not None else None))
+
+
+def negative_binomial(n, p, size=None):
+    """Gamma-Poisson mixture (numpy semantics)."""
+    def fn(k):
+        k1, k2 = jax.random.split(k)
+        shp = _shp(size) if size is not None else ()
+        lam = jax.random.gamma(k1, n, shape=shp) * (1.0 - p) / p
+        return jax.random.poisson(k2, lam, shape=shp if size is not None
+                                  else lam.shape)
+    return _sample("negative_binomial", fn)
+
+
+def f(dfnum, dfden, size=None):
+    def fn(k):
+        k1, k2 = jax.random.split(k)
+        shp = _shp(size) if size is not None else ()
+        num = jax.random.chisquare(k1, dfnum, shape=shp) / dfnum
+        den = jax.random.chisquare(k2, dfden, shape=shp) / dfden
+        return num / den
+    return _sample("f", fn)

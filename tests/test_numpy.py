@@ -319,3 +319,32 @@ class TestNpxControlFlow:
             pass
         mx.engine.set_bulk_size(prev)
         mx.engine.wait_all()
+
+
+class TestRandomDistributions:
+    def test_new_distributions_shapes_and_support(self):
+        import mxnet_tpu as mx
+        r = mx.np.random
+        mx.random.seed(0)
+        cases = [("pareto", (3.0,), lambda v: (v >= 0).all()),
+                 ("power", (5.0,), lambda v: ((v >= 0) & (v <= 1)).all()),
+                 ("rayleigh", (2.0,), lambda v: (v >= 0).all()),
+                 ("weibull", (1.5,), lambda v: (v >= 0).all()),
+                 ("geometric", (0.3,), lambda v: (v >= 1).all()),
+                 ("negative_binomial", (5, 0.5), lambda v: (v >= 0).all()),
+                 ("f", (5, 7), lambda v: (v > 0).all())]
+        for name, args, check in cases:
+            v = getattr(r, name)(*args, size=(500,)).asnumpy()
+            assert v.shape == (500,), name
+            assert check(v), name
+
+    def test_moments(self):
+        import mxnet_tpu as mx
+        r = mx.np.random
+        mx.random.seed(3)
+        onp.testing.assert_allclose(
+            r.rayleigh(2.0, size=(20000,)).asnumpy().mean(),
+            2.0 * onp.sqrt(onp.pi / 2), rtol=0.05)
+        onp.testing.assert_allclose(
+            r.geometric(0.25, size=(20000,)).asnumpy().mean(), 4.0,
+            rtol=0.05)
