@@ -1123,3 +1123,45 @@ def DeformableConvolution(data, offset, weight, bias=None, *, kernel=(),
 
 
 alias("DeformableConvolution", "_contrib_DeformableConvolution")
+
+
+@op("Correlation")
+def Correlation(data1, data2, *, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference anchor ``Correlation``,
+    src/operator/correlation.cc): for every displacement (dy, dx) within
+    ``max_displacement`` (step ``stride2``), the per-pixel patch
+    correlation of data1 against shifted data2.
+
+    Vectorized as one shifted multiply + box-sum per displacement (the
+    displacement count is static, so the whole op jits to a fused loop).
+    Output: (N, D*D, Ho, Wo) with D = 2*floor(max_displacement/stride2)+1."""
+    N, C, H, W = data1.shape
+    p = pad_size
+    a = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    b = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    Hp, Wp = H + 2 * p, W + 2 * p
+    steps = max_displacement // stride2
+    disps = [d * stride2 for d in range(-steps, steps + 1)]
+    bk = kernel_size // 2
+    outs = []
+    for dy in disps:
+        for dx in disps:
+            shifted = jnp.roll(b, shift=(-dy, -dx), axis=(2, 3))
+            valid_y = jnp.zeros(Hp, bool).at[
+                max(0, -dy):Hp - max(0, dy)].set(True)
+            valid_x = jnp.zeros(Wp, bool).at[
+                max(0, -dx):Wp - max(0, dx)].set(True)
+            mask = valid_y[:, None] & valid_x[None, :]
+            prod = (a * shifted if is_multiply
+                    else jnp.abs(a - shifted))
+            corr = prod.mean(axis=1) * mask[None]        # (N, Hp, Wp)
+            if kernel_size > 1:
+                corr = lax.reduce_window(
+                    corr, 0.0, lax.add, (1, kernel_size, kernel_size),
+                    (1, 1, 1), "SAME") / (kernel_size * kernel_size)
+            outs.append(corr)
+    out = jnp.stack(outs, axis=1)                        # (N, D*D, Hp, Wp)
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out

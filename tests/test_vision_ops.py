@@ -228,3 +228,24 @@ class TestDeformableConv:
         o.backward(mx.nd.ones(o.shape))
         for t in (x, w, off):
             assert float(onp.asarray(t.grad.abs().sum().asnumpy())) > 0
+
+
+class TestCorrelation:
+    def test_zero_displacement_is_mean_of_squares(self):
+        rng = onp.random.RandomState(0)
+        a = rng.rand(1, 3, 6, 6).astype(onp.float32)
+        out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(a),
+                                max_displacement=1)
+        assert out.shape == (1, 9, 6, 6)
+        onp.testing.assert_allclose(out.asnumpy()[0, 4],
+                                    (a[0] ** 2).mean(0), rtol=1e-5)
+
+    def test_displacement_alignment(self):
+        rng = onp.random.RandomState(1)
+        a = rng.rand(1, 2, 6, 6).astype(onp.float32)
+        b = onp.roll(a, -1, axis=3)
+        out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(b),
+                                max_displacement=1)
+        onp.testing.assert_allclose(out.asnumpy()[0, 3][:, 1:-1],
+                                    ((a[0] ** 2).mean(0))[:, 1:-1],
+                                    rtol=1e-5)
