@@ -25,6 +25,7 @@ from .mesh import (Mesh, P, make_mesh, current_mesh, default_mesh,
 from .collectives import (all_reduce, all_gather, reduce_scatter,
                           broadcast, ring_pass)
 from .spmd import ShardingRules, shard_block, SPMDTrainer
+from .pipeline import gpipe_apply, stack_stage_params
 
 __all__ = [
     "Mesh", "P", "make_mesh", "current_mesh", "default_mesh", "use_mesh",
@@ -32,4 +33,5 @@ __all__ = [
     "init_distributed", "local_mesh_axes",
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ring_pass",
     "ShardingRules", "shard_block", "SPMDTrainer",
+    "gpipe_apply", "stack_stage_params",
 ]

@@ -1,0 +1,105 @@
+"""Pipeline parallelism (GPipe schedule over a mesh axis).
+
+SURVEY.md §3.3 marks PP "optional later phase" for the reference (which has
+none — only manual ``group2ctx`` placement).  TPU-native implementation:
+stages live on a ``pp`` mesh axis, activations flow stage-to-stage with
+``ppermute`` (ICI-neighbor traffic), and microbatches fill the pipeline on
+a GPipe schedule — M microbatches over S stages cost M+S-1 ticks, all
+inside ONE jitted ``shard_map`` (XLA overlaps the permute with compute).
+
+The schedule is differentiable end-to-end: ``jax.grad`` through
+``gpipe_apply`` backpropagates the reverse schedule automatically, so a
+pipelined train step is just ``jax.value_and_grad(loss ∘ gpipe_apply)``.
+
+Constraint (by design): activations circulate a ring, so the stage input
+and output shapes must match — run embeddings/heads outside the pipelined
+trunk (the standard GPipe decomposition).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import NamedSharding
+
+from .mesh import Mesh, P, default_mesh, local_mesh_axes
+
+__all__ = ["gpipe_apply", "stack_stage_params"]
+
+
+def stack_stage_params(stage_params_list):
+    """Stack per-stage parameter pytrees on a new leading axis (the ``pp``
+    sharding axis): [tree_0, ..., tree_{S-1}] → tree of (S, ...) arrays."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves),
+                        *stage_params_list)
+
+
+def gpipe_apply(stage_fn: Callable, stage_params, x, mesh: Mesh = None,
+                axis: str = "pp", microbatches: int = None):
+    """Run ``x`` through S pipeline stages with a GPipe schedule.
+
+    - ``stage_fn(params_i, h) -> h`` — one stage (same structure every
+      stage, per-stage weights; h-shape invariant).
+    - ``stage_params`` — pytree with leading axis S (see
+      :func:`stack_stage_params`), sharded over ``axis``.
+    - ``x`` — (batch, ...) input, split into ``microbatches`` chunks along
+      axis 0 (default S, the minimum that fills the pipeline).
+
+    Returns the final stage's (batch, ...) output, replicated.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    mesh = mesh or default_mesh()
+    S = local_mesh_axes(mesh)[axis]
+    M = microbatches or S
+    xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    params = jax.tree.map(
+        lambda a: a._data if isinstance(a, NDArray) else jnp.asarray(a),
+        stage_params)
+    B = xv.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} must divide into {M} microbatches")
+    mb = B // M
+    xs = xv.reshape((M, mb) + xv.shape[1:])
+
+    p0 = jax.tree.map(lambda a: a[0], params)
+    out_aval = jax.eval_shape(stage_fn, p0, jax.ShapeDtypeStruct(
+        (mb,) + xv.shape[1:], xv.dtype))
+    if tuple(out_aval.shape) != (mb,) + tuple(xv.shape[1:]):
+        raise ValueError(
+            "gpipe_apply requires ring-invariant activations: stage output "
+            f"{tuple(out_aval.shape)} != input {(mb,) + tuple(xv.shape[1:])};"
+            " keep embeddings/heads outside the pipelined trunk")
+
+    def shard_fn(local_params, xs_full):
+        my = lax.axis_index(axis)
+        lp = jax.tree.map(lambda a: a[0], local_params)  # drop local S=1
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(state, t):
+            prev = lax.ppermute(state, axis, fwd)
+            x_t = xs_full[jnp.minimum(t, M - 1)].astype(out_aval.dtype)
+            inp = jnp.where(my == 0, x_t, prev)
+            out = stage_fn(lp, inp)
+            return out, out
+
+        state0 = jnp.zeros(out_aval.shape, out_aval.dtype)
+        # the carry varies per pp shard; mark the init accordingly
+        state0 = lax.pcast(state0, (axis,), to="varying") \
+            if hasattr(lax, "pcast") else lax.pvary(state0, (axis,))
+        _, hist = lax.scan(tick, state0, jnp.arange(M + S - 1))
+        # the final stage emits microbatch m at tick m + S - 1
+        outs = lax.dynamic_slice_in_dim(hist, S - 1, M, axis=0)
+        mine = jnp.where(my == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(mine, axis)  # replicate the true outputs
+
+    pspec = jax.tree.map(lambda a: P(axis), params)
+    params = jax.device_put(params, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), pspec))
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=(pspec, P()),
+                   out_specs=P())
+    out = fn(params, xs)
+    result = out.reshape((B,) + out.shape[2:])
+    return NDArray(result) if isinstance(x, NDArray) else result
