@@ -788,3 +788,31 @@ class capture:
 
 def current_capture():
     return getattr(capture._tls, "value", None)
+
+
+def _symbol_list_attr(self, recursive=False):
+    """All non-internal attrs of the head node (reference
+    ``Symbol.list_attr``); ``__key__`` user attrs are returned as ``key``."""
+    out = {}
+    nodes = _topo(self._heads) if recursive else [self._heads[0][0]]
+    for node in nodes:
+        for k, v in node.attrs.items():
+            if k.startswith("__") and k.endswith("__"):
+                key = k[2:-2]
+                out[f"{node.name}_{key}" if recursive else key] = v
+    return out
+
+
+def _symbol_attr_dict(self):
+    """name -> attrs for every node (reference ``attr_dict``)."""
+    out = {}
+    for node in _topo(self._heads):
+        attrs = {k[2:-2]: v for k, v in node.attrs.items()
+                 if k.startswith("__") and k.endswith("__")}
+        if attrs:
+            out[node.name] = attrs
+    return out
+
+
+Symbol.list_attr = _symbol_list_attr
+Symbol.attr_dict = _symbol_attr_dict

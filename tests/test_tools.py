@@ -134,3 +134,24 @@ class TestSymbolicCheckers:
         check_symbolic_forward(s, [x], [onp.maximum(x, 0)])
         check_symbolic_backward(s, [x], [onp.ones_like(x)],
                                 [(x > 0).astype(onp.float32)])
+
+
+class TestProfiler:
+    def test_aggregate_stats_capture_and_pause(self, tmp_path):
+        import mxnet_tpu as mx
+        mx.profiler.set_config(filename=str(tmp_path / "prof.json"),
+                               aggregate_stats=True)
+        mx.profiler.start()
+        a = mx.nd.array(onp.ones((8, 8), onp.float32))
+        _ = mx.nd.dot(a, a)
+        mx.profiler.pause()
+        _ = a + 1  # excluded section
+        mx.profiler.resume()
+        _ = mx.nd.dot(a, a)
+        mx.profiler.stop()
+        table = mx.profiler.dumps()
+        assert "dot" in table
+        mx.profiler.dump()
+        import json
+        trace = json.load(open(str(tmp_path / "prof.json")))
+        assert any(ev["name"] == "dot" for ev in trace["traceEvents"])
