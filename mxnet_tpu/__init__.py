@@ -69,6 +69,7 @@ def __getattr__(name):
         "contrib": ".contrib",
         "operator": ".operator",
         "model": ".model",
+        "predictor": ".predictor",
     }
     if name == "AttrScope":
         from .name import AttrScope

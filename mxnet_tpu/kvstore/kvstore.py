@@ -29,6 +29,13 @@ _KNOWN_TYPES = ("local", "device", "nccl", "tpu", "dist_sync", "dist_async",
 def create(name="local"):
     if name not in _KNOWN_TYPES:
         raise MXNetError(f"unknown kvstore type {name}")
+    if name == "dist_async":
+        import warnings
+        warnings.warn(
+            "kvstore 'dist_async' runs with SYNCHRONOUS semantics on TPU "
+            "(async parameter serving is anti-idiomatic under XLA "
+            "collectives; see PARITY.md). Updates are applied at barrier "
+            "points, not per-worker-push.", UserWarning, stacklevel=2)
     return KVStore(name)
 
 

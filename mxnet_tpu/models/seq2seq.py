@@ -29,6 +29,7 @@ class CrossAttention(HybridBlock):
             raise MXNetError(f"units {units} % heads {num_heads} != 0")
         self._units = units
         self._heads = num_heads
+        self._attn_dropout = dropout
         with self.name_scope():
             self.q = Dense(units, flatten=False, use_bias=use_bias,
                            in_units=units, dtype=dtype, prefix="q_")
@@ -50,7 +51,8 @@ class CrossAttention(HybridBlock):
                       shape=(B, H, Lk, D))
         v = F.reshape(F.slice_axis(kv, axis=0, begin=1, end=2),
                       shape=(B, H, Lk, D))
-        out = F.flash_attention(q, k, v, mem_mask, causal=False)
+        out = F.flash_attention(q, k, v, mem_mask, causal=False,
+                                dropout=self._attn_dropout)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, Lq, U))
         out = self.proj(out)
         if self.drop is not None:

@@ -35,6 +35,7 @@ class MultiHeadAttention(HybridBlock):
         self._units = units
         self._heads = num_heads
         self._causal = causal
+        self._attn_dropout = dropout
         with self.name_scope():
             self.qkv = Dense(3 * units, flatten=False, use_bias=use_bias,
                              in_units=units, dtype=dtype, prefix="qkv_")
@@ -51,7 +52,8 @@ class MultiHeadAttention(HybridBlock):
         q = F.reshape(F.slice_axis(qkv, axis=0, begin=0, end=1), shape=(B, H, L, D))
         k = F.reshape(F.slice_axis(qkv, axis=0, begin=1, end=2), shape=(B, H, L, D))
         v = F.reshape(F.slice_axis(qkv, axis=0, begin=2, end=3), shape=(B, H, L, D))
-        out = F.flash_attention(q, k, v, mask, causal=self._causal)
+        out = F.flash_attention(q, k, v, mask, causal=self._causal,
+                                dropout=self._attn_dropout)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, L, U))
         out = self.proj(out)
         if self.drop is not None:

@@ -9,7 +9,7 @@ actual reference files is best-effort — see PARITY.md):
   file := uint64 kMXAPINDArrayListMagic(0x112) | uint64 reserved(0)
         | uint64 n_arrays | n * ndarray_blob
         | uint64 n_names  | n * (uint64 len | bytes)  (names; 0 for list)
-  ndarray_blob := uint32 NDARRAY_V2_MAGIC(0xF993FAC9) | int32 stype(-1 dense)
+  ndarray_blob := uint32 NDARRAY_V2_MAGIC(0xF993FAC9) | int32 stype(0 dense)
         | uint32 ndim | int64 dims[ndim]
         | int32 devtype | int32 devid | int32 type_flag | raw data
 """
@@ -29,7 +29,7 @@ _ND_MAGIC = 0xF993FAC9
 def _write_nd(f, nd: NDArray):
     data = onp.ascontiguousarray(nd.asnumpy())
     f.write(struct.pack("<I", _ND_MAGIC))
-    f.write(struct.pack("<i", -1))  # dense stype
+    f.write(struct.pack("<i", 0))  # stype: kDefaultStorage (dense)
     f.write(struct.pack("<I", data.ndim))
     for d in data.shape:
         f.write(struct.pack("<q", d))
@@ -43,8 +43,12 @@ def _read_nd(f) -> NDArray:
     if magic != _ND_MAGIC:
         raise MXNetError(f"bad ndarray magic {magic:#x}")
     stype, = struct.unpack("<i", f.read(4))
-    if stype != -1:
-        raise MXNetError("sparse load not supported")
+    # 0 = kDefaultStorage (dense); -1 accepted for files written by the
+    # round-1 serializer which used -1 for dense.
+    if stype not in (0, -1):
+        raise MXNetError(
+            f"sparse .params load not supported (stype={stype}: "
+            "1=row_sparse, 2=csr)")
     ndim, = struct.unpack("<I", f.read(4))
     shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
     _devt, _devid = struct.unpack("<ii", f.read(8))

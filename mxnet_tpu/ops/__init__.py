@@ -4,3 +4,6 @@ from .registry import Op, get_op, list_ops, invoke, register
 from . import defs
 from . import nn
 from . import attention
+from . import linalg
+from . import optimizer_ops
+from . import extended

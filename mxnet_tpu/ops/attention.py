@@ -6,14 +6,22 @@ Reference counterpart: the BERT-era fused attention matmuls
 The TPU-native answer (SURVEY.md §5.7 — NEW capability, not parity) is:
 
 - ``flash_attention``: blockwise online-softmax attention, O(L) memory.
-  Forward is a Pallas kernel on TPU (MXU-tiled 128-blocks, fp32
-  accumulation); everywhere else a ``lax.scan`` blockwise implementation
-  that XLA fuses.  Backward recomputes blockwise from the saved
-  log-sum-exp (the flash-attention-2 scheme) — no O(L²) residuals.
+  On TPU both the forward AND backward run as Pallas kernels (MXU-tiled
+  128-blocks, fp32 accumulation); everywhere else a ``lax.scan`` blockwise
+  implementation that XLA fuses.  Padding masks (additive bias of layout
+  ``(B|1, 1, 1, Lk)``) and attention dropout run INSIDE the kernels;
+  general dense biases (e.g. ALiBi tables) take the XLA blockwise path.
+  Backward recomputes blockwise from the saved log-sum-exp (the
+  flash-attention-2 scheme) — no O(L²) residuals on any path.
 - ``ring_attention``: sequence-parallel attention over a mesh axis; K/V
   shards rotate around the ICI ring via ``ppermute`` while each device
   accumulates online-softmax partials for its local Q shard.  This is the
   scale-out long-context path (SURVEY.md §3.3 "SP/CP" row).
+
+Dropout determinism: the keep-mask is a pure position hash of
+``(seed, batch·head, q_pos, k_pos)`` computed identically by the Pallas
+kernels and the XLA paths, so a forward on one path and a backward
+recompute on another still see the same mask.
 
 Shapes follow (batch, heads, seq, head_dim) throughout.
 """
@@ -32,10 +40,11 @@ from .registry import op
 __all__ = ["flash_attention", "ring_attention"]
 
 _NEG_INF = -1e30
+_BLOCK = 128  # MXU-native q/k tile
 
 
 def _interpret() -> bool:
-    # run the Pallas kernel in interpreter mode (CPU numerics testing)
+    # run the Pallas kernels in interpreter mode (CPU numerics testing)
     return os.environ.get("MXNET_FLASH_INTERPRET", "") == "1"
 
 
@@ -51,11 +60,58 @@ def _use_pallas() -> bool:
         return False
 
 
-# ---------------------------------------------------------------------------
-# blockwise reference path (runs everywhere; O(L) memory via scan)
-# ---------------------------------------------------------------------------
+def _is_kmask(bias) -> bool:
+    """Additive bias of layout (B|1, 1, 1, Lk) — a key padding mask."""
+    return bias is not None and bias.ndim == 4 and \
+        bias.shape[1] == 1 and bias.shape[2] == 1
 
-def _blockwise_attn(q, k, v, bias, scale, causal, q_block):
+
+def _pallas_eligible(q, k, bias, dtype_ok=True) -> bool:
+    if not _use_pallas():
+        return False
+    if q.shape[2] % _BLOCK or k.shape[2] % _BLOCK:
+        return False
+    if bias is not None and not (_is_kmask(bias) and
+                                 bias.shape[3] == k.shape[2]):
+        return False
+    return dtype_ok
+
+
+# --------------------------------------------------------------------------- #
+# dropout keep-mask: pure position hash, identical on every path
+# --------------------------------------------------------------------------- #
+
+def _hash_bits(seed, bh, qpos, kpos):
+    """murmur3-style avalanche over (seed, batch·head, q, k) -> uint32.
+    ``bh``/``qpos``/``kpos`` broadcast against each other; pure uint32
+    elementwise ops so the Pallas TPU lowering computes bit-identical
+    values to XLA."""
+    u = jnp.uint32
+    h = u(seed) ^ (jnp.asarray(bh).astype(jnp.uint32) * u(0x9E3779B1))
+    h = h ^ (jnp.asarray(qpos).astype(jnp.uint32) * u(0x85EBCA77))
+    h = h ^ (jnp.asarray(kpos).astype(jnp.uint32) * u(0xC2B2AE3D))
+    h = h ^ (h >> 16)
+    h = h * u(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * u(0x846CA68B)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _keep_threshold(rate: float):
+    # drop iff bits < rate * 2^32  (P = rate)
+    return jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+
+
+def _keep(seed, bh, qpos, kpos, rate):
+    return _hash_bits(seed, bh, qpos, kpos) >= _keep_threshold(rate)
+
+
+# --------------------------------------------------------------------------- #
+# blockwise XLA path (runs everywhere; O(L) memory via scan over q blocks)
+# --------------------------------------------------------------------------- #
+
+def _blockwise_attn(q, k, v, bias, seed, scale, causal, dropout, q_block):
     """Online-softmax attention, scanning over q blocks.  Returns
     (out, lse) with lse = logsumexp of scores per query row (fp32).
     ``bias`` is an optional additive score bias broadcastable to
@@ -75,6 +131,8 @@ def _blockwise_attn(q, k, v, bias, scale, causal, q_block):
     k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
     kpos = lax.broadcasted_iota(jnp.int32, (1, Lk), 1)
+    bh = (lax.broadcasted_iota(jnp.int32, (B, H), 0) * H +
+          lax.broadcasted_iota(jnp.int32, (B, H), 1))[..., None, None]
 
     def one_block(i, qb):
         s = jnp.einsum("bhqd,bhkd->bhqk", qb.astype(jnp.float32), k32)
@@ -82,14 +140,18 @@ def _blockwise_attn(q, k, v, bias, scale, causal, q_block):
         if bias is not None:
             s = s + lax.dynamic_slice_in_dim(bias, i * q_block, q_block,
                                              axis=2)
+        qpos = i * q_block + lax.broadcasted_iota(
+            jnp.int32, (q_block, 1), 0)
         if causal:
-            qpos = i * q_block + lax.broadcasted_iota(
-                jnp.int32, (q_block, 1), 0)
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
         m = jnp.max(s, axis=-1, keepdims=True)
         m = jnp.maximum(m, -1e30)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            keep = _keep(seed, bh, qpos[None, None], kpos[None, None],
+                         dropout)
+            p = jnp.where(keep, p, 0.0) / (1.0 - dropout)
         o = jnp.einsum("bhqk,bhkd->bhqd", p, v32) / jnp.maximum(l, 1e-30)
         lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
         return o, lse
@@ -107,35 +169,73 @@ def _blockwise_attn(q, k, v, bias, scale, causal, q_block):
     return o.astype(q.dtype), lse
 
 
-# ---------------------------------------------------------------------------
+# --------------------------------------------------------------------------- #
 # Pallas TPU forward kernel
-# ---------------------------------------------------------------------------
+# --------------------------------------------------------------------------- #
 
-def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
+def _kmask_arrays(bias, B):
+    """(B|1, 1, 1, Lk) additive mask -> (Nb, 1, Lk) fp32 view for the
+    kernels (middle singleton keeps the Pallas block 3D/tile-legal)."""
+    return bias.astype(jnp.float32).reshape(
+        bias.shape[0], 1, bias.shape[3])
+
+
+def _pad_heads(x, D):
+    if x.shape[-1] == D:
+        return x
+    return jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, D - x.shape[-1]),))
+
+
+# residual layout: lse/delta are stored lane-replicated at width 128
+# ((BH, L, 128)) — the same scheme as jax.experimental.pallas.ops.tpu.
+# flash_attention — so the backward kernels can read (block_q, 1) columns
+# without any in-kernel transpose.
+_LANES = 128
+
+
+def _rep(x):
+    """(BH, L) -> (BH, L, 128) lane-replicated."""
+    return jnp.broadcast_to(x[..., None], x.shape + (_LANES,))
+
+
+def _block_q_for(L):
+    """Larger q blocks at length cut k/v HBM re-streaming (traffic scales
+    with L/block_q) while staying within VMEM."""
+    for bq in (512, 256, 128):
+        if L % bq == 0:
+            return bq
+    return _BLOCK
+
+
+def _pallas_fwd(q, k, v, scale, causal, kmask=None, seed=None, dropout=0.0,
+                block_q=None, block_k=_BLOCK):
     """Flash forward on TPU.  Grid (batch·heads, q_blocks, k_blocks) with
     the k axis innermost: VMEM holds one q/k/v block at a time (O(block·D)
     VMEM — long sequences stream from HBM) while running max / sum / output
     accumulators live in VMEM scratch across the k sweep.  head_dim is
-    padded to the 128-lane width so every model head size hits the MXU."""
+    padded to the 128-lane width so every model head size hits the MXU.
+    ``kmask`` is an optional (Nb, 1, Lk) additive bias (key padding mask);
+    ``dropout``/``seed`` apply in-kernel attention dropout via the shared
+    position hash."""
+    if block_q is None:
+        block_q = _block_q_for(q.shape[2])
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, L, D0 = q.shape
     Lk = k.shape[2]
     D = max(128, -(-D0 // 128) * 128)
-    if D != D0:
-        padd = ((0, 0), (0, 0), (0, 0), (0, D - D0))
-        q = jnp.pad(q, padd)
-        k = jnp.pad(k, padd)
-        v = jnp.pad(v, padd)
+    q, k, v = (_pad_heads(x, D) for x in (q, k, v))
     nq = L // block_q
     nk = Lk // block_k
+    inv_keep = 1.0 / (1.0 - dropout) if dropout > 0.0 else 1.0
 
-    # m/l scratch live at full 128-lane width (the value broadcast across
-    # lanes) — TPU vregs are (8, 128); a lane-1 scratch would not tile.
-    LANES = 128
-
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+    def kernel(seed_ref, *refs):
+        if kmask is not None:
+            km_ref = refs[0]
+            refs = refs[1:]
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        bhi = pl.program_id(0)
         qi = pl.program_id(1)
         kj = pl.program_id(2)
 
@@ -158,21 +258,28 @@ def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
+            if kmask is not None:
+                s = s + km_ref[0]                       # (1, bk) broadcast
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kpos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
             if causal:
-                qpos = qi * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, 1), 0)
-                kpos = kj * block_k + lax.broadcasted_iota(
-                    jnp.int32, (1, block_k), 1)
                 s = jnp.where(qpos >= kpos, s, _NEG_INF)
             m_prev = m_s[:]
             m_new = jnp.maximum(
                 m_prev, jnp.broadcast_to(
-                    jnp.max(s, axis=-1, keepdims=True), (block_q, LANES)))
+                    jnp.max(s, axis=-1, keepdims=True), (block_q, _LANES)))
             alpha = jnp.exp(m_prev - m_new)
             p = jnp.exp(s - m_new[:, :1])
+            # fully-masked rows/blocks: exp(-1e30 - (-1e30)) == 1 poison
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
             m_s[:] = m_new
             l_s[:] = l_s[:] * alpha + jnp.broadcast_to(
-                jnp.sum(p, axis=-1, keepdims=True), (block_q, LANES))
+                jnp.sum(p, axis=-1, keepdims=True), (block_q, _LANES))
+            if dropout > 0.0:
+                keep = _keep(seed_ref[0, 0], bhi, qpos, kpos, dropout)
+                p = jnp.where(keep, p, 0.0) * inv_keep
             acc_s[:] = acc_s[:] * alpha[:, :1] + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -187,73 +294,382 @@ def _pallas_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
     qr = q.reshape(B * H, L, D)
     kr = k.reshape(B * H, Lk, D)
     vr = v.reshape(B * H, Lk, D)
-    out, lse = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+    ]
+    args = [jnp.full((1, 1), 0 if seed is None else seed, jnp.uint32)]
+    if kmask is not None:
+        Nb = kmask.shape[0]
+        if Nb == 1:
+            km_idx = lambda b, i, j: (0, 0, j)
+        else:
+            km_idx = lambda b, i, j: (b // H, 0, j)
+        in_specs.append(pl.BlockSpec((1, 1, block_k), km_idx,
+                                     memory_space=pltpu.VMEM))
+        args.append(kmask)
+    in_specs += [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [qr, kr, vr]
+    out, lse_rep = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, L, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, L, _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qr, kr, vr)
+    )(*args)
     out = out.reshape(B, H, L, D)
     if D != D0:
         out = out[..., :D0]
-    return out, lse[..., 0].reshape(B, H, L)
+    return out, lse_rep[..., 0].reshape(B, H, L)
 
 
-# ---------------------------------------------------------------------------
+# --------------------------------------------------------------------------- #
+# Pallas TPU backward kernels (flash-attention-2: recompute from lse)
+# --------------------------------------------------------------------------- #
+
+def _pallas_bwd_dq(q, k, v, g, lse_rep, dlt_rep, scale, causal, kmask=None,
+                   seed=None, dropout=0.0, block_q=None, block_k=_BLOCK):
+    """dq kernel: grid (BH, nq, nk), k innermost; dq accumulates in VMEM.
+    ``lse_rep``/``dlt_rep`` are the lane-replicated (BH, L, 128) residuals."""
+    if block_q is None:
+        block_q = _block_q_for(q.shape[2])
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, L, D0 = q.shape
+    Lk = k.shape[2]
+    D = max(128, -(-D0 // 128) * 128)
+    q, k, v, g = (_pad_heads(x, D) for x in (q, k, v, g))
+    nq, nk = L // block_q, Lk // block_k
+    inv_keep = 1.0 / (1.0 - dropout) if dropout > 0.0 else 1.0
+
+    def kernel(seed_ref, *refs):
+        if kmask is not None:
+            km_ref = refs[0]
+            refs = refs[1:]
+        q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, dq_ref, dq_s = refs
+        bhi = pl.program_id(0)
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            dq_s[:] = jnp.zeros_like(dq_s)
+
+        run = True
+        if causal:
+            run = (qi + 1) * block_q > kj * block_k
+
+        @pl.when(run if causal else True)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            gb = g_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if kmask is not None:
+                s = s + km_ref[0]
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kpos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            p = jnp.exp(s - lse_ref[0][:, :1])          # (bq, bk)
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if dropout > 0.0:
+                keep = _keep(seed_ref[0, 0], bhi, qpos, kpos, dropout)
+                dp = jnp.where(keep, dp, 0.0) * inv_keep
+            ds = p * (dp - dlt_ref[0][:, :1])
+            dq_s[:] = dq_s[:] + scale * jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(kj == nk - 1)
+        def _finalize():
+            dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+    grid = (B * H, nq, nk)
+    in_specs = [pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                             memory_space=pltpu.SMEM)]
+    args = [jnp.full((1, 1), 0 if seed is None else seed, jnp.uint32)]
+    if kmask is not None:
+        Nb = kmask.shape[0]
+        km_idx = (lambda b, i, j: (0, 0, j)) if Nb == 1 else \
+            (lambda b, i, j: (b // H, 0, j))
+        in_specs.append(pl.BlockSpec((1, 1, block_k), km_idx,
+                                     memory_space=pltpu.VMEM))
+        args.append(kmask)
+    in_specs += [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [q.reshape(B * H, L, D), k.reshape(B * H, Lk, D),
+             v.reshape(B * H, Lk, D), g.reshape(B * H, L, D),
+             lse_rep, dlt_rep]
+    dq = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, L, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return dq.reshape(B, H, L, D)[..., :D0]
+
+
+def _pallas_bwd_dkv(q, k, v, g, lse_rep, dlt_rep, scale, causal, kmask=None,
+                    seed=None, dropout=0.0, need_dbias=False,
+                    block_q=_BLOCK, block_k=None):
+    """dk/dv kernel: grid (BH, nk, nq), q innermost.  Computation stays in
+    q-row orientation ((block_q, block_k) scores); dk/dv fall out of
+    contractions over the q dim, so no in-kernel transposes are needed.
+    Optionally also emits the q-and-lane-summed dbias for the k-mask
+    layout as (BH, 1, Lk)."""
+    if block_k is None:
+        block_k = _block_q_for(k.shape[2])
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, L, D0 = q.shape
+    Lk = k.shape[2]
+    D = max(128, -(-D0 // 128) * 128)
+    q, k, v, g = (_pad_heads(x, D) for x in (q, k, v, g))
+    nq, nk = L // block_q, Lk // block_k
+    inv_keep = 1.0 / (1.0 - dropout) if dropout > 0.0 else 1.0
+
+    def kernel(seed_ref, *refs):
+        if kmask is not None:
+            km_ref = refs[0]
+            refs = refs[1:]
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref) = refs[:6]
+        refs = refs[6:]
+        if need_dbias:
+            dk_ref, dv_ref, db_ref, dk_s, dv_s, db_s = refs
+        else:
+            dk_ref, dv_ref, dk_s, dv_s = refs
+        bhi = pl.program_id(0)
+        kj = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_s[:] = jnp.zeros_like(dk_s)
+            dv_s[:] = jnp.zeros_like(dv_s)
+            if need_dbias:
+                db_s[:] = jnp.zeros_like(db_s)
+
+        run = True
+        if causal:
+            run = (qi + 1) * block_q > kj * block_k
+
+        @pl.when(run if causal else True)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            gb = g_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if kmask is not None:
+                s = s + km_ref[0]
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kpos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            p = jnp.exp(s - lse_ref[0][:, :1])          # (bq, bk)
+            p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            p_drop = p
+            if dropout > 0.0:
+                keep = _keep(seed_ref[0, 0], bhi, qpos, kpos, dropout)
+                dp = jnp.where(keep, dp, 0.0) * inv_keep
+                p_drop = jnp.where(keep, p, 0.0) * inv_keep
+            ds = p * (dp - dlt_ref[0][:, :1])
+            # contract over the q dim — outputs land k-major, no transpose
+            dv_s[:] = dv_s[:] + jax.lax.dot_general(
+                p_drop, gb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_s[:] = dk_s[:] + scale * jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if need_dbias:
+                db_s[:] = db_s[:] + jnp.broadcast_to(
+                    jnp.sum(ds, axis=0, keepdims=True), db_s.shape)
+
+        @pl.when(qi == nq - 1)
+        def _finalize():
+            dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+            if need_dbias:
+                db_ref[0] = db_s[:1]
+
+    grid = (B * H, nk, nq)
+    in_specs = [pl.BlockSpec((1, 1), lambda b, j, i: (0, 0),
+                             memory_space=pltpu.SMEM)]
+    args = [jnp.full((1, 1), 0 if seed is None else seed, jnp.uint32)]
+    if kmask is not None:
+        Nb = kmask.shape[0]
+        km_idx = (lambda b, j, i: (0, 0, j)) if Nb == 1 else \
+            (lambda b, j, i: (b // H, 0, j))
+        in_specs.append(pl.BlockSpec((1, 1, block_k), km_idx,
+                                     memory_space=pltpu.VMEM))
+        args.append(kmask)
+    in_specs += [
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [q.reshape(B * H, L, D), k.reshape(B * H, Lk, D),
+             v.reshape(B * H, Lk, D), g.reshape(B * H, L, D),
+             lse_rep, dlt_rep]
+    out_specs = [
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B * H, Lk, D), jnp.float32),
+        jax.ShapeDtypeStruct((B * H, Lk, D), jnp.float32),
+    ]
+    scratch = [pltpu.VMEM((block_k, D), jnp.float32),
+               pltpu.VMEM((block_k, D), jnp.float32)]
+    if need_dbias:
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, 1, Lk), jnp.float32))
+        scratch.append(pltpu.VMEM((8, block_k), jnp.float32))
+    res = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    dk = res[0].reshape(B, H, Lk, D)[..., :D0]
+    dv = res[1].reshape(B, H, Lk, D)[..., :D0]
+    dbias = res[2].reshape(B, H, Lk) if need_dbias else None
+    return dk, dv, dbias
+
+
+
+# --------------------------------------------------------------------------- #
 # custom VJP: blockwise recompute backward (flash-attention-2 scheme)
-# ---------------------------------------------------------------------------
+# --------------------------------------------------------------------------- #
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, bias, scale, causal):
-    out, _ = _flash_fwd_impl(q, k, v, bias, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, bias, seed, scale, causal, dropout=0.0):
+    out, _ = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout)
     return out
 
 
-def _flash_fwd_impl(q, k, v, bias, scale, causal):
-    B, H, L, D = q.shape
-    Lk = k.shape[2]
-    if bias is None and _use_pallas() and L % 128 == 0 and Lk % 128 == 0:
-        return _pallas_fwd(q, k, v, scale, causal)
-    return _blockwise_attn(q, k, v, bias, scale, causal,
+def _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout):
+    L = q.shape[2]
+    if _pallas_eligible(q, k, bias):
+        kmask = _kmask_arrays(bias, q.shape[0]) if bias is not None \
+            else None
+        return _pallas_fwd(q, k, v, scale, causal, kmask=kmask, seed=seed,
+                           dropout=dropout)
+    return _blockwise_attn(q, k, v, bias, seed, scale, causal, dropout,
                            q_block=min(128, max(16, L)))
 
 
-def _flash_fwd(q, k, v, bias, scale, causal):
-    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal)
-    return out, (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, seed, scale, causal, dropout=0.0):
+    out, lse = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, res, g):
-    q, k, v, bias, out, lse = res
+def _flash_bwd(scale, causal, dropout, res, g):
+    q, k, v, bias, seed, out, lse = res
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
-    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     g32, o32 = g.astype(jnp.float32), out.astype(jnp.float32)
     # delta_i = sum_d o_i * do_i  (row-wise), standard flash backward
-    delta = jnp.sum(o32 * g32, axis=-1)              # (B,H,Lq)
+    delta = jnp.sum(o32 * g32, axis=-1)                 # (B,H,Lq)
 
+    if _pallas_eligible(q, k, bias):
+        kmask = _kmask_arrays(bias, B) if bias is not None else None
+        lse_rep = _rep(lse.reshape(B * H, Lq))
+        dlt_rep = _rep(delta.reshape(B * H, Lq))
+        dq = _pallas_bwd_dq(q, k, v, g, lse_rep, dlt_rep, scale, causal,
+                            kmask=kmask, seed=seed, dropout=dropout)
+        dk, dv, dbias_bh = _pallas_bwd_dkv(
+            q, k, v, g, lse_rep, dlt_rep, scale, causal, kmask=kmask,
+            seed=seed, dropout=dropout, need_dbias=bias is not None)
+        if bias is None:
+            dbias = None
+        else:
+            db = dbias_bh.sum(axis=1)                   # (B, Lk): sum heads
+            if bias.shape[0] == 1:
+                db = db.sum(axis=0, keepdims=True)
+            dbias = db.reshape(bias.shape).astype(bias.dtype)
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                dbias, None)
+
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     block = min(512, Lk)
     nkb = -(-Lk // block)
     padk = nkb * block - Lk
@@ -261,6 +677,8 @@ def _flash_bwd(scale, causal, res, g):
         k32 = jnp.pad(k32, ((0, 0), (0, 0), (0, padk), (0, 0)))
         v32 = jnp.pad(v32, ((0, 0), (0, 0), (0, padk), (0, 0)))
     qpos = lax.broadcasted_iota(jnp.int32, (Lq, 1), 0)
+    bh = (lax.broadcasted_iota(jnp.int32, (B, H), 0) * H +
+          lax.broadcasted_iota(jnp.int32, (B, H), 1))[..., None, None]
 
     bias32 = None
     if bias is not None:
@@ -283,9 +701,16 @@ def _flash_bwd(scale, causal, res, g):
         if causal:
             valid = jnp.logical_and(valid, qpos >= kpos)
         s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])              # (B,H,Lq,block)
-        dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+        p = jnp.exp(s - lse[..., None])                 # (B,H,Lq,block)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         dp = jnp.einsum("bhqd,bhkd->bhqk", g32, vs)
+        p_drop = p
+        if dropout > 0.0:
+            keep = _keep(seed, bh, qpos[None, None], kpos[None, None],
+                         dropout)
+            dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout)
+            p_drop = jnp.where(keep, p, 0.0) / (1.0 - dropout)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p_drop, g32)
         ds = p * (dp - delta[..., None]) * scale
         dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, ks)
         dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
@@ -304,8 +729,9 @@ def _flash_bwd(scale, causal, res, g):
 
     dq0 = jnp.zeros_like(q32)
     dq, (dks, dvs, dbs) = lax.scan(body, dq0, jnp.arange(nkb))
-    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, nkb * block, D)[:, :, :Lk]
-    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, nkb * block, D)[:, :, :Lk]
+    D_ = q.shape[3]
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, nkb * block, D_)[:, :, :Lk]
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, nkb * block, D_)[:, :, :Lk]
     if bias is None:
         dbias = None
     elif bias.shape[3] == 1:
@@ -316,7 +742,7 @@ def _flash_bwd(scale, causal, res, g):
         dbias = dbias.reshape(*dbias.shape[:3], nkb * block)[..., :Lk]
         dbias = dbias.astype(bias.dtype)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            dbias)
+            dbias, None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -330,36 +756,61 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 _PLAIN_ATTN_MAX_SCORES = 512 * 512
 
 
-def _plain_attn(q, k, v, bias, scale, causal):
+def _plain_attn(q, k, v, bias, scale, causal, dropout=0.0, seed=None):
+    B, H = q.shape[0], q.shape[1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    Lq, Lk = q.shape[2], k.shape[2]
     if causal:
-        Lq, Lk = q.shape[2], k.shape[2]
         qpos = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
         kpos = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
         s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout > 0.0:
+        bh = (lax.broadcasted_iota(jnp.int32, (B, H), 0) * H +
+              lax.broadcasted_iota(jnp.int32, (B, H), 1))[..., None, None]
+        qpos = lax.broadcasted_iota(jnp.int32, (1, 1, Lq, 1), 2)
+        kpos = lax.broadcasted_iota(jnp.int32, (1, 1, 1, Lk), 3)
+        keep = _keep(seed, bh, qpos, kpos, dropout)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 @op("flash_attention")
 def flash_attention(q, k, v, bias=None, *, scale: Optional[float] = None,
-                    causal: bool = False):
+                    causal: bool = False, dropout: float = 0.0,
+                    training: Optional[bool] = None):
     """Memory-efficient attention over (B, H, L, D) tensors.  ``bias`` is an
     optional additive score bias broadcastable to (B, H, Lq, Lk) — use
-    large negative values as a padding mask (treated as constant w.r.t.
-    grad).
+    large negative values as a padding mask.  Gradients propagate through
+    ``bias`` on every path (summed over broadcast dims).
+
+    ``dropout`` applies attention-probability dropout (reference: the
+    Dropout inside ``MultiheadAttention``) when training — in training
+    mode (``autograd.is_training()``) unless ``training`` overrides.
 
     Short sequences (score matrix ≤ ~512²) take an unblocked fused-softmax
-    path; long sequences run the O(L)-memory blockwise kernel (Pallas on
-    TPU)."""
+    path; long sequences run the O(L)-memory blockwise kernel.  On TPU,
+    128-aligned sequences with no bias or a key-padding-mask bias
+    (layout ``(B|1, 1, 1, Lk)``) run Pallas kernels forward AND backward;
+    general dense biases take the XLA blockwise path."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if training is None:
+        from .. import autograd
+        training = autograd.is_training()
+    rate = float(dropout) if training else 0.0
+    if rate > 0.0:
+        from .. import random as mxrandom
+        seed = jax.random.bits(mxrandom.next_key(), dtype=jnp.uint32)
+    else:
+        seed = jnp.uint32(0)
     if q.shape[2] * k.shape[2] <= _PLAIN_ATTN_MAX_SCORES:
-        return _plain_attn(q, k, v, bias, float(scale), bool(causal))
-    return _flash(q, k, v, bias, float(scale), bool(causal))
+        return _plain_attn(q, k, v, bias, float(scale), bool(causal),
+                           dropout=rate, seed=seed)
+    return _flash(q, k, v, bias, seed, float(scale), bool(causal), rate)
 
 
 # ---------------------------------------------------------------------------

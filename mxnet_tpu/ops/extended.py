@@ -1,0 +1,685 @@
+"""Round-2 operator-corpus extensions (SURVEY.md §3.1 "Operator corpus"):
+spatial-transformer pipeline, LRN, cumulative/scan ops, indexing utilities,
+bitwise family, masked softmax, and the remaining tensor ops the reference
+test surface touches (``src/operator/tensor/*``, ``src/operator/nn/lrn.cc``,
+``src/operator/spatial_transformer.cc``).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op, alias, get_op
+
+__all__ = [
+    "SpatialTransformer", "LRN", "cumsum", "cumprod", "batch_take",
+    "digamma", "moments", "ravel_multi_index", "unravel_index",
+    "masked_softmax", "masked_log_softmax", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "left_shift", "right_shift", "tril",
+    "triu", "trace", "tensordot", "kron", "outer", "khatri_rao",
+    "index_array", "arange_like", "allclose_op", "logsumexp",
+    "log1mexp", "relu6", "hard_swish", "logaddexp", "ldexp",
+    "copysign", "heaviside", "nextafter", "hypot", "floor_divide",
+    "remainder", "fmod", "gcd", "lcm", "isnan", "isinf", "isfinite",
+    "isposinf", "isneginf", "searchsorted", "bincount_op", "diff",
+    "ediff1d", "interp_op", "trapz_op", "cross_op", "vdot_op",
+    "inner_op", "polyval_op", "unique_op",
+]
+
+
+# --------------------------------------------------------------------------- #
+# spatial transformer networks (STN): GridGenerator + BilinearSampler fused
+# --------------------------------------------------------------------------- #
+
+@op("SpatialTransformer")
+def SpatialTransformer(data, loc, *, target_shape=(0, 0),
+                       transform_type="affine", sampler_type="bilinear",
+                       cudnn_off=False):
+    """Reference anchor ``SpatialTransformer``
+    (src/operator/spatial_transformer.cc): affine grid from ``loc`` (N, 6)
+    then bilinear sampling of NCHW ``data`` — the STN pipeline in one op."""
+    from .nn import GridGenerator, BilinearSampler
+    grid = get_op("GridGenerator").fn(loc, transform_type=transform_type,
+                                      target_shape=tuple(target_shape))
+    return get_op("BilinearSampler").fn(data, grid)
+
+
+@op("LRN")
+def LRN(data, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels (reference anchor
+    ``LRN``, the AlexNet-era op): out = x / (k + a/n * sum(x^2))^b."""
+    sq = jnp.square(data)                               # (N, C, H, W)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    # windowed channel sum via cumulative sums (static nsize)
+    csum = jnp.cumsum(padded, axis=1)
+    csum = jnp.pad(csum, ((0, 0), (1, 0), (0, 0), (0, 0)))
+    win = csum[:, nsize:] - csum[:, :-nsize]
+    norm = (knorm + alpha / nsize * win) ** beta
+    return data / norm
+
+
+# --------------------------------------------------------------------------- #
+# cumulative / scan
+# --------------------------------------------------------------------------- #
+
+@op("cumsum")
+def cumsum(a, *, axis=None, dtype=None):
+    out = jnp.cumsum(a if axis is not None else a.reshape(-1),
+                     axis=axis if axis is not None else 0)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@op("cumprod")
+def cumprod(a, *, axis=None, dtype=None):
+    out = jnp.cumprod(a if axis is not None else a.reshape(-1),
+                      axis=axis if axis is not None else 0)
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@op("logsumexp")
+def logsumexp(data, *, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(data, axis=axis, keepdims=keepdims)
+
+
+# --------------------------------------------------------------------------- #
+# indexing utilities
+# --------------------------------------------------------------------------- #
+
+@op("batch_take")
+def batch_take(a, indices):
+    """Reference ``batch_take``: out[i] = a[i, indices[i]] — rows pick one
+    element each (the classification-likelihood gather)."""
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@op("ravel_multi_index", differentiable=False)
+def ravel_multi_index(data, *, shape):
+    """(ndim, n) coordinate rows -> flat indices (reference
+    ``_ravel_multi_index``)."""
+    strides = []
+    s = 1
+    for d in reversed(shape):
+        strides.append(s)
+        s *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@op("unravel_index", differentiable=False)
+def unravel_index(data, *, shape):
+    """flat indices -> (ndim, n) coordinate rows (reference
+    ``_unravel_index``)."""
+    idx = data.astype(jnp.int64).reshape(-1)
+    coords = jnp.stack(jnp.unravel_index(idx, shape), axis=0)
+    return coords.astype(data.dtype)
+
+
+@op("index_array", differentiable=False)
+def index_array(data, *, axes=None):
+    """Reference ``_contrib_index_array``: an int64 array whose value at
+    position (i, j, ...) is its own index vector along ``axes``."""
+    shape = data.shape
+    axes = tuple(range(len(shape))) if axes is None else tuple(axes)
+    comps = [jnp.broadcast_to(
+        lax.broadcasted_iota(jnp.int64, shape, ax), shape) for ax in axes]
+    return jnp.stack(comps, axis=-1)
+
+
+@op("arange_like", differentiable=False)
+def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None):
+    """Reference ``_contrib_arange_like``: arange shaped like the input
+    (or its ``axis`` length)."""
+    if axis is None:
+        n = data.size
+        m = -(-n // repeat)                     # distinct values
+        out = start + step * jnp.arange(m, dtype=data.dtype)
+        return jnp.repeat(out, repeat)[:n].reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@op("searchsorted", differentiable=False)
+def searchsorted(a, v, *, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+@op("unique_op", differentiable=False)
+def unique_op(data, *, size=None, fill_value=0):
+    """np.unique with a STATIC ``size`` (XLA needs static shapes — the
+    reference's dynamic-shape unique must be bounded on TPU; pass
+    ``size=`` or get the input-sized padded form)."""
+    return jnp.unique(data.reshape(-1), size=size or data.size,
+                      fill_value=fill_value)
+
+
+# --------------------------------------------------------------------------- #
+# masked softmax family (reference masked_softmax / masked_log_softmax)
+# --------------------------------------------------------------------------- #
+
+@op("masked_softmax")
+def masked_softmax(data, mask=None, *, axis=-1, temperature=1.0,
+                   normalize=True):
+    s = data / temperature
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30)
+    p = jax.nn.softmax(s, axis=axis)
+    if mask is not None:
+        p = jnp.where(mask.astype(bool), p, 0.0)
+    return p
+
+
+@op("masked_log_softmax")
+def masked_log_softmax(data, mask=None, *, axis=-1, temperature=1.0):
+    s = data / temperature
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30)
+    out = jax.nn.log_softmax(s, axis=axis)
+    if mask is not None:
+        out = jnp.where(mask.astype(bool), out, -jnp.inf)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# bitwise / integer ops
+# --------------------------------------------------------------------------- #
+
+@op("bitwise_and", differentiable=False)
+def bitwise_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+@op("bitwise_or", differentiable=False)
+def bitwise_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+@op("bitwise_xor", differentiable=False)
+def bitwise_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+@op("bitwise_not", differentiable=False)
+def bitwise_not(a):
+    return jnp.bitwise_not(a)
+
+
+@op("left_shift", differentiable=False)
+def left_shift(a, b):
+    return jnp.left_shift(a, b)
+
+
+@op("right_shift", differentiable=False)
+def right_shift(a, b):
+    return jnp.right_shift(a, b)
+
+
+@op("gcd", differentiable=False)
+def gcd(a, b):
+    return jnp.gcd(a.astype(jnp.int64), b.astype(jnp.int64)).astype(a.dtype)
+
+
+@op("lcm", differentiable=False)
+def lcm(a, b):
+    return jnp.lcm(a.astype(jnp.int64), b.astype(jnp.int64)).astype(a.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# triangles / contractions
+# --------------------------------------------------------------------------- #
+
+@op("tril")
+def tril(data, *, k=0):
+    return jnp.tril(data, k=k)
+
+
+@op("triu")
+def triu(data, *, k=0):
+    return jnp.triu(data, k=k)
+
+
+@op("trace")
+def trace(data, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op("tensordot")
+def tensordot(a, b, *, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(x) if isinstance(x, (list, tuple)) else x
+                     for x in axes)
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@op("kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@op("outer")
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@op("vdot_op")
+def vdot_op(a, b):
+    return jnp.vdot(a, b)
+
+
+@op("inner_op")
+def inner_op(a, b):
+    return jnp.inner(a, b)
+
+
+@op("cross_op")
+def cross_op(a, b, *, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    return jnp.cross(a, b, axisa=axisa, axisb=axisb, axisc=axisc, axis=axis)
+
+
+@op("khatri_rao", variadic=True)
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference ``khatri_rao``)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        n = out.shape[1]
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, n)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# pointwise additions
+# --------------------------------------------------------------------------- #
+
+@op("digamma")
+def digamma(data):
+    return jax.scipy.special.digamma(data)
+
+
+@op("relu6")
+def relu6(data):
+    return jnp.clip(data, 0.0, 6.0)
+
+
+@op("hard_swish")
+def hard_swish(data):
+    return data * jnp.clip(data + 3.0, 0.0, 6.0) / 6.0
+
+
+@op("log1mexp")
+def log1mexp(data):
+    """log(1 - exp(x)) for x < 0, numerically stable."""
+    return jnp.where(data > -0.6931471805599453,          # -log 2
+                     jnp.log(-jnp.expm1(data)),
+                     jnp.log1p(-jnp.exp(data)))
+
+
+@op("logaddexp")
+def logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+@op("ldexp")
+def ldexp(a, b):
+    return a * jnp.power(2.0, b)
+
+
+@op("copysign")
+def copysign(a, b):
+    return jnp.copysign(a, b)
+
+
+@op("heaviside", differentiable=False)
+def heaviside(a, b):
+    return jnp.heaviside(a, b)
+
+
+@op("nextafter", differentiable=False)
+def nextafter(a, b):
+    return jnp.nextafter(a, b)
+
+
+@op("hypot")
+def hypot(a, b):
+    return jnp.hypot(a, b)
+
+
+@op("floor_divide", differentiable=False)
+def floor_divide(a, b):
+    return jnp.floor_divide(a, b)
+
+
+@op("remainder", differentiable=False)
+def remainder(a, b):
+    return jnp.remainder(a, b)
+
+
+@op("fmod", differentiable=False)
+def fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+@op("isnan", differentiable=False)
+def isnan(a):
+    return jnp.isnan(a)
+
+
+@op("isinf", differentiable=False)
+def isinf(a):
+    return jnp.isinf(a)
+
+
+@op("isfinite", differentiable=False)
+def isfinite(a):
+    return jnp.isfinite(a)
+
+
+@op("isposinf", differentiable=False)
+def isposinf(a):
+    return jnp.isposinf(a)
+
+
+@op("isneginf", differentiable=False)
+def isneginf(a):
+    return jnp.isneginf(a)
+
+
+# --------------------------------------------------------------------------- #
+# statistics / numerics
+# --------------------------------------------------------------------------- #
+
+@op("moments")
+def moments(data, *, axes=None, keepdims=False):
+    """Reference ``moments``: (mean, variance) over ``axes`` in one op."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    mk = mean if keepdims or ax is None else \
+        jnp.expand_dims(mean, ax)
+    var = jnp.mean(jnp.square(data - (mean if keepdims or ax is None
+                                      else mk)), axis=ax,
+                   keepdims=keepdims)
+    return mean, var
+
+
+@op("bincount_op", differentiable=False)
+def bincount_op(data, weights=None, *, minlength=0, length=None):
+    """Static-length bincount (XLA static shapes: pass ``length`` or
+    ``minlength`` as the bound)."""
+    n = length or minlength
+    if not n:
+        raise ValueError("TPU bincount needs a static length= or "
+                         "minlength= bound")
+    return jnp.bincount(data.reshape(-1).astype(jnp.int32),
+                        weights=None if weights is None
+                        else weights.reshape(-1), length=n)
+
+
+@op("diff")
+def diff(a, *, n=1, axis=-1):
+    return jnp.diff(a, n=n, axis=axis)
+
+
+@op("ediff1d")
+def ediff1d(a):
+    return jnp.diff(a.reshape(-1))
+
+
+@op("interp_op")
+def interp_op(x, xp, fp, *, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+@op("trapz_op")
+def trapz_op(y, x=None, *, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+@op("polyval_op")
+def polyval_op(p, x):
+    return jnp.polyval(p, x)
+
+
+@op("allclose_op", differentiable=False)
+def allclose_op(a, b, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Reference ``_contrib_allclose``."""
+    return jnp.all(jnp.isclose(a, b, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+# reference-name aliases
+alias("_ravel_multi_index", "ravel_multi_index")
+alias("_unravel_index", "unravel_index")
+alias("_contrib_index_array", "index_array")
+alias("_contrib_arange_like", "arange_like")
+alias("_contrib_allclose", "allclose_op")
+alias("softmax_cross_entropy_mask", "masked_log_softmax")
+
+
+# --------------------------------------------------------------------------- #
+# reductions / statistics (reference tensor/broadcast_reduce_op + np mirror)
+# --------------------------------------------------------------------------- #
+
+@op("var")
+def var(a, *, axis=None, ddof=0, keepdims=False):
+    return jnp.var(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims)
+
+
+@op("std")
+def std(a, *, axis=None, ddof=0, keepdims=False):
+    return jnp.std(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims)
+
+
+@op("ptp")
+def ptp(a, *, axis=None, keepdims=False):
+    return jnp.ptp(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@op("median")
+def median(a, *, axis=None, keepdims=False):
+    return jnp.median(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@op("percentile")
+def percentile(a, *, q, axis=None, keepdims=False,
+               interpolation="linear"):
+    return jnp.percentile(a, jnp.asarray(q), axis=_ax(axis),
+                          keepdims=keepdims, method=interpolation)
+
+
+@op("quantile")
+def quantile(a, *, q, axis=None, keepdims=False, interpolation="linear"):
+    return jnp.quantile(a, jnp.asarray(q), axis=_ax(axis),
+                        keepdims=keepdims, method=interpolation)
+
+
+@op("average")
+def average(a, weights=None, *, axis=None):
+    return jnp.average(a, axis=_ax(axis), weights=weights)
+
+
+@op("nanmean")
+def nanmean(a, *, axis=None, keepdims=False):
+    return jnp.nanmean(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@op("nanstd")
+def nanstd(a, *, axis=None, ddof=0, keepdims=False):
+    return jnp.nanstd(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims)
+
+
+@op("nanvar")
+def nanvar(a, *, axis=None, ddof=0, keepdims=False):
+    return jnp.nanvar(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims)
+
+
+@op("nanmax")
+def nanmax(a, *, axis=None, keepdims=False):
+    return jnp.nanmax(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@op("nanmin")
+def nanmin(a, *, axis=None, keepdims=False):
+    return jnp.nanmin(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@op("nanargmax", differentiable=False)
+def nanargmax(a, *, axis=None):
+    return jnp.nanargmax(a, axis=axis)
+
+
+@op("nanargmin", differentiable=False)
+def nanargmin(a, *, axis=None):
+    return jnp.nanargmin(a, axis=axis)
+
+
+@op("count_nonzero", differentiable=False)
+def count_nonzero(a, *, axis=None, keepdims=False):
+    return jnp.count_nonzero(a, axis=_ax(axis), keepdims=keepdims)
+
+
+@op("histogram_op", differentiable=False)
+def histogram_op(data, *, bin_cnt=10, range=None):
+    """Static-bin histogram (reference ``_histogram``): returns
+    (counts, bin_edges)."""
+    lo, hi = range if range is not None else (float(0), float(1))
+    return jnp.histogram(data.reshape(-1), bins=bin_cnt, range=(lo, hi))
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+# --------------------------------------------------------------------------- #
+# array manipulation
+# --------------------------------------------------------------------------- #
+
+@op("roll")
+def roll(a, *, shift, axis=None):
+    sh = tuple(shift) if isinstance(shift, (list, tuple)) else shift
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.roll(a, sh, axis=ax)
+
+
+@op("rot90")
+def rot90(a, *, k=1, axes=(0, 1)):
+    return jnp.rot90(a, k=k, axes=tuple(axes))
+
+
+@op("fliplr")
+def fliplr(a):
+    return jnp.fliplr(a)
+
+
+@op("flipud")
+def flipud(a):
+    return jnp.flipud(a)
+
+
+@op("atleast_1d")
+def atleast_1d(a):
+    return jnp.atleast_1d(a)
+
+
+@op("atleast_2d")
+def atleast_2d(a):
+    return jnp.atleast_2d(a)
+
+
+@op("atleast_3d")
+def atleast_3d(a):
+    return jnp.atleast_3d(a)
+
+
+@op("hstack", variadic=True)
+def hstack(*arrays):
+    return jnp.hstack(list(arrays))
+
+
+@op("vstack", variadic=True)
+def vstack(*arrays):
+    return jnp.vstack(list(arrays))
+
+
+@op("dstack", variadic=True)
+def dstack(*arrays):
+    return jnp.dstack(list(arrays))
+
+
+@op("column_stack", variadic=True)
+def column_stack(*arrays):
+    return jnp.column_stack(list(arrays))
+
+
+@op("meshgrid", variadic=True)
+def meshgrid(*arrays, indexing="xy"):
+    return tuple(jnp.meshgrid(*arrays, indexing=indexing))
+
+
+@op("hsplit")
+def hsplit(a, *, indices_or_sections):
+    return tuple(jnp.hsplit(a, indices_or_sections))
+
+
+@op("vsplit")
+def vsplit(a, *, indices_or_sections):
+    return tuple(jnp.vsplit(a, indices_or_sections))
+
+
+@op("dsplit")
+def dsplit(a, *, indices_or_sections):
+    return tuple(jnp.dsplit(a, indices_or_sections))
+
+
+@op("moveaxis")
+def moveaxis(a, *, source, destination):
+    return jnp.moveaxis(a, source, destination)
+
+
+@op("rollaxis")
+def rollaxis(a, *, axis, start=0):
+    return jnp.rollaxis(a, axis, start)
+
+
+@op("nan_to_num")
+def nan_to_num(a, *, copy=True, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op("resize_op")
+def resize_op(a, *, new_shape):
+    """np.resize semantics (cyclic repetition to the new shape)."""
+    return jnp.resize(a, tuple(new_shape))
+
+
+@op("broadcast_arrays", variadic=True)
+def broadcast_arrays(*arrays):
+    return tuple(jnp.broadcast_arrays(*arrays))
+
+
+@op("squared_difference")
+def squared_difference(a, b):
+    return jnp.square(a - b)
+
+
+@op("reset_arrays", variadic=True, differentiable=False)
+def reset_arrays(*arrays, num_arrays=None):
+    """Reference ``reset_arrays`` (zero a list of tensors in one engine
+    op — used to clear gradient buffers)."""
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@op("clip_global_norm", variadic=True, differentiable=False)
+def clip_global_norm(*arrays, max_norm, scale=1.0):
+    """gluon.utils.clip_global_norm as one fused op: rescales every array
+    by min(1, max_norm/||g||_global)."""
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a.astype(jnp.float32)))
+                         for a in arrays))
+    ratio = jnp.minimum(1.0, max_norm / (total * scale + 1e-12))
+    return tuple((a * ratio).astype(a.dtype) for a in arrays)

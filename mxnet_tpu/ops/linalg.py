@@ -1,0 +1,154 @@
+"""Extended linalg operator family (reference ``src/operator/tensor/la_op.cc``,
+SURVEY.md §3.1 "Operator corpus" — linalg: gemm/potrf/trsm/syrk/...).
+
+All ops operate on the last two axes with arbitrary leading batch dims,
+matching the reference's batched-linalg contract.  Implementations lower to
+XLA's native triangular-solve / Cholesky / QR / eigendecomposition, which
+map onto the MXU where the shapes allow; gradients come from jax autodiff
+through ``jax.numpy.linalg`` / ``jax.scipy.linalg``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op, alias
+
+__all__ = [
+    "linalg_gemm", "linalg_potri", "linalg_trmm", "linalg_gelqf",
+    "linalg_syevd", "linalg_sumlogdiag", "linalg_extractdiag",
+    "linalg_makediag", "linalg_extracttrian", "linalg_maketrian",
+    "linalg_inverse", "linalg_det", "linalg_slogdet",
+]
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@op("linalg_gemm")
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False,
+                alpha=1.0, beta=1.0, axis=-2):
+    """C' = alpha * op(A) @ op(B) + beta * C (reference ``linalg_gemm``)."""
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@op("linalg_trmm")
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply: B' = alpha * op(tri(A)) @ B (or B @ op)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = _t(tri)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@op("linalg_potri")
+def linalg_potri(A):
+    """Inverse from a Cholesky factor: A is lower-triangular L with
+    M = L @ L^T; returns M^{-1} (reference ``linalg_potri``)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@op("linalg_gelqf")
+def linalg_gelqf(A):
+    """LQ factorization of a full-rank m×n (m<=n) input: A = L @ Q with
+    Q orthonormal rows (reference ``linalg_gelqf``).  Returns (Q, L)."""
+    # LQ(A) from QR(A^T): A^T = QR  =>  A = R^T Q^T
+    q, r = jnp.linalg.qr(_t(A), mode="reduced")
+    return _t(q), _t(r)
+
+
+@op("linalg_syevd")
+def linalg_syevd(A):
+    """Symmetric eigendecomposition: A = U^T diag(L) U; returns (U, L)
+    with eigenvectors as ROWS of U (reference ``linalg_syevd``)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@op("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    """sum(log(diag(A))) over the last two axes (reference
+    ``linalg_sumlogdiag`` — the log-det of a Cholesky factor)."""
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@op("linalg_extractdiag")
+def linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@op("linalg_makediag")
+def linalg_makediag(A, *, offset=0):
+    def mk(v):
+        return jnp.diag(v, k=offset)
+    f = mk
+    for _ in range(A.ndim - 1):
+        f = jax.vmap(f)
+    return f(A)
+
+
+@op("linalg_extracttrian")
+def linalg_extracttrian(A, *, offset=0, lower=True):
+    """Pack the (lower/upper) triangle into a vector, row-major, matching
+    the reference's packed layout."""
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@op("linalg_maketrian")
+def linalg_maketrian(A, *, offset=0, lower=True):
+    """Inverse of extracttrian: unpack a vector into a triangular matrix."""
+    m = A.shape[-1]
+    # m = n(n+1)/2 + extra from offset; solve n for the common offset cases
+    k = abs(offset)
+    # n^2 + n(1 +- 2k)/... solve quadratically: count = n(n+1)/2 + k*n - k(k+1)/2 for offset>0
+    # reference restricts |offset| small; brute-force n
+    n = 1
+    while True:
+        if offset == 0:
+            cnt = n * (n + 1) // 2
+        elif (offset > 0) != lower:
+            cnt = n * (n + 1) // 2 + k * n - k * (k + 1) // 2
+        else:
+            cnt = n * (n + 1) // 2 - k * n + k * (k - 1) // 2
+        if cnt == m:
+            break
+        n += 1
+        if n > 10000:
+            raise ValueError(f"cannot infer matrix size from {m} packed "
+                             f"elements")
+    nn = n if offset <= 0 else n
+    rows, cols = jnp.tril_indices(nn, k=offset) if lower else \
+        jnp.triu_indices(nn, k=offset)
+    out = jnp.zeros(A.shape[:-1] + (nn, nn), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@op("linalg_inverse")
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@op("linalg_det")
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@op("linalg_slogdet")
+def linalg_slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+alias("det", "linalg_det")
+alias("slogdet", "linalg_slogdet")
+alias("inverse", "linalg_inverse")

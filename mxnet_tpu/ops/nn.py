@@ -414,28 +414,37 @@ def SoftmaxOutput(data, label, *, grad_scale=1.0, ignore_label=-1,
     """Reference anchor ``SoftmaxOutput``: forward = softmax; BACKWARD is the
     cross-entropy gradient ``(p - onehot(label)) * grad_scale`` regardless of
     the incoming cotangent (unless ``out_grad``) — the semantics the legacy
-    Module training loop relies on (backward with implicit ones)."""
+    Module training loop relies on (backward with implicit ones).
+
+    ``multi_output=True`` softmaxes over the channel axis (axis 1) of
+    ``(n, c, d1...)`` inputs with ``(n, d1...)`` labels, matching the
+    reference's NCHW segmentation-style usage."""
+    axis = 1 if (multi_output and data.ndim > 2) else -1
 
     @jax.custom_vjp
     def f(d, l):
-        return jax.nn.softmax(d, axis=-1)
+        return jax.nn.softmax(d, axis=axis)
 
     def fwd(d, l):
-        return jax.nn.softmax(d, axis=-1), (d, l)
+        return jax.nn.softmax(d, axis=axis), (d, l)
 
     def bwd(res, g):
         d, l = res
-        p = jax.nn.softmax(d, axis=-1)
-        v = d.shape[-1]
+        dm = jnp.moveaxis(d, axis, -1) if axis != -1 else d
+        p = jax.nn.softmax(dm, axis=-1)
+        v = dm.shape[-1]
         if l.shape == d.shape:  # distribution labels
-            onehot = l.astype(d.dtype)
+            lm = jnp.moveaxis(l, axis, -1) if axis != -1 else l
+            onehot = lm.astype(d.dtype)
+            l_is_dist = True
         else:
             onehot = jax.nn.one_hot(l.astype(jnp.int32), v, dtype=d.dtype)
+            l_is_dist = False
         if smooth_alpha:
             onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / v
         grad = p - onehot
         scale = grad_scale
-        if use_ignore and l.shape != d.shape:
+        if use_ignore and not l_is_dist:
             mask = (l.astype(jnp.int32) != int(ignore_label))
             grad = grad * mask[..., None].astype(d.dtype)
             if normalization == "valid":
@@ -444,7 +453,10 @@ def SoftmaxOutput(data, label, *, grad_scale=1.0, ignore_label=-1,
             scale = scale / d.shape[0]
         grad = grad * scale
         if out_grad:
-            grad = grad * g
+            gm = jnp.moveaxis(g, axis, -1) if axis != -1 else g
+            grad = grad * gm
+        if axis != -1:
+            grad = jnp.moveaxis(grad, -1, axis)
         return grad.astype(d.dtype), jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)
@@ -726,8 +738,12 @@ def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
 
         suppressed = lax.fori_loop(0, n, body,
                                    jnp.zeros(n, bool))
-        new_scores = jnp.where(suppressed | (scores < valid_thresh),
-                               -1.0, scores)
+        # reference discards all non-topk candidates outright (score -1),
+        # not just excludes them as suppressors
+        rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n))
+        new_scores = jnp.where(
+            suppressed | (scores < valid_thresh) | (rank >= keep_lim),
+            -1.0, scores)
         return batch.at[:, score_index].set(new_scores)
 
     return jax.vmap(one)(flat).reshape(shape)
@@ -1135,7 +1151,10 @@ def Correlation(data1, data2, *, kernel_size=1, max_displacement=1,
 
     Vectorized as one shifted multiply + box-sum per displacement (the
     displacement count is static, so the whole op jits to a fused loop).
-    Output: (N, D*D, Ho, Wo) with D = 2*floor(max_displacement/stride2)+1."""
+    Output: (N, D*D, Ho, Wo) with D = 2*floor(max_displacement/stride2)+1
+    and Ho = ceil((H + 2*pad - 2*border) / stride1) where
+    border = max_displacement + (kernel_size-1)//2 — the reference crops
+    that border from the padded grid before striding."""
     N, C, H, W = data1.shape
     p = pad_size
     a = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
@@ -1143,7 +1162,6 @@ def Correlation(data1, data2, *, kernel_size=1, max_displacement=1,
     Hp, Wp = H + 2 * p, W + 2 * p
     steps = max_displacement // stride2
     disps = [d * stride2 for d in range(-steps, steps + 1)]
-    bk = kernel_size // 2
     outs = []
     for dy in disps:
         for dx in disps:
@@ -1162,6 +1180,9 @@ def Correlation(data1, data2, *, kernel_size=1, max_displacement=1,
                     (1, 1, 1), "SAME") / (kernel_size * kernel_size)
             outs.append(corr)
     out = jnp.stack(outs, axis=1)                        # (N, D*D, Hp, Wp)
-    if stride1 > 1:
-        out = out[:, :, ::stride1, ::stride1]
+    # crop the reference's border (max_displacement + kernel_radius) and
+    # anchor stride1 sampling after it; within the crop every displaced
+    # window stays in-bounds so the zero-masking above never bites
+    border = max_displacement + (kernel_size - 1) // 2
+    out = out[:, :, border:Hp - border:stride1, border:Wp - border:stride1]
     return out

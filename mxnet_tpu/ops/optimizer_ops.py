@@ -1,0 +1,313 @@
+"""Fused optimizer update ops (reference ``src/operator/optimizer_op.cc``:
+``sgd_update``, ``adam_update``, ``lamb_update_phase1/2``, ``multi_sgd_*``,
+``mp_*`` multi-precision variants — SURVEY.md §3.1 "optimizer_op" row).
+
+TPU-native delta: the reference mutates ``weight``/state in place; here
+every op is PURE — it returns the updated tensors (single output ops
+support ``out=weight`` for reference-style call sites).  The Python
+optimizers (``mxnet_tpu/optimizer``) fuse these formulas into the jitted
+train step; these registered ops exist for ``mx.nd.*_update`` API parity
+and for custom training loops.
+
+All ops apply ``rescale_grad`` then ``clip_gradient`` (when >= 0) to the
+incoming gradient, matching the reference order.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+__all__ = [
+    "sgd_update", "sgd_mom_update", "mp_sgd_update", "mp_sgd_mom_update",
+    "nag_mom_update", "mp_nag_mom_update", "adam_update", "adamw_update",
+    "mp_adamw_update", "lamb_update_phase1", "lamb_update_phase2",
+    "ftrl_update", "ftml_update", "rmsprop_update", "rmspropalex_update",
+    "signsgd_update", "signum_update", "adagrad_update", "adadelta_update",
+    "multi_sgd_update", "multi_sgd_mom_update", "multi_mp_sgd_update",
+    "multi_mp_sgd_mom_update",
+]
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@op("sgd_update", differentiable=False)
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@op("sgd_mom_update", differentiable=False)
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight)
+    return weight + mom_new, mom_new
+
+
+@op("mp_sgd_update", differentiable=False)
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@op("mp_sgd_mom_update", differentiable=False)
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@op("nag_mom_update", differentiable=False)
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    mom_new = momentum * mom + g
+    return weight - lr * (g + momentum * mom_new), mom_new
+
+
+@op("mp_nag_mom_update", differentiable=False)
+def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient) \
+        + wd * weight32
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+@op("adam_update", differentiable=False)
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """Reference ``adam_update``: bias correction is folded into ``lr`` by
+    the Python optimizer (as in the reference), not done in-op."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    w = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    return w, mean_new, var_new
+
+
+@op("adamw_update", differentiable=False)
+def adamw_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """Reference ``_contrib_adamw_update``: decoupled weight decay; ``eta``
+    is the schedule multiplier applied on top of ``lr``."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    w = weight - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                        + lr * wd * weight)
+    return w, mean_new, var_new
+
+
+@op("mp_adamw_update", differentiable=False)
+def mp_adamw_update(weight, grad, mean, var, weight32, *, lr, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    w32 = weight32 - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                            + lr * wd * weight32)
+    return w32.astype(weight.dtype), mean_new, var_new, w32
+
+
+@op("lamb_update_phase1", differentiable=False)
+def lamb_update_phase1(weight, grad, mean, var, *, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Phase 1: the raw LAMB direction g' (reference
+    ``lamb_update_phase1``); phase 2 applies the layerwise trust ratio."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1.0 - beta1) * g
+    var_new = beta2 * var + (1.0 - beta2) * g * g
+    m_hat, v_hat = mean_new, var_new
+    if bias_correction:
+        m_hat = mean_new / (1.0 - beta1 ** t)
+        v_hat = var_new / (1.0 - beta2 ** t)
+    direction = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * weight
+    return direction, mean_new, var_new
+
+
+@op("lamb_update_phase2", differentiable=False)
+def lamb_update_phase2(weight, g, r1, r2, *, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    """Phase 2: w -= lr * (r1/r2) * g with the trust ratio from the norms
+    computed between phases (reference ``lamb_update_phase2``)."""
+    if lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+@op("ftrl_update", differentiable=False)
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    n_new = n + g * g
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z_new) <= lamda1, 0.0,
+        -(z_new - jnp.sign(z_new) * lamda1) /
+        ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return w.astype(weight.dtype), z_new, n_new
+
+
+@op("ftml_update", differentiable=False)
+def ftml_update(weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    g = _prep(grad, rescale_grad, clip_grad) + wd * weight
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    d_new = (1.0 - beta1 ** t) / lr * (
+        jnp.sqrt(v_new / (1.0 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    return -z_new / d_new, d_new, v_new, z_new
+
+
+@op("rmsprop_update", differentiable=False)
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = gamma1 * n + (1.0 - gamma1) * g * g
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new
+
+
+@op("rmspropalex_update", differentiable=False)
+def rmspropalex_update(weight, grad, n, g_state, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Centered RMSProp (Graves 2013), reference ``rmspropalex_update``."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = gamma1 * n + (1.0 - gamma1) * g * g
+    g_new = gamma1 * g_state + (1.0 - gamma1) * g
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(
+        n_new - g_new * g_new + epsilon)
+    w = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new, g_new, delta_new
+
+
+@op("signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@op("signum_update", differentiable=False)
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - (1.0 - momentum) * (g + wd * weight)
+    w = (1.0 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return w, mom_new
+
+
+@op("adagrad_update", differentiable=False)
+def adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    hist_new = history + g * g
+    return (weight - lr * (g / (jnp.sqrt(hist_new) + epsilon)
+                           + wd * weight), hist_new)
+
+
+@op("adadelta_update", differentiable=False)
+def adadelta_update(weight, grad, acc_g, acc_delta, *, rho=0.9,
+                    epsilon=1e-5, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    acc_g_new = rho * acc_g + (1.0 - rho) * g * g
+    delta = jnp.sqrt(acc_delta + epsilon) / \
+        jnp.sqrt(acc_g_new + epsilon) * g
+    acc_delta_new = rho * acc_delta + (1.0 - rho) * delta * delta
+    return weight - delta, acc_g_new, acc_delta_new
+
+
+# --------------------------------------------------------------------------- #
+# fused multi-tensor updates: one op over interleaved tensor lists
+# (reference ``multi_sgd_update`` family — the aggregated fast path driven
+# by Optimizer.aggregate_num; on TPU one jit already fuses everything, so
+# these exist for API parity and custom loops)
+# --------------------------------------------------------------------------- #
+
+@op("multi_sgd_update", differentiable=False, variadic=True)
+def multi_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None):
+    """arrays = [w0, g0, w1, g1, ...]; returns the updated weights."""
+    n = num_weights if num_weights is not None else len(arrays) // 2
+    outs = []
+    for i in range(n):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        g = _prep(g, rescale_grad, clip_gradient)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs)
+
+
+@op("multi_sgd_mom_update", differentiable=False, variadic=True)
+def multi_sgd_mom_update(*arrays, lrs, wds, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=None):
+    """arrays = [w0, g0, m0, w1, g1, m1, ...] -> (w0', m0', w1', m1', ...)"""
+    n = num_weights if num_weights is not None else len(arrays) // 3
+    outs = []
+    for i in range(n):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        g = _prep(g, rescale_grad, clip_gradient)
+        m_new = momentum * m - lrs[i] * (g + wds[i] * w)
+        outs += [w + m_new, m_new]
+    return tuple(outs)
+
+
+@op("multi_mp_sgd_update", differentiable=False, variadic=True)
+def multi_mp_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    """arrays = [w0, g0, w32_0, ...] -> (w0', w32_0', ...)"""
+    n = num_weights if num_weights is not None else len(arrays) // 3
+    outs = []
+    for i in range(n):
+        w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        g = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        w32_new = w32 - lrs[i] * (g + wds[i] * w32)
+        outs += [w32_new.astype(w.dtype), w32_new]
+    return tuple(outs)
+
+
+@op("multi_mp_sgd_mom_update", differentiable=False, variadic=True)
+def multi_mp_sgd_mom_update(*arrays, lrs, wds, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    """arrays = [w0, g0, m0, w32_0, ...] -> (w0', m0', w32_0', ...)"""
+    n = num_weights if num_weights is not None else len(arrays) // 4
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = arrays[4 * i:4 * i + 4]
+        g = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        m_new = momentum * m - lrs[i] * (g + wds[i] * w32)
+        w32_new = w32 + m_new
+        outs += [w32_new.astype(w.dtype), m_new, w32_new]
+    return tuple(outs)

@@ -1,0 +1,120 @@
+"""Standalone inference runner — the reference ``c_predict_api`` answer.
+
+Reference surface (SURVEY.md §3.1 "C API" row, ``src/c_api/c_predict_api.cc``):
+``MXPredCreate(symbol_json, param_bytes) / SetInput / Forward / GetOutput``
+— load an exported graph + weights and run inference with no training
+machinery.  TPU-native design: the exported ``-symbol.json`` +
+``-0000.params`` pair loads into a jitted forward; ``export_compiled``
+additionally serializes the XLA executable itself via ``jax.export`` so a
+serving process can run AOT without retracing Python model code (the
+deployment role the reference's C ABI played).
+
+    pred = Predictor("model-symbol.json", "model-0000.params",
+                     {"data": (1, 3, 224, 224)})
+    out = pred.forward(data=batch)[0]
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as onp
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Load an exported (graph-json, params) pair and run jitted inference.
+
+    API mirrors the reference's C predict surface: ``set_input`` +
+    ``forward`` + ``get_output`` (and a one-call ``forward(**inputs)``).
+    """
+
+    def __init__(self, symbol_file: str, param_file: Optional[str],
+                 input_shapes: Dict[str, tuple], ctx=None,
+                 dtype="float32"):
+        import jax
+
+        from . import context as _ctx
+        from .gluon.block import SymbolBlock
+        from .ndarray.ndarray import NDArray, array
+
+        self._ctx = ctx or _ctx.current_context()
+        self._input_names = list(input_shapes.keys())
+        self._input_shapes = dict(input_shapes)
+        self._dtype = dtype
+        self._net = SymbolBlock.imports(symbol_file, self._input_names,
+                                        param_file, ctx=self._ctx)
+        self._inputs: Dict[str, NDArray] = {}
+        self._outputs: List[NDArray] = []
+        self._array = array
+
+        def fwd(*xs):
+            from . import autograd
+            with autograd.pause(train_mode=False):
+                out = self._net(*[NDArray(x) for x in xs])
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            return [o._data for o in out]
+
+        self._fwd = jax.jit(fwd)
+
+    # -- reference-shaped API (MXPredSetInput / Forward / GetOutput) ------- #
+    def set_input(self, name: str, data) -> None:
+        if name not in self._input_names:
+            raise KeyError(f"unknown input {name!r}; have "
+                           f"{self._input_names}")
+        self._inputs[name] = self._array(onp.asarray(data))
+
+    def run(self) -> None:
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise ValueError(f"inputs not set: {missing}")
+        outs = self._fwd(*[self._inputs[n]._data
+                           for n in self._input_names])
+        from .ndarray.ndarray import NDArray
+        self._outputs = [NDArray(o) for o in outs]
+
+    def get_output(self, index: int = 0):
+        return self._outputs[index]
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._outputs)
+
+    # -- one-call convenience ---------------------------------------------- #
+    def forward(self, **inputs):
+        for name, data in inputs.items():
+            self.set_input(name, data)
+        self.run()
+        return list(self._outputs)
+
+    # -- AOT: serialize the compiled executable (jax.export) --------------- #
+    def export_compiled(self, path: str) -> None:
+        """Serialize the jitted forward as a portable StableHLO artifact
+        (``jax.export``): a serving host can ``load_compiled`` and run it
+        without this framework's Python model code — the deployment story
+        the reference's ``c_predict_api`` ABI provided."""
+        import jax
+        from jax import export as jexport
+        import jax.numpy as jnp
+
+        args = [jax.ShapeDtypeStruct(self._input_shapes[n],
+                                     jnp.dtype(self._dtype))
+                for n in self._input_names]
+        exported = jexport.export(self._fwd)(*args)
+        with open(path, "wb") as f:
+            f.write(exported.serialize())
+
+    @staticmethod
+    def load_compiled(path: str):
+        """Returns a callable running the serialized executable; takes the
+        original positional inputs (numpy or jax arrays)."""
+        from jax import export as jexport
+
+        with open(path, "rb") as f:
+            exported = jexport.deserialize(f.read())
+
+        def run(*xs):
+            return exported.call(*xs)
+
+        return run

@@ -56,7 +56,7 @@ def test_flash_grads_match_naive(causal):
     q, k, v = (_rand(1, 2, 48, 8) for _ in range(3))
 
     def f_flash(q, k, v):
-        return jnp.sum(_flash(q, k, v, None, 0.125, causal) ** 2)
+        return jnp.sum(_flash(q, k, v, None, jnp.uint32(0), 0.125, causal) ** 2)
 
     def f_naive(q, k, v):
         return jnp.sum(_naive(q, k, v, causal=causal, scale=0.125) ** 2)
@@ -122,7 +122,7 @@ def test_flash_bias_grad_matches_naive():
     bias = (_rand(2, 1, 24, 24) * 0.1).astype("float32")
 
     def f_flash(bias):
-        return jnp.sum(_flash(q, k, v, bias, 0.3, False) ** 2)
+        return jnp.sum(_flash(q, k, v, bias, jnp.uint32(0), 0.3, False) ** 2)
 
     def f_naive(bias):
         import jax.numpy as jnp
@@ -202,6 +202,182 @@ def test_plain_and_blockwise_paths_agree():
     import jax.numpy as jnp
     q, k, v = (jnp.asarray(_rand(1, 2, 96, 8)) for _ in range(3))
     a = _plain_attn(q, k, v, None, 0.125, True)
-    b = _flash(q, k, v, None, 0.125, True)
+    b = _flash(q, k, v, None, jnp.uint32(0), 0.125, True)
     onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
                                 rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------------------------------------- #
+# round 2: Pallas backward kernels, in-kernel padding mask, dropout
+# --------------------------------------------------------------------------- #
+
+def _naive_dropout(q, k, v, bias, scale, causal, rate, seed):
+    """Naive attention using the SAME position-hash keep mask as the
+    kernels — exact reference for dropout numerics on every path."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.ops.attention import _keep
+    B, H, Lq, _ = q.shape
+    Lk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        qp = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        kp = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        s = jnp.where(qp >= kp, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if rate > 0:
+        bh = (lax.broadcasted_iota(jnp.int32, (B, H), 0) * H +
+              lax.broadcasted_iota(jnp.int32, (B, H), 1))[..., None, None]
+        qp = lax.broadcasted_iota(jnp.int32, (1, 1, Lq, 1), 2)
+        kp = lax.broadcasted_iota(jnp.int32, (1, 1, 1, Lk), 3)
+        p = jnp.where(_keep(seed, bh, qp, kp, rate), p, 0.0) / (1 - rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def test_pallas_bwd_kernels_match_naive_grads():
+    """Interpret-mode Pallas dq + dkdv kernels vs jax.grad of naive."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _flash
+
+    q, k, v = (_rand(1, 2, 128, 64) for _ in range(3))
+    for causal in (False, True):
+        os.environ["MXNET_FLASH_INTERPRET"] = "1"
+        try:
+            g1 = jax.grad(lambda *a: jnp.sum(
+                _flash(*a, None, jnp.uint32(0), 0.125, causal) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+        finally:
+            del os.environ["MXNET_FLASH_INTERPRET"]
+        g2 = jax.grad(lambda *a: jnp.sum(
+            _naive(*a, causal=causal, scale=0.125) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                        rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_kmask_in_kernel_fwd_bwd():
+    """Key-padding-mask bias stays ON the Pallas path (fwd + both bwd
+    kernels, incl. dbias) and matches masked naive attention."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _flash, _pallas_eligible
+
+    q, k, v = (_rand(2, 2, 128, 64) for _ in range(3))
+    bias = onp.zeros((2, 1, 1, 128), "float32")
+    bias[:, :, :, 100:] = -1e30
+    bias = jnp.asarray(bias)
+    os.environ["MXNET_FLASH_INTERPRET"] = "1"
+    try:
+        assert _pallas_eligible(jnp.asarray(q), jnp.asarray(k), bias)
+        out = _flash(q, k, v, bias, jnp.uint32(0), 0.125, False)
+        g1 = jax.grad(lambda qq, kk, vv, bb: jnp.sum(
+            _flash(qq, kk, vv, bb, jnp.uint32(0), 0.125, False) ** 2),
+            argnums=(0, 1, 2, 3))(q, k, v, bias)
+    finally:
+        del os.environ["MXNET_FLASH_INTERPRET"]
+    ref = _naive(q, k[:, :, :100], v[:, :, :100], scale=0.125)
+    onp.testing.assert_allclose(onp.asarray(out[:, :, :, :]),
+                                onp.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def f_naive(qq, kk, vv, bb):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) * 0.125 + bb
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, vv) ** 2)
+
+    g2 = jax.grad(f_naive, argnums=(0, 1, 2, 3))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
+
+
+def test_dropout_fwd_stats_and_determinism():
+    """Dropout keeps ~(1-rate) mass, is deterministic per seed, differs
+    across seeds, and is off in inference mode."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _plain_attn
+
+    q, k, v = (jnp.asarray(_rand(2, 4, 64, 16)) for _ in range(3))
+    a1 = _plain_attn(q, k, v, None, 0.25, False, dropout=0.5,
+                     seed=jnp.uint32(7))
+    a2 = _plain_attn(q, k, v, None, 0.25, False, dropout=0.5,
+                     seed=jnp.uint32(7))
+    a3 = _plain_attn(q, k, v, None, 0.25, False, dropout=0.5,
+                     seed=jnp.uint32(8))
+    onp.testing.assert_array_equal(onp.asarray(a1), onp.asarray(a2))
+    assert onp.abs(onp.asarray(a1) - onp.asarray(a3)).max() > 1e-4
+
+    # E[dropped p row-sum] == 1; check the keep fraction is ~50%
+    from mxnet_tpu.ops.attention import _keep
+    import jax.lax as lax
+    bits = _keep(jnp.uint32(7), jnp.int32(0),
+                 lax.broadcasted_iota(jnp.int32, (256, 1), 0),
+                 lax.broadcasted_iota(jnp.int32, (1, 256), 1), 0.5)
+    frac = onp.asarray(bits).mean()
+    assert 0.47 < frac < 0.53, frac
+
+
+def test_dropout_grads_consistent_across_paths():
+    """XLA blockwise fwd+bwd with dropout == grads of the hash-identical
+    naive implementation (the mask regenerates identically)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _flash
+
+    q, k, v = (_rand(1, 2, 96, 8) for _ in range(3))
+    seed = jnp.uint32(42)
+    g1 = jax.grad(lambda *a: jnp.sum(
+        _flash(*a, None, seed, 0.125, False, 0.3) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(
+        _naive_dropout(*a, None, 0.125, False, 0.3, seed) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
+
+
+def test_dropout_pallas_kernels_match_naive():
+    """Pallas fwd + bwd with in-kernel dropout == hash-identical naive."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _flash
+
+    q, k, v = (_rand(1, 2, 128, 64) for _ in range(3))
+    seed = jnp.uint32(5)
+    os.environ["MXNET_FLASH_INTERPRET"] = "1"
+    try:
+        out = _flash(q, k, v, None, seed, 0.125, False, 0.2)
+        g1 = jax.grad(lambda *a: jnp.sum(
+            _flash(*a, None, seed, 0.125, False, 0.2) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+    finally:
+        del os.environ["MXNET_FLASH_INTERPRET"]
+    ref = _naive_dropout(q, k, v, None, 0.125, False, 0.2, seed)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+    g2 = jax.grad(lambda *a: jnp.sum(
+        _naive_dropout(*a, None, 0.125, False, 0.2, seed) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_op_dropout_training_flag():
+    """The public op applies dropout only in training mode."""
+    q, k, v = (mx.nd.array(_rand(1, 2, 32, 8)) for _ in range(3))
+    mx.random.seed(0)
+    out_infer = mx.nd.flash_attention(q, k, v, dropout=0.5)
+    with autograd.record(train_mode=True):
+        out_train = mx.nd.flash_attention(q, k, v, dropout=0.5)
+    ref = _naive(q.asnumpy(), k.asnumpy(), v.asnumpy())
+    onp.testing.assert_allclose(out_infer.asnumpy(), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+    assert onp.abs(out_train.asnumpy() - out_infer.asnumpy()).max() > 1e-4

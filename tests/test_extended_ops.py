@@ -1,0 +1,227 @@
+"""Round-2 operator-corpus extensions (mxnet_tpu/ops/extended.py):
+golden numerics vs numpy + selected gradient checks."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _nd(a):
+    return mx.nd.array(onp.asarray(a))
+
+
+class TestSpatialOps:
+    def test_spatial_transformer_identity(self):
+        """Identity affine theta must reproduce the input."""
+        rng = onp.random.RandomState(0)
+        img = rng.rand(2, 3, 8, 8).astype(onp.float32)
+        theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32),
+                         (2, 1))
+        out = mx.nd.SpatialTransformer(_nd(img), _nd(theta),
+                                       target_shape=(8, 8))
+        onp.testing.assert_allclose(out.asnumpy(), img, rtol=1e-4,
+                                    atol=1e-4)
+
+    def test_spatial_transformer_zoom(self):
+        """0.5-scale theta samples the center crop (smoke + shape)."""
+        rng = onp.random.RandomState(1)
+        img = rng.rand(1, 1, 8, 8).astype(onp.float32)
+        theta = onp.array([[0.5, 0, 0, 0, 0.5, 0]], onp.float32)
+        out = mx.nd.SpatialTransformer(_nd(img), _nd(theta),
+                                       target_shape=(4, 4))
+        assert out.shape == (1, 1, 4, 4)
+        assert onp.isfinite(out.asnumpy()).all()
+
+    def test_lrn_formula(self):
+        rng = onp.random.RandomState(2)
+        x = rng.rand(1, 6, 3, 3).astype(onp.float32)
+        out = mx.nd.LRN(_nd(x), alpha=1e-3, beta=0.75, knorm=2.0, nsize=3)
+        # reference formula, dense loop
+        ref = onp.empty_like(x)
+        for c in range(6):
+            lo, hi = max(0, c - 1), min(6, c + 2)
+            s = (x[:, lo:hi] ** 2).sum(axis=1)
+            ref[:, c] = x[:, c] / (2.0 + 1e-3 / 3 * s) ** 0.75
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-6)
+
+
+class TestIndexing:
+    def test_batch_take(self):
+        a = onp.arange(12, dtype=onp.float32).reshape(4, 3)
+        idx = onp.array([0, 2, 1, 0], onp.float32)
+        out = mx.nd.batch_take(_nd(a), _nd(idx))
+        onp.testing.assert_array_equal(out.asnumpy(),
+                                       a[onp.arange(4), idx.astype(int)])
+
+    def test_ravel_unravel_roundtrip(self):
+        coords = onp.array([[1, 2, 0], [0, 3, 1]], onp.int64)  # (2, 3)
+        flat = mx.nd.ravel_multi_index(_nd(coords).astype("int64"),
+                                       shape=(3, 4))
+        onp.testing.assert_array_equal(
+            flat.asnumpy(), onp.ravel_multi_index(coords, (3, 4)))
+        back = mx.nd.unravel_index(flat, shape=(3, 4))
+        onp.testing.assert_array_equal(back.asnumpy(), coords)
+
+    def test_index_array(self):
+        x = mx.nd.zeros((2, 3))
+        out = mx.nd.index_array(x)
+        assert out.shape == (2, 3, 2)
+        onp.testing.assert_array_equal(out.asnumpy()[1, 2], [1, 2])
+
+    def test_searchsorted_and_unique(self):
+        a = onp.array([1.0, 3.0, 5.0], onp.float32)
+        v = onp.array([2.0, 5.0], onp.float32)
+        out = mx.nd.searchsorted(_nd(a), _nd(v))
+        onp.testing.assert_array_equal(out.asnumpy(), [1, 2])
+        u = mx.nd.unique_op(_nd(onp.array([3.0, 1.0, 3.0, 2.0],
+                                          onp.float32)), size=3)
+        onp.testing.assert_array_equal(u.asnumpy(), [1.0, 2.0, 3.0])
+
+
+class TestMaskedSoftmax:
+    def test_masked_softmax_matches_manual(self):
+        rng = onp.random.RandomState(3)
+        x = rng.rand(2, 5).astype(onp.float32)
+        mask = onp.array([[1, 1, 0, 1, 0], [1, 1, 1, 1, 1]], onp.float32)
+        out = mx.nd.masked_softmax(_nd(x), _nd(mask))
+        arr = out.asnumpy()
+        assert (arr[0, [2, 4]] == 0).all()
+        onp.testing.assert_allclose(arr.sum(-1), [1.0, 1.0], rtol=1e-5)
+
+    def test_masked_softmax_grad_flows(self):
+        x = _nd(onp.random.RandomState(4).rand(2, 4).astype(onp.float32))
+        mask = _nd(onp.array([[1, 1, 1, 0]] * 2, onp.float32))
+        x.attach_grad()
+        with autograd.record():
+            out = mx.nd.masked_softmax(x, mask)
+            loss = (out * out).sum()
+        loss.backward()
+        g = x.grad.asnumpy()
+        assert onp.isfinite(g).all()
+        onp.testing.assert_allclose(g[:, 3], 0.0, atol=1e-6)
+
+
+class TestNumpyParityOps:
+    """Golden one-liners vs numpy for the breadth additions."""
+
+    CASES = [
+        ("cumsum", lambda: (onp.arange(6.0).reshape(2, 3),), {"axis": 1},
+         lambda a: onp.cumsum(a, axis=1)),
+        ("cumprod", lambda: (onp.arange(1.0, 7.0).reshape(2, 3),),
+         {"axis": 0}, lambda a: onp.cumprod(a, axis=0)),
+        ("diff", lambda: (onp.array([1.0, 3.0, 6.0, 10.0]),), {},
+         lambda a: onp.diff(a)),
+        ("tril", lambda: (onp.ones((3, 3), onp.float32),), {"k": 0},
+         onp.tril),
+        ("triu", lambda: (onp.ones((3, 3), onp.float32),), {"k": 1},
+         lambda a: onp.triu(a, 1)),
+        ("trace", lambda: (onp.arange(9.0).reshape(3, 3),), {},
+         lambda a: onp.trace(a)),
+        ("kron", lambda: (onp.eye(2, dtype=onp.float32),
+                          onp.ones((2, 2), onp.float32)), {}, onp.kron),
+        ("outer", lambda: (onp.arange(3.0), onp.arange(2.0)), {},
+         onp.outer),
+        ("hypot", lambda: (onp.array([3.0]), onp.array([4.0])), {},
+         onp.hypot),
+        ("logaddexp", lambda: (onp.array([1.0]), onp.array([2.0])), {},
+         onp.logaddexp),
+        ("copysign", lambda: (onp.array([1.0, -2.0]),
+                              onp.array([-1.0, 1.0])), {}, onp.copysign),
+        ("var", lambda: (onp.arange(8.0),), {}, lambda a: onp.var(a)),
+        ("std", lambda: (onp.arange(8.0),), {}, lambda a: onp.std(a)),
+        ("median", lambda: (onp.array([3.0, 1.0, 2.0]),), {},
+         lambda a: onp.median(a)),
+        ("ptp", lambda: (onp.array([3.0, 1.0, 7.0]),), {},
+         lambda a: onp.ptp(a)),
+        ("roll", lambda: (onp.arange(5.0),), {"shift": 2},
+         lambda a: onp.roll(a, 2)),
+        ("rot90", lambda: (onp.arange(4.0).reshape(2, 2),), {},
+         lambda a: onp.rot90(a)),
+        ("fliplr", lambda: (onp.arange(4.0).reshape(2, 2),), {},
+         onp.fliplr),
+        ("flipud", lambda: (onp.arange(4.0).reshape(2, 2),), {},
+         onp.flipud),
+        ("nan_to_num",
+         lambda: (onp.array([onp.nan, 1.0, onp.inf], onp.float32),), {},
+         lambda a: onp.nan_to_num(a)),
+        ("squared_difference", lambda: (onp.array([3.0]),
+                                        onp.array([1.0])), {},
+         lambda a, b: (a - b) ** 2),
+        ("digamma", lambda: (onp.array([1.0, 2.0]),), {},
+         lambda a: onp.array([-0.5772157, 0.42278433], onp.float32)),
+        ("logsumexp", lambda: (onp.array([1.0, 2.0, 3.0]),), {},
+         lambda a: onp.log(onp.exp(a).sum())),
+        ("isnan", lambda: (onp.array([1.0, onp.nan]),), {}, onp.isnan),
+        ("isinf", lambda: (onp.array([1.0, onp.inf]),), {}, onp.isinf),
+        ("gcd", lambda: (onp.array([12]), onp.array([8])), {}, onp.gcd),
+        ("floor_divide", lambda: (onp.array([7.0]), onp.array([2.0])), {},
+         lambda a, b: a // b),
+        ("remainder", lambda: (onp.array([7.0]), onp.array([3.0])), {},
+         onp.remainder),
+    ]
+
+    @pytest.mark.parametrize("name,mk,kw,ref",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_golden(self, name, mk, kw, ref):
+        args = mk()
+        out = getattr(mx.nd, name)(*[_nd(a) for a in args], **kw)
+        onp.testing.assert_allclose(out.asnumpy(), ref(*args),
+                                    rtol=1e-4, atol=1e-5)
+
+    def test_moments(self):
+        rng = onp.random.RandomState(5)
+        x = rng.rand(3, 4).astype(onp.float32)
+        mean, var = mx.nd.moments(_nd(x), axes=(1,))
+        onp.testing.assert_allclose(mean.asnumpy(), x.mean(1), rtol=1e-5)
+        onp.testing.assert_allclose(var.asnumpy(), x.var(1), rtol=1e-4)
+
+    def test_meshgrid_and_stacks(self):
+        a, b = onp.arange(3.0), onp.arange(2.0)
+        gx, gy = mx.nd.meshgrid(_nd(a), _nd(b))
+        rx, ry = onp.meshgrid(a, b)
+        onp.testing.assert_array_equal(gx.asnumpy(), rx)
+        onp.testing.assert_array_equal(gy.asnumpy(), ry)
+        h = mx.nd.hstack(_nd(a), _nd(a))
+        onp.testing.assert_array_equal(h.asnumpy(), onp.hstack([a, a]))
+        v = mx.nd.vstack(_nd(a), _nd(a))
+        assert v.shape == (2, 3)
+
+    def test_bincount_histogram(self):
+        x = onp.array([0, 1, 1, 3], onp.int32)
+        out = mx.nd.bincount_op(_nd(x), length=4)
+        onp.testing.assert_array_equal(out.asnumpy(), [1, 2, 0, 1])
+        counts, edges = mx.nd.histogram_op(
+            _nd(onp.array([0.1, 0.4, 0.6], onp.float32)), bin_cnt=2,
+            range=(0.0, 1.0))
+        onp.testing.assert_array_equal(counts.asnumpy(), [2, 1])
+
+    def test_khatri_rao(self):
+        A = onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32)
+        B = onp.array([[5.0, 6.0]], onp.float32)
+        out = mx.nd.khatri_rao(_nd(A), _nd(B))
+        ref = onp.vstack([onp.kron(A[:, i], B[:, i])
+                          for i in range(2)]).T
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+    def test_clip_global_norm_op(self):
+        a = onp.full(4, 3.0, onp.float32)
+        b = onp.full(4, 4.0, onp.float32)
+        outs = mx.nd.clip_global_norm(_nd(a), _nd(b), max_norm=1.0)
+        total = onp.sqrt(sum((x.asnumpy() ** 2).sum() for x in outs))
+        onp.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_relu6_hard_swish_grad(self):
+        x = _nd(onp.array([-1.0, 3.0, 7.0], onp.float32))
+        x.attach_grad()
+        with autograd.record():
+            loss = (mx.nd.relu6(x) + mx.nd.hard_swish(x)).sum()
+        loss.backward()
+        assert onp.isfinite(x.grad.asnumpy()).all()
+
+    def test_arange_like(self):
+        x = mx.nd.zeros((2, 3))
+        out = mx.nd.arange_like(x)
+        onp.testing.assert_array_equal(out.asnumpy(),
+                                       onp.arange(6.0).reshape(2, 3))

@@ -1,0 +1,222 @@
+"""Extended linalg family (mxnet_tpu/ops/linalg.py — reference
+``src/operator/tensor/la_op.cc``): golden numerics vs numpy + gradient
+checks through the tape."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _rand_spd(n, batch=()):
+    rng = onp.random.RandomState(0)
+    a = rng.rand(*batch, n, n).astype(onp.float32)
+    return a @ a.swapaxes(-1, -2) + n * onp.eye(n, dtype=onp.float32)
+
+
+class TestLinalgGolden:
+    def test_gemm(self):
+        rng = onp.random.RandomState(1)
+        A = rng.rand(2, 3, 4).astype(onp.float32)
+        B = rng.rand(2, 4, 5).astype(onp.float32)
+        C = rng.rand(2, 3, 5).astype(onp.float32)
+        out = mx.nd.linalg_gemm(mx.nd.array(A), mx.nd.array(B),
+                                mx.nd.array(C), alpha=2.0, beta=0.5)
+        onp.testing.assert_allclose(out.asnumpy(), 2.0 * A @ B + 0.5 * C,
+                                    rtol=1e-5, atol=1e-5)
+
+    def test_trmm(self):
+        rng = onp.random.RandomState(2)
+        A = rng.rand(4, 4).astype(onp.float32)
+        B = rng.rand(4, 3).astype(onp.float32)
+        out = mx.nd.linalg_trmm(mx.nd.array(A), mx.nd.array(B), alpha=1.5)
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    1.5 * onp.tril(A) @ B,
+                                    rtol=1e-5, atol=1e-5)
+
+    def test_potri_inverse_from_cholesky(self):
+        M = _rand_spd(4)
+        L = onp.linalg.cholesky(M)
+        out = mx.nd.linalg_potri(mx.nd.array(L))
+        onp.testing.assert_allclose(out.asnumpy(), onp.linalg.inv(M),
+                                    rtol=1e-3, atol=1e-4)
+
+    def test_gelqf(self):
+        rng = onp.random.RandomState(3)
+        A = rng.rand(3, 5).astype(onp.float32)
+        Q, L = mx.nd.linalg_gelqf(mx.nd.array(A))
+        Qn, Ln = Q.asnumpy(), L.asnumpy()
+        onp.testing.assert_allclose(Ln @ Qn, A, rtol=1e-4, atol=1e-5)
+        onp.testing.assert_allclose(Qn @ Qn.T, onp.eye(3), rtol=1e-4,
+                                    atol=1e-5)
+        # L lower-triangular
+        onp.testing.assert_allclose(Ln, onp.tril(Ln), atol=1e-6)
+
+    def test_syevd(self):
+        M = _rand_spd(4)
+        U, lam = mx.nd.linalg_syevd(mx.nd.array(M))
+        Un, ln = U.asnumpy(), lam.asnumpy()
+        # rows of U are eigenvectors: U^T diag(l) U == M
+        onp.testing.assert_allclose(Un.T @ onp.diag(ln) @ Un, M,
+                                    rtol=1e-3, atol=1e-3)
+
+    def test_sumlogdiag(self):
+        M = _rand_spd(5)
+        out = mx.nd.linalg_sumlogdiag(mx.nd.array(M))
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    onp.log(onp.diag(M)).sum(),
+                                    rtol=1e-5)
+
+    def test_extract_make_diag_roundtrip(self):
+        rng = onp.random.RandomState(4)
+        v = rng.rand(2, 3).astype(onp.float32)
+        M = mx.nd.linalg_makediag(mx.nd.array(v))
+        assert M.shape == (2, 3, 3)
+        back = mx.nd.linalg_extractdiag(M)
+        onp.testing.assert_allclose(back.asnumpy(), v, rtol=1e-6)
+
+    def test_extract_make_trian_roundtrip(self):
+        rng = onp.random.RandomState(5)
+        A = rng.rand(4, 4).astype(onp.float32)
+        packed = mx.nd.linalg_extracttrian(mx.nd.array(A))
+        assert packed.shape == (10,)
+        M = mx.nd.linalg_maketrian(packed)
+        onp.testing.assert_allclose(M.asnumpy(), onp.tril(A), rtol=1e-6)
+
+    def test_det_slogdet_inverse(self):
+        M = _rand_spd(3)
+        det = mx.nd.linalg_det(mx.nd.array(M))
+        onp.testing.assert_allclose(det.asnumpy(), onp.linalg.det(M),
+                                    rtol=1e-3)
+        sign, logabs = mx.nd.linalg_slogdet(mx.nd.array(M))
+        onp.testing.assert_allclose(sign.asnumpy() *
+                                    onp.exp(logabs.asnumpy()),
+                                    onp.linalg.det(M), rtol=1e-3)
+        inv = mx.nd.linalg_inverse(mx.nd.array(M))
+        onp.testing.assert_allclose(inv.asnumpy() @ M, onp.eye(3),
+                                    rtol=1e-3, atol=1e-3)
+
+
+class TestLinalgGrad:
+    def test_det_grad(self):
+        """d det(A) / dA = det(A) * A^{-T}."""
+        M = _rand_spd(3)
+        x = mx.nd.array(M)
+        x.attach_grad()
+        with autograd.record():
+            d = mx.nd.linalg_det(x)
+        d.backward()
+        expected = onp.linalg.det(M) * onp.linalg.inv(M).T
+        onp.testing.assert_allclose(x.grad.asnumpy(), expected,
+                                    rtol=1e-3, atol=1e-3)
+
+    def test_gemm_grad(self):
+        rng = onp.random.RandomState(6)
+        A = mx.nd.array(rng.rand(3, 4).astype(onp.float32))
+        B = mx.nd.array(rng.rand(4, 2).astype(onp.float32))
+        C = mx.nd.array(rng.rand(3, 2).astype(onp.float32))
+        for t in (A, B, C):
+            t.attach_grad()
+        with autograd.record():
+            out = mx.nd.linalg_gemm(A, B, C, alpha=2.0, beta=3.0)
+            loss = out.sum()
+        loss.backward()
+        ones = onp.ones((3, 2), onp.float32)
+        onp.testing.assert_allclose(A.grad.asnumpy(),
+                                    2.0 * ones @ B.asnumpy().T,
+                                    rtol=1e-5)
+        onp.testing.assert_allclose(C.grad.asnumpy(), 3.0 * ones,
+                                    rtol=1e-6)
+
+
+class TestOptimizerOps:
+    """mx.nd.*_update fused optimizer ops (reference optimizer_op.cc)."""
+
+    def test_sgd_update(self):
+        w = mx.nd.array(onp.full(4, 2.0, onp.float32))
+        g = mx.nd.array(onp.full(4, 1.0, onp.float32))
+        out = mx.nd.sgd_update(w, g, lr=0.5, wd=0.1)
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    2.0 - 0.5 * (1.0 + 0.1 * 2.0),
+                                    rtol=1e-6)
+
+    def test_sgd_mom_matches_optimizer_class(self):
+        """The op formula must match mxnet_tpu.optimizer.SGD step-by-step."""
+        rng = onp.random.RandomState(0)
+        w0 = rng.rand(5).astype(onp.float32)
+        grads = [rng.rand(5).astype(onp.float32) for _ in range(3)]
+        # op path
+        w = mx.nd.array(w0)
+        mom = mx.nd.zeros((5,))
+        for g in grads:
+            w, mom = mx.nd.sgd_mom_update(w, mx.nd.array(g), mom, lr=0.1,
+                                          momentum=0.9, wd=0.01)
+        # optimizer-class path
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+        w2 = mx.nd.array(w0)
+        state = opt.create_state(0, w2)
+        for g in grads:
+            state = opt.update(0, w2, mx.nd.array(g), state)
+        onp.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-5,
+                                    atol=1e-6)
+
+    def test_adam_update_formula(self):
+        rng = onp.random.RandomState(1)
+        w0 = rng.rand(3).astype(onp.float32)
+        g0 = rng.rand(3).astype(onp.float32)
+        w, m, v = mx.nd.adam_update(
+            mx.nd.array(w0), mx.nd.array(g0), mx.nd.zeros((3,)),
+            mx.nd.zeros((3,)), lr=0.01, beta1=0.9, beta2=0.999,
+            epsilon=1e-8)
+        m_ref = 0.1 * g0
+        v_ref = 0.001 * g0 * g0
+        w_ref = w0 - 0.01 * m_ref / (onp.sqrt(v_ref) + 1e-8)
+        onp.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
+        onp.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-5)
+        onp.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-5)
+
+    def test_mp_sgd_keeps_fp32_master(self):
+        w = mx.nd.array(onp.full(3, 1.0, onp.float16))
+        w32 = mx.nd.array(onp.full(3, 1.0, onp.float32))
+        g = mx.nd.array(onp.full(3, 1e-4, onp.float16))
+        w_new, w32_new = mx.nd.mp_sgd_update(w, g, w32, lr=1.0)
+        assert w_new.dtype == onp.float16
+        assert w32_new.dtype == onp.float32
+        onp.testing.assert_allclose(w32_new.asnumpy(), 1.0 - 1e-4,
+                                    rtol=1e-6)
+
+    def test_lamb_two_phase(self):
+        rng = onp.random.RandomState(2)
+        w0 = rng.rand(4).astype(onp.float32)
+        g0 = rng.rand(4).astype(onp.float32)
+        d, m, v = mx.nd.lamb_update_phase1(
+            mx.nd.array(w0), mx.nd.array(g0), mx.nd.zeros((4,)),
+            mx.nd.zeros((4,)), t=1, wd=0.01)
+        r1 = mx.nd.array(onp.array([onp.linalg.norm(w0)], onp.float32))
+        r2 = mx.nd.norm(d).reshape((1,))
+        w_new = mx.nd.lamb_update_phase2(mx.nd.array(w0), d, r1, r2, lr=0.1)
+        ratio = onp.linalg.norm(w0) / onp.linalg.norm(d.asnumpy())
+        ref = w0 - 0.1 * ratio * d.asnumpy()
+        onp.testing.assert_allclose(w_new.asnumpy(), ref, rtol=1e-4)
+
+    def test_multi_sgd_mom(self):
+        w1, g1, m1 = (onp.ones(2, onp.float32) * x for x in (1, 2, 0))
+        w2, g2, m2 = (onp.ones(3, onp.float32) * x for x in (3, 4, 0))
+        outs = mx.nd.multi_sgd_mom_update(
+            *[mx.nd.array(a) for a in (w1, g1, m1, w2, g2, m2)],
+            lrs=(0.1, 0.2), wds=(0.0, 0.0), momentum=0.9)
+        assert len(outs) == 4
+        onp.testing.assert_allclose(outs[0].asnumpy(), 1 - 0.1 * 2,
+                                    rtol=1e-6)
+        onp.testing.assert_allclose(outs[2].asnumpy(), 3 - 0.2 * 4,
+                                    rtol=1e-6)
+
+    def test_rmsprop_and_adagrad_shapes(self):
+        w = mx.nd.ones((3,))
+        g = mx.nd.ones((3,))
+        n = mx.nd.zeros((3,))
+        w2, n2 = mx.nd.rmsprop_update(w, g, n, lr=0.1)
+        assert w2.shape == (3,) and float(n2.asnumpy()[0]) > 0
+        h = mx.nd.zeros((3,))
+        w3, h2 = mx.nd.adagrad_update(w, g, h, lr=0.1)
+        assert float(h2.asnumpy()[0]) == 1.0

@@ -104,6 +104,26 @@ class TestSoftmaxOutputGrad:
         onp.testing.assert_allclose(x.grad.asnumpy(), p - onehot, rtol=1e-4,
                                     atol=1e-5)
 
+    def test_multi_output_channel_axis(self):
+        """multi_output=True softmaxes over axis 1 of (n, c, d1) inputs with
+        (n, d1) labels (reference NCHW segmentation semantics)."""
+        from mxnet_tpu import autograd
+        rng = onp.random.RandomState(3)
+        x = rng.rand(2, 4, 5).astype(onp.float32)
+        y = rng.randint(0, 4, (2, 5)).astype(onp.float32)
+        xd, yd = mx.nd.array(x), mx.nd.array(y)
+        xd.attach_grad()
+        with autograd.record():
+            out = mx.nd.SoftmaxOutput(xd, yd, multi_output=True)
+        out.backward()
+        e = onp.exp(x - x.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        onp.testing.assert_allclose(out.asnumpy(), p, rtol=1e-5, atol=1e-6)
+        onehot = onp.eye(4, dtype=onp.float32)[y.astype(int)]  # (2, 5, 4)
+        grad = p - onehot.transpose(0, 2, 1)
+        onp.testing.assert_allclose(xd.grad.asnumpy(), grad, rtol=1e-4,
+                                    atol=1e-5)
+
 
 class TestBucketing:
     @staticmethod
@@ -113,6 +133,9 @@ class TestBucketing:
         f = mx.sym.FullyConnected(d, mx.sym.var("fc_weight"),
                                   mx.sym.var("fc_bias"), num_hidden=4,
                                   flatten=False, name="fc")
+        # multi_output softmaxes over axis 1 (reference semantics), so put
+        # the class axis there: (n, seq, c) -> (n, c, seq)
+        f = mx.sym.transpose(f, axes=(0, 2, 1))
         return (mx.sym.SoftmaxOutput(f, l, multi_output=True),
                 ("data",), ("softmax_label",))
 

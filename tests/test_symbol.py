@@ -224,3 +224,48 @@ class TestNameAttrScopes:
             with mx.AttrScope(b="2"):
                 u = mx.sym.relu(mx.sym.var("z"))
         assert u.attr("__a__") == "1" and u.attr("__b__") == "2"
+
+
+class TestPredictor:
+    """Standalone inference runner — the c_predict_api answer
+    (mxnet_tpu/predictor.py, SURVEY.md §3.1 C API row)."""
+
+    def _export_mlp(self, tmp_path):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(10))
+        net.initialize()
+        x = mx.nd.array(onp.random.rand(3, 20).astype(onp.float32))
+        ref = net(x)
+        prefix = str(tmp_path / "pred")
+        net.export(prefix)
+        return prefix, x, ref
+
+    def test_predict_api_surface(self, tmp_path):
+        from mxnet_tpu.predictor import Predictor
+        prefix, x, ref = self._export_mlp(tmp_path)
+        pred = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                         {"data": (3, 20)})
+        # MXPredSetInput / Forward / GetOutput shape
+        pred.set_input("data", x.asnumpy())
+        pred.run()
+        out = pred.get_output(0)
+        onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+        # one-call convenience
+        out2 = pred.forward(data=x.asnumpy())[0]
+        onp.testing.assert_allclose(out2.asnumpy(), ref.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+
+    def test_compiled_artifact_roundtrip(self, tmp_path):
+        """jax.export AOT artifact: serialize, reload, run without the
+        model's Python code."""
+        from mxnet_tpu.predictor import Predictor
+        prefix, x, ref = self._export_mlp(tmp_path)
+        pred = Predictor(prefix + "-symbol.json", prefix + "-0000.params",
+                         {"data": (3, 20)})
+        artifact = str(tmp_path / "model.jaxexport")
+        pred.export_compiled(artifact)
+        run = Predictor.load_compiled(artifact)
+        out = run(x.asnumpy())[0]
+        onp.testing.assert_allclose(onp.asarray(out), ref.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)

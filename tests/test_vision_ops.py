@@ -236,9 +236,11 @@ class TestCorrelation:
         a = rng.rand(1, 3, 6, 6).astype(onp.float32)
         out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(a),
                                 max_displacement=1)
-        assert out.shape == (1, 9, 6, 6)
+        # reference crops a border of max_displacement + kernel_radius = 1
+        assert out.shape == (1, 9, 4, 4)
         onp.testing.assert_allclose(out.asnumpy()[0, 4],
-                                    (a[0] ** 2).mean(0), rtol=1e-5)
+                                    (a[0] ** 2).mean(0)[1:-1, 1:-1],
+                                    rtol=1e-5)
 
     def test_displacement_alignment(self):
         rng = onp.random.RandomState(1)
@@ -246,6 +248,7 @@ class TestCorrelation:
         b = onp.roll(a, -1, axis=3)
         out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(b),
                                 max_displacement=1)
-        onp.testing.assert_allclose(out.asnumpy()[0, 3][:, 1:-1],
-                                    ((a[0] ** 2).mean(0))[:, 1:-1],
+        # channel 3 = (dy=0, dx=-1); cropped grid covers orig coords 1..4
+        onp.testing.assert_allclose(out.asnumpy()[0, 3],
+                                    ((a[0] ** 2).mean(0))[1:-1, 1:-1],
                                     rtol=1e-5)
