@@ -29,6 +29,10 @@ def main(argv=None):
     p.add_argument("--synthetic", type=int, default=0,
                    help="use N synthetic samples instead of real CIFAR")
     p.add_argument("--hybridize", type=int, default=1)
+    p.add_argument("--eval", type=int, default=1,
+                   help="evaluate test-split accuracy each epoch")
+    p.add_argument("--lr-decay-epochs", type=str, default="",
+                   help="comma-separated epochs at which lr *= 0.1")
     args = p.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -50,6 +54,17 @@ def main(argv=None):
     loader = DataLoader(train.transform_first(transform),
                         batch_size=args.batch_size, shuffle=True,
                         num_workers=2, last_batch="discard")
+    val_loader = None
+    if args.eval:
+        try:
+            test = CIFAR10(root=args.data_dir, train=False,
+                           synthetic=args.synthetic and
+                           max(1000, args.synthetic // 5))
+        except Exception:
+            test = CIFAR10(train=False, synthetic=1000)
+        val_loader = DataLoader(test.transform_first(transform),
+                                batch_size=args.batch_size, shuffle=False,
+                                num_workers=2)
 
     net = get_resnet(1, 18, thumbnail=True, classes=10)
     net.initialize(mx.init.Xavier(), ctx=ctx)
@@ -61,7 +76,10 @@ def main(argv=None):
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     metric = mx.metric.Accuracy()
 
+    decay_epochs = {int(e) for e in args.lr_decay_epochs.split(",") if e}
     for epoch in range(args.epochs):
+        if epoch in decay_epochs:
+            trainer.set_learning_rate(trainer.learning_rate * 0.1)
         metric.reset()
         tic = time.time()
         n = 0
@@ -77,8 +95,15 @@ def main(argv=None):
             n += x.shape[0]
         name, acc = metric.get()
         dt = time.time() - tic
-        print(f"epoch {epoch}: {name}={acc:.4f} "
-              f"({n / dt:.0f} samples/s)")
+        line = f"epoch {epoch}: {name}={acc:.4f} ({n / dt:.0f} samples/s)"
+        if val_loader is not None:
+            vmetric = mx.metric.Accuracy()
+            for x, y in val_loader:
+                x = x.as_in_context(ctx)
+                y = y.astype("float32").as_in_context(ctx)
+                vmetric.update(y, net(x))
+            line += f" val-acc={vmetric.get()[1]:.4f}"
+        print(line, flush=True)
     return 0
 
 

@@ -4,11 +4,11 @@
 
 Flow (reference ``quantize_net``): run calibration batches through the
 fp32 net collecting per-layer input ranges (min-max or KL-entropy), then
-swap compute-heavy layers for quantized variants.  Here Dense layers become
-:class:`QuantizedDense` — weights pre-quantized to int8, activations
-quantized with the calibrated range, int8×int8→int32 MXU matmul, dequantized
-output.  Conv quantization falls back to fp32-with-calibrated-clip
-(documented descope; the int8 conv path follows the same recipe).
+swap compute-heavy layers for quantized variants.  Dense layers become
+:class:`QuantizedDense` and Conv2D layers :class:`QuantizedConv2D` —
+weights pre-quantized to int8 (per-output-channel scales for conv),
+activations quantized with the calibrated range, int8×int8→int32 MXU
+compute, dequantized output.
 """
 from __future__ import annotations
 
@@ -22,7 +22,8 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ops.quantization import optimal_threshold_kl
 
-__all__ = ["quantize_net", "QuantizedDense", "LayerOutputCollector"]
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "LayerOutputCollector"]
 
 
 class LayerOutputCollector:
@@ -110,12 +111,60 @@ class QuantizedDense(HybridBlock):
         return f"QuantizedDense({self._units}, int8)"
 
 
+class QuantizedConv2D(HybridBlock):
+    """INT8 Conv2D: per-output-channel int8 weights, calibrated activation
+    range, int32 accumulation (reference ``_contrib_quantized_conv``)."""
+
+    def __init__(self, conv: nn.Conv2D, input_threshold: float, **kwargs):
+        super().__init__(**kwargs)
+        w_np = conv.weight.data().asnumpy()             # (O, I, kh, kw)
+        o = w_np.shape[0]
+        w_amax = onp.abs(w_np).reshape(o, -1).max(axis=1)
+        w_amax = onp.where(w_amax > 0, w_amax, 1e-12)
+        qw = onp.clip(onp.round(w_np * (127.0 / w_amax)[:, None, None,
+                                                        None]),
+                      -127, 127).astype(onp.int8)
+        self._qweight = nd.array(qw, dtype="int8")
+        self._w_amax = nd.array(w_amax.astype(onp.float32))
+        self._bias = conv.bias.data() if conv.bias is not None else None
+        self._x_amax = float(input_threshold) or 1e-12
+        self._stride = conv._stride
+        self._pad = conv._pad
+        self._dilate = conv._dilate
+        self._groups = conv._groups
+        self._channels = conv._channels
+        self._act = conv.act
+
+    def hybrid_forward(self, F, x):
+        qx, _, _ = F._contrib_quantize_v2(x, min_calib_range=-self._x_amax,
+                                          max_calib_range=self._x_amax)
+        acc = F.quantized_conv_int8(qx, self._qweight, stride=self._stride,
+                                    pad=self._pad, dilate=self._dilate,
+                                    num_group=self._groups)
+        scale = self._w_amax.reshape((1, -1, 1, 1)) * \
+            (self._x_amax / (127.0 * 127.0))
+        out = acc.astype("float32") * scale
+        if self._bias is not None:
+            out = out + self._bias.reshape((1, -1, 1, 1))
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+    def __repr__(self):
+        return f"QuantizedConv2D({self._channels}, int8, per-channel)"
+
+
 def _walk_replace(block, collector, exclude):
     for name, child in list(block._children.items()):
         path = child.name
-        if isinstance(child, nn.Dense) and path not in exclude \
+        quantizable = isinstance(child, (nn.Dense, nn.Conv2D)) and \
+            not isinstance(child, nn.Conv2DTranspose)
+        if quantizable and path not in exclude \
                 and path in collector.stats:
-            q = QuantizedDense(child, collector.threshold(path))
+            if isinstance(child, nn.Dense):
+                q = QuantizedDense(child, collector.threshold(path))
+            else:
+                q = QuantizedConv2D(child, collector.threshold(path))
             block._children[name] = q
             # keep any attribute alias (self.fc = Dense(...)) pointing at
             # the quantized replacement
@@ -132,7 +181,8 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     """Quantize a Gluon net post-training (reference ``quantize_net``).
 
     ``calib_data``: iterable of input batches (NDArray or (x, y) tuples).
-    Returns the net with Dense layers swapped for QuantizedDense."""
+    Returns the net with Dense/Conv2D layers swapped for their int8
+    variants."""
     if quantized_dtype != "int8":
         raise MXNetError("only int8 quantization is supported")
     if calib_data is None:
@@ -150,7 +200,9 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             block._active = False
             block._cached_op = None
         for child in block._children.values():
-            if isinstance(child, nn.Dense):
+            if isinstance(child, nn.Dense) or (
+                    isinstance(child, nn.Conv2D)
+                    and not isinstance(child, nn.Conv2DTranspose)):
                 hooks.append(child.register_forward_pre_hook(
                     collector.hook(child.name)))
             attach(child)

@@ -416,13 +416,26 @@ class HybridBlock(Block):
 
     def forward(self, x, *args, **kwargs):
         from .. import ndarray as F
+        # pick the replica matching the input's device so the legacy
+        # per-ctx DP loop (split_and_load + per-ctx forward) runs each
+        # slice on its own device (reference per-ctx param copies)
+        ctx = x.context if isinstance(x, NDArray) and any(
+            p._replicas is not None
+            for p in self._reg_params.values()) else None
         try:
-            params = {name: p.data() for name, p in self._reg_params.items()}
+            params = {name: p.data(ctx)
+                      for name, p in self._reg_params.items()}
         except DeferredInitializationError:
             self._deferred_infer_shape(x, *args)
             for p in self._reg_params.values():
                 p._finish_deferred_init()
-            params = {name: p.data() for name, p in self._reg_params.items()}
+            # deferred init may have just CREATED the replicas — recompute
+            # the selection ctx so the first forward uses the right one
+            ctx = x.context if isinstance(x, NDArray) and any(
+                p._replicas is not None
+                for p in self._reg_params.values()) else None
+            params = {name: p.data(ctx)
+                      for name, p in self._reg_params.items()}
         return self.hybrid_forward(F, x, *args, **params, **kwargs)
 
     def _deferred_infer_shape(self, *args):
@@ -598,9 +611,15 @@ class _CachedOp:
             jax.eval_shape(fn, key0, *param_vals, *in_vals)
 
         key = mxrandom.next_key()
-        param_nds = [p._data for _, p in self._param_list]
         input_nds = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
                      for a in args]
+        # legacy multi-ctx DP: feed the replicas matching the input device
+        # (jax.jit re-specializes per placement, like the reference's
+        # per-ctx GraphInfo cache)
+        in_ctx = input_nds[0].context if input_nds and any(
+            p._replicas is not None for _, p in self._param_list) else None
+        param_nds = [p.data(in_ctx) if p._replicas is not None else p._data
+                     for _, p in self._param_list]
         opref = Op(name=f"CachedOp_{self._block.name}", fn=fn)
         result = invoke(opref, [NDArray(key)] + param_nds + input_nds, {})
         outs = result if isinstance(result, list) else [result]

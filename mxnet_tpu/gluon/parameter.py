@@ -70,6 +70,11 @@ class Parameter:
         self._deferred_init = None  # (init, ctx, default_init)
         self._trainer = None
         self._sharding = None  # jax.sharding.NamedSharding when meshed
+        # legacy multi-device DP: ctx-key -> replica NDArray when
+        # initialized with a multi-ctx list (reference per-ctx ``data()``
+        # copies, SURVEY.md §3.3 DP row); None for the canonical
+        # single-array / GSPMD paths
+        self._replicas = None
 
     # ------------------------------------------------------------------ #
     @property
@@ -83,12 +88,14 @@ class Parameter:
         if not self._differentiable:
             req = "null"
         self._grad_req = req
-        if self._data is not None:
+        reps = getattr(self, "_replicas", None)  # setter runs in __init__
+        for arr in (reps.values() if reps is not None
+                    else ([self._data] if self._data is not None else [])):
             if req == "null":
-                self._data._grad = None
-                self._data._grad_req = "null"
+                arr._grad = None
+                arr._grad_req = "null"
             else:
-                self._data.attach_grad(req)
+                arr.attach_grad(req)
 
     @property
     def shape(self):
@@ -140,6 +147,10 @@ class Parameter:
             return
         self._init_impl(init, ctx, default_init)
 
+    @staticmethod
+    def _ctx_key(ctx):
+        return (ctx.device_type, ctx.device_id)
+
     def _init_impl(self, init, ctx_list, default_init):
         # Explicit init (param-level ``self.init`` or the ``init`` argument)
         # rides the InitDesc ``__init__`` attr so the global initializer's
@@ -156,6 +167,16 @@ class Parameter:
         elif ctx is not None:
             arr._rebind(jax.device_put(arr._data, ctx.jax_device()))
         self._set_data_arr(arr)
+        if len(ctx_list) > 1 and self._sharding is None:
+            # reference per-ctx replicas: same values device_put to every
+            # ctx, each replica with its OWN grad buffer
+            self._replicas = OrderedDict()
+            self._replicas[self._ctx_key(ctx)] = arr
+            for c in ctx_list[1:]:
+                rep = NDArray(jax.device_put(arr._data, c.jax_device()), c)
+                if self._grad_req != "null":
+                    rep.attach_grad(self._grad_req)
+                self._replicas[self._ctx_key(c)] = rep
         self._deferred_init = None
 
     def _finish_deferred_init(self):
@@ -189,24 +210,51 @@ class Parameter:
 
     def data(self, ctx=None) -> NDArray:
         self._check_initialized()
+        if self._replicas is not None and ctx is not None:
+            key = self._ctx_key(ctx)
+            if key not in self._replicas:
+                raise MXNetError(
+                    f"parameter {self.name} was not initialized on "
+                    f"context {ctx} (replicas on "
+                    f"{list(self._replicas)})")
+            return self._replicas[key]
         return self._data
 
     def grad(self, ctx=None) -> NDArray:
         self._check_initialized()
-        if self._grad_req == "null" or self._data._grad is None:
+        arr = self.data(ctx)
+        if self._grad_req == "null" or arr._grad is None:
             raise MXNetError(
                 f"cannot get grad for {self.name}: grad_req is 'null'")
-        return self._data._grad
+        return arr._grad
 
     def list_data(self):
+        self._check_initialized()
+        if self._replicas is not None:
+            return list(self._replicas.values())
         return [self.data()]
 
     def list_grad(self):
+        if self._replicas is not None:
+            return [r._grad for r in self._replicas.values()]
         return [self.grad()]
 
     def list_ctx(self):
         self._check_initialized()
+        if self._replicas is not None:
+            return [r.context for r in self._replicas.values()]
         return [self._data.context]
+
+    def _sync_replicas(self):
+        """Broadcast the primary replica's value to the others (after an
+        optimizer update — the reference's kvstore weight pull)."""
+        if self._replicas is None:
+            return
+        src = self._data._data
+        for key, rep in self._replicas.items():
+            if rep is self._data:
+                continue
+            rep._rebind(jax.device_put(src, rep.context.jax_device()))
 
     def set_data(self, data):
         """Replace the value, preserving the grad buffer (reference
@@ -227,6 +275,7 @@ class Parameter:
         if self._sharding is not None:
             src = jax.device_put(src, self._sharding)
         self._data._rebind(jnp.asarray(src, self._data._data.dtype))
+        self._sync_replicas()
 
     def _load_init(self, src, ctx=None):
         """Set the value from a loaded array (``load_parameters`` /
@@ -255,6 +304,11 @@ class Parameter:
             self._data._rebind(jnp.asarray(data, self._data._data.dtype))
 
     def zero_grad(self):
+        if self._replicas is not None:
+            for r in self._replicas.values():
+                if r._grad is not None:
+                    r.zero_grad()
+            return
         if self._data is not None and self._data._grad is not None:
             self._data.zero_grad()
 

@@ -84,13 +84,18 @@ class Trainer:
                 # update otherwise (single-process TPU: local fused update)
                 self._update_on_kvstore = str(self._kv_type).startswith(
                     "dist")
+            needs_reduce = any(p._replicas is not None
+                               for p in self._params)
             if (not self._update_on_kvstore
+                    and not needs_reduce
                     and not hasattr(self._kv_type, "push")
                     and not str(self._kv_type).startswith("dist")):
                 # a Parameter owns ONE canonical (possibly GSPMD-sharded)
                 # array, so local pushpull would be an identity allreduce;
                 # skip the store entirely (no weight mirror, no per-step
-                # no-op) — jit/GSPMD handles cross-device reduction
+                # no-op) — jit/GSPMD handles cross-device reduction.
+                # Params with per-ctx REPLICAS (multi-ctx initialize) do
+                # need the store: pushpull sums the per-device grads.
                 self._kvstore = None
             else:
                 from .. import kvstore as kv_mod
@@ -181,6 +186,9 @@ class Trainer:
                 self._states_created[i] = True
             self._states[i] = self._optimizer.update_multi_precision(
                 i, p.data(), p.grad(), self._states[i])
+            # broadcast updated weights to the other replicas (the
+            # reference's kvstore weight pull after the server update)
+            p._sync_replicas()
 
     # -- state checkpointing (SURVEY.md §5.4 d) --------------------------- #
     def save_states(self, fname):

@@ -22,6 +22,19 @@ def _cross_process_sum(x):
     gathered = multihost_utils.process_allgather(x)
     return jnp.sum(gathered, axis=0)
 
+def _put_like(data, o):
+    """Cast + place ``data`` on the out array's device (the reference's
+    broadcast-back-to-each-ctx after a reduce)."""
+    data = jnp.asarray(data, o._data.dtype)
+    try:
+        tgt = list(o._data.devices())[0]
+        if list(data.devices())[0] != tgt:
+            data = jax.device_put(data, tgt)
+    except Exception:
+        pass
+    return data
+
+
 _KNOWN_TYPES = ("local", "device", "nccl", "tpu", "dist_sync", "dist_async",
                 "dist_device_sync", "dist")
 
@@ -98,9 +111,22 @@ class KVStore:
         elif len(value) == 1:
             acc = value[0]._data
         else:
+            # per-device values: gather to the first value's device, then
+            # one add chain (reference CommDevice reduce-at-root)
             acc = value[0]._data
+            try:
+                root = list(acc.devices())[0]
+            except Exception:
+                root = None
             for v in value[1:]:
-                acc = acc + v._data
+                rhs = v._data
+                if root is not None:
+                    try:
+                        if list(rhs.devices())[0] != root:
+                            rhs = jax.device_put(rhs, root)
+                    except Exception:
+                        pass
+                acc = acc + rhs
         if self._compression is not None and key is not None:
             acc = self._compression.compress(key, acc)
         if self._kind.startswith("dist") and self.num_workers > 1:
@@ -138,7 +164,7 @@ class KVStore:
         src = self._store[key]
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._rebind(jnp.asarray(src._data, o._data.dtype))
+            o._rebind(_put_like(src._data, o))
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (reference ``MXKVStorePushPull``).  With no
@@ -164,7 +190,7 @@ class KVStore:
             return
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._rebind(jnp.asarray(merged, o._data.dtype))
+            o._rebind(_put_like(merged, o))
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -1,3 +1,4 @@
-"""``mx.onnx`` — ONNX export (reference ``python/mxnet/onnx/`` mx2onnx;
-SURVEY.md §3.2 "ONNX" row)."""
+"""``mx.onnx`` — ONNX export + import (reference ``python/mxnet/onnx/``
+mx2onnx and ``contrib/onnx`` onnx2mx; SURVEY.md §3.2 "ONNX" row)."""
 from .mx2onnx import export_model, get_converter_registry
+from .onnx2mx import import_model

@@ -116,9 +116,11 @@ def linalg_maketrian(A, *, offset=0, lower=True):
     while True:
         if offset == 0:
             cnt = n * (n + 1) // 2
-        elif (offset > 0) != lower:
+        elif (offset > 0) == lower:
+            # triangle GROWS past the diagonal (tril k>0 / triu k<0)
             cnt = n * (n + 1) // 2 + k * n - k * (k + 1) // 2
         else:
+            # triangle shrinks: (n-k)(n-k+1)/2
             cnt = n * (n + 1) // 2 - k * n + k * (k - 1) // 2
         if cnt == m:
             break
@@ -126,10 +128,9 @@ def linalg_maketrian(A, *, offset=0, lower=True):
         if n > 10000:
             raise ValueError(f"cannot infer matrix size from {m} packed "
                              f"elements")
-    nn = n if offset <= 0 else n
-    rows, cols = jnp.tril_indices(nn, k=offset) if lower else \
-        jnp.triu_indices(nn, k=offset)
-    out = jnp.zeros(A.shape[:-1] + (nn, nn), A.dtype)
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
     return out.at[..., rows, cols].set(A)
 
 

@@ -16,7 +16,7 @@ from jax import lax
 from .registry import op
 
 __all__ = ["quantize_v2", "dequantize", "requantize",
-           "quantized_matmul_int8"]
+           "quantized_matmul_int8", "quantized_conv_int8"]
 
 
 @op("_contrib_quantize_v2", differentiable=False)
@@ -107,3 +107,21 @@ def optimal_threshold_kl(hist, hist_edges, num_quantized_bins=255):
     if not thresholds:
         return float(abs(hist_edges).max())
     return float(thresholds[int(onp.argmin(divergences))])
+
+
+@op("quantized_conv_int8", differentiable=False)
+def quantized_conv_int8(qx, qw, *, stride=(1, 1), pad=(0, 0),
+                        dilate=(1, 1), num_group=1):
+    """int8 NCHW convolution with int32 accumulation (reference
+    ``_contrib_quantized_conv`` — the oneDNN/cuDNN int8 conv; on TPU the
+    integer dot rides the MXU via ``preferred_element_type=int32``)."""
+    dn = lax.conv_dimension_numbers(qx.shape, qw.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(
+        qx.astype(jnp.int8), qw.astype(jnp.int8),
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)

@@ -264,15 +264,23 @@ class SPMDTrainer:
                     nm, ni = opt._update_rule(master, g32, inner, lr_i,
                                               wd_i, t)
                     new_vals.append(nm.astype(w.dtype))
-                    new_states.append((nm, ni))
+                    new_states.append((nm, jax.tree.map(
+                        lambda a, b: b.astype(a.dtype) if hasattr(
+                            a, "dtype") else b, inner, ni)))
                 else:
-                    g = g.astype(w.dtype) * rescale
+                    # CRITICAL dtype discipline: the traced f32 scalars
+                    # (rescale/lr) promote bf16 math to f32; without the
+                    # casts below one step() silently turns the whole
+                    # model f32 and the MXU runs at 1/2-1/4 rate
+                    g = (g * rescale).astype(w.dtype)
                     if opt.clip_gradient is not None:
                         g = jnp.clip(g, -opt.clip_gradient,
                                      opt.clip_gradient)
                     nw, ns = opt._update_rule(w, g, s, lr_i, wd_i, t)
-                    new_vals.append(nw)
-                    new_states.append(ns)
+                    new_vals.append(nw.astype(w.dtype))
+                    new_states.append(jax.tree.map(
+                        lambda a, b: b.astype(a.dtype) if hasattr(
+                            a, "dtype") else b, s, ns))
 
             # map aux updates back to frozen-param slots
             aux_by_id = {id(p): v for p, v in aux_pairs}

@@ -220,3 +220,20 @@ class TestOptimizerOps:
         h = mx.nd.zeros((3,))
         w3, h2 = mx.nd.adagrad_update(w, g, h, lr=0.1)
         assert float(h2.asnumpy()[0]) == 1.0
+
+
+class TestMaketrianOffsets:
+    """offset != 0 round-trips (review finding: inverted grow/shrink
+    selector)."""
+
+    @pytest.mark.parametrize("offset,lower", [(1, True), (-1, True),
+                                              (1, False), (-1, False)])
+    def test_roundtrip(self, offset, lower):
+        rng = onp.random.RandomState(0)
+        A = rng.rand(4, 4).astype(onp.float32)
+        tri = onp.tril(A, offset) if lower else onp.triu(A, offset)
+        packed = mx.nd.linalg_extracttrian(mx.nd.array(A), offset=offset,
+                                           lower=lower)
+        M = mx.nd.linalg_maketrian(packed, offset=offset, lower=lower)
+        assert M.shape == (4, 4)
+        onp.testing.assert_allclose(M.asnumpy(), tri, rtol=1e-6)

@@ -1,0 +1,119 @@
+"""Legacy multi-device data parallelism (VERDICT r1 item 8): Parameter
+per-ctx replicas + Trainer/kvstore grad reduction, on the 8-device virtual
+CPU mesh.  Mirrors the reference pattern: initialize(ctx=[...]) →
+split_and_load → per-ctx forward/backward → trainer.step."""
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.utils import split_and_load
+
+
+def _ctxs(n=4):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"need {n} devices, have {len(devs)}")
+    return [mx.Context("cpu", i) for i in range(n)]
+
+
+class TestParameterReplicas:
+    def test_replicas_created_per_ctx(self):
+        ctxs = _ctxs(4)
+        net = gluon.nn.Dense(8, in_units=4)
+        net.initialize(mx.init.Xavier(), ctx=ctxs)
+        w = net.weight
+        assert len(w.list_data()) == 4
+        assert [c.device_id for c in w.list_ctx()] == [0, 1, 2, 3]
+        # each replica actually lives on its own device
+        for i, arr in enumerate(w.list_data()):
+            assert list(arr._data.devices())[0].id == i
+        # replicas start identical
+        base = w.list_data()[0].asnumpy()
+        for arr in w.list_data()[1:]:
+            onp.testing.assert_array_equal(arr.asnumpy(), base)
+
+    def test_data_ctx_lookup_and_missing_ctx_error(self):
+        ctxs = _ctxs(2)
+        net = gluon.nn.Dense(3, in_units=2)
+        net.initialize(ctx=ctxs)
+        arr = net.weight.data(ctxs[1])
+        assert list(arr._data.devices())[0].id == 1
+        with pytest.raises(mx.MXNetError, match="not initialized on"):
+            net.weight.data(mx.Context("cpu", 7))
+
+    def test_forward_uses_input_device_replica(self):
+        ctxs = _ctxs(2)
+        net = gluon.nn.Dense(5, in_units=3)
+        net.initialize(ctx=ctxs)
+        x1 = mx.nd.array(onp.ones((2, 3), onp.float32)).as_in_context(ctxs[1])
+        out = net(x1)
+        assert list(out._data.devices())[0].id == 1
+
+
+class TestMultiDeviceTraining:
+    def _train(self, ctxs, kvstore, steps=3, hybridize=False):
+        mx.random.seed(0)
+        net = gluon.nn.Dense(1, in_units=4)
+        net.initialize(mx.init.Constant(0.1), ctx=ctxs)
+        if hybridize:
+            net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=kvstore)
+        rng = onp.random.RandomState(0)
+        X = rng.rand(16, 4).astype(onp.float32)  # fixed total batch
+        Y = (X.sum(1, keepdims=True) * 2).astype(onp.float32)
+        loss_fn = gluon.loss.L2Loss()
+        losses = []
+        for _ in range(steps):
+            xs = split_and_load(mx.nd.array(X), ctxs)
+            ys = split_and_load(mx.nd.array(Y), ctxs)
+            with autograd.record():
+                ls = [loss_fn(net(x), y) for x, y in zip(xs, ys)]
+            for l in ls:
+                l.backward()
+            trainer.step(X.shape[0])
+            losses.append(float(sum(l.asnumpy().mean() for l in ls)))
+        return net, losses
+
+    @pytest.mark.parametrize("kvstore", ["device", "local"])
+    def test_multi_ctx_training_converges(self, kvstore):
+        ctxs = _ctxs(4)
+        net, losses = self._train(ctxs, kvstore)
+        assert losses[-1] < losses[0], losses
+        # all replicas stay in sync after updates
+        reps = [a.asnumpy() for a in net.weight.list_data()]
+        for r in reps[1:]:
+            onp.testing.assert_allclose(r, reps[0], rtol=1e-6)
+
+    def test_multi_ctx_matches_single_ctx_math(self):
+        """N-device DP with summed grads / N-scaled step must equal the
+        same single-device batch run (the reference DP contract)."""
+        ctxs = _ctxs(2)
+        net_m, _ = self._train(ctxs, "device", steps=2)
+        net_s, _ = self._train([mx.Context("cpu", 0)], "device", steps=2)
+        onp.testing.assert_allclose(net_m.weight.data().asnumpy(),
+                                    net_s.weight.data().asnumpy(),
+                                    rtol=1e-5, atol=1e-6)
+
+    def test_hybridized_multi_ctx(self):
+        ctxs = _ctxs(2)
+        net, losses = self._train(ctxs, "device", hybridize=True)
+        assert losses[-1] < losses[0], losses
+
+    def test_gradients_actually_computed_per_device(self):
+        ctxs = _ctxs(2)
+        net = gluon.nn.Dense(2, in_units=3)
+        net.initialize(ctx=ctxs)
+        xs = split_and_load(mx.nd.array(onp.ones((4, 3), onp.float32)), ctxs)
+        with autograd.record():
+            outs = [net(x).sum() for x in xs]
+        for o in outs:
+            o.backward()
+        grads = net.weight.list_grad()
+        assert len(grads) == 2
+        for i, g in enumerate(grads):
+            assert list(g._data.devices())[0].id == i
+            assert onp.abs(g.asnumpy()).sum() > 0
