@@ -160,6 +160,54 @@ def main():
                                        {"dp": len(jax.devices())}))
     toks = rng.randint(0, V, (B, L))
     labs = rng.randint(0, V, (B, L))
+
+    # 6a. model-only ablation: loss fwd and fwd+bwd through the full BERT
+    # (no optimizer, no scan) — isolates where the step's non-matmul time
+    # lives
+    g_step = (g_ffn + g_attn + 2 * tokens * U * V / 1e9 +
+              2 * tokens * 4 * U * U * NL / 1e9) * 3
+    trainer._ensure_built(mx.nd.array(toks), mx.nd.array(labs))
+    tv = tuple(trainer._train_vals)
+    fv = list(trainer._frozen_vals)
+    d32 = jnp.asarray(toks)
+    l32 = jnp.asarray(labs)
+    key0 = jax.random.PRNGKey(0)
+
+    def loss_only(tv_q, d, l):
+        box = []
+        return trainer._forward_loss(key0, tv_q, fv, d, l, box)
+
+    @jax.jit
+    def fwd_rep(d, l):
+        def body(c, _):
+            return c + loss_only(tv, d, l), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=8)
+        return c
+
+    @jax.jit
+    def fwdbwd_rep(d, l):
+        # grads must feed the next iteration's params or XLA DCEs the
+        # whole backward — a 1e-12-lr SGD keeps it alive at ~zero cost
+        def body(c_tv, _):
+            lv, gr = jax.value_and_grad(
+                lambda t: loss_only(t, d, l))(c_tv)
+            new_tv = tuple(v - g.astype(v.dtype) * 1e-12
+                           for v, g in zip(c_tv, gr))
+            return new_tv, lv
+        tv_out, losses = jax.lax.scan(body, tv, None, length=8)
+        return losses[-1] + jnp.sum(tv_out[0].astype(jnp.float32)) * 0 + \
+            sum(jnp.sum(t.astype(jnp.float32)) for t in tv_out) * 1e-12
+
+    for nm, f, mult in (("model_fwd_only", fwd_rep, 1),
+                        ("model_fwd+bwd_sgd1e-12", fwdbwd_rep, 3)):
+        float(f(d32, l32))
+        ts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(f(d32, l32))
+            ts.append(time.perf_counter() - t0)
+        emit(nm, min(ts) / 8 * 1e3, g_step / 3 * mult)
+
     n_steps = 20
     sd = mx.nd.array(onp.broadcast_to(toks, (n_steps,) + toks.shape))
     sl = mx.nd.array(onp.broadcast_to(labs, (n_steps,) + labs.shape))
@@ -171,8 +219,6 @@ def main():
               .reshape(-1)[-1])
         dt = (time.perf_counter() - t0) / n_steps
         best = dt if best is None else min(best, dt)
-    g_step = (g_ffn + g_attn + 2 * tokens * U * V / 1e9 +
-              2 * tokens * 4 * U * U * NL / 1e9) * 3
     emit("full_train_step", best * 1e3, g_step)
     print(json.dumps({"bench": "step_breakdown",
                       "component": "throughput",

@@ -37,24 +37,34 @@ def main(argv=None):
 
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
-    from mxnet_tpu.gluon.data import DataLoader
-    from mxnet_tpu.gluon.data.vision import CIFAR10, transforms as T
+    from mxnet_tpu.gluon.data.vision import CIFAR10
     from mxnet_tpu.gluon.model_zoo.vision import get_resnet
 
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
-    transform = T.Compose([T.ToTensor(),
-                           T.Normalize([0.4914, 0.4822, 0.4465],
-                                       [0.2470, 0.2435, 0.2616])])
+    # per-BATCH device-side normalization: per-sample nd transforms would
+    # dispatch one device op per image (disastrous through a TPU tunnel;
+    # the reference normalizes on the CPU side of the pipeline)
+    mean = mx.nd.array(onp.array([0.4914, 0.4822, 0.4465],
+                                 onp.float32).reshape(1, 3, 1, 1))
+    std = mx.nd.array(onp.array([0.2470, 0.2435, 0.2616],
+                                onp.float32).reshape(1, 3, 1, 1))
+
+    mean = mean.as_in_context(ctx)
+    std = std.as_in_context(ctx)
+
+    def prep(x):
+        # x: uint8 NHWC batch -> normalized float NCHW on device
+        x = x.astype("float32").as_in_context(ctx)
+        x = x.transpose((0, 3, 1, 2)) / 255.0
+        return (x - mean) / std
+
     try:
         train = CIFAR10(root=args.data_dir, train=True,
                         synthetic=args.synthetic)
     except Exception:
         print("CIFAR-10 not found; falling back to synthetic data")
         train = CIFAR10(train=True, synthetic=args.synthetic or 512)
-    loader = DataLoader(train.transform_first(transform),
-                        batch_size=args.batch_size, shuffle=True,
-                        num_workers=2, last_batch="discard")
-    val_loader = None
+    test = None
     if args.eval:
         try:
             test = CIFAR10(root=args.data_dir, train=False,
@@ -62,9 +72,21 @@ def main(argv=None):
                            max(1000, args.synthetic // 5))
         except Exception:
             test = CIFAR10(train=False, synthetic=1000)
-        val_loader = DataLoader(test.transform_first(transform),
-                                batch_size=args.batch_size, shuffle=False,
-                                num_workers=2)
+
+    # numpy-level batching: ONE host->device transfer per batch (a
+    # per-sample DataLoader would pay one transfer per image — ruinous
+    # over a remote TPU tunnel)
+    def batches(ds, bs, shuffle, rng, drop_last=True):
+        data, labels = ds._data, ds._label
+        order = rng.permutation(len(labels)) if shuffle else \
+            onp.arange(len(labels))
+        stop = len(order) - bs + 1 if drop_last else len(order)
+        for lo in range(0, max(stop, 0 if drop_last else 1), bs):
+            idx = order[lo:lo + bs]
+            if len(idx) == 0:
+                return
+            yield mx.nd.array(data[idx]), mx.nd.array(
+                labels[idx].astype(onp.float32))
 
     net = get_resnet(1, 18, thumbnail=True, classes=10)
     net.initialize(mx.init.Xavier(), ctx=ctx)
@@ -83,9 +105,10 @@ def main(argv=None):
         metric.reset()
         tic = time.time()
         n = 0
-        for x, y in loader:
-            x = x.as_in_context(ctx)
-            y = y.astype("float32").as_in_context(ctx)
+        rng = onp.random.RandomState(epoch)
+        for x, y in batches(train, args.batch_size, True, rng):
+            x = prep(x)
+            y = y.as_in_context(ctx)
             with autograd.record():
                 out = net(x)
                 loss = loss_fn(out, y)
@@ -96,11 +119,13 @@ def main(argv=None):
         name, acc = metric.get()
         dt = time.time() - tic
         line = f"epoch {epoch}: {name}={acc:.4f} ({n / dt:.0f} samples/s)"
-        if val_loader is not None:
+        if test is not None:
             vmetric = mx.metric.Accuracy()
-            for x, y in val_loader:
-                x = x.as_in_context(ctx)
-                y = y.astype("float32").as_in_context(ctx)
+            for x, y in batches(test, args.batch_size, False,
+                                onp.random.RandomState(0),
+                                drop_last=False):
+                x = prep(x)
+                y = y.as_in_context(ctx)
                 vmetric.update(y, net(x))
             line += f" val-acc={vmetric.get()[1]:.4f}"
         print(line, flush=True)

@@ -157,8 +157,7 @@ class QuantizedConv2D(HybridBlock):
 def _walk_replace(block, collector, exclude):
     for name, child in list(block._children.items()):
         path = child.name
-        quantizable = isinstance(child, (nn.Dense, nn.Conv2D)) and \
-            not isinstance(child, nn.Conv2DTranspose)
+        quantizable = isinstance(child, (nn.Dense, nn.Conv2D))
         if quantizable and path not in exclude \
                 and path in collector.stats:
             if isinstance(child, nn.Dense):
@@ -200,9 +199,7 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             block._active = False
             block._cached_op = None
         for child in block._children.values():
-            if isinstance(child, nn.Dense) or (
-                    isinstance(child, nn.Conv2D)
-                    and not isinstance(child, nn.Conv2DTranspose)):
+            if isinstance(child, (nn.Dense, nn.Conv2D)):
                 hooks.append(child.register_forward_pre_hook(
                     collector.hook(child.name)))
             attach(child)

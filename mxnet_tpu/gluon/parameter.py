@@ -302,6 +302,7 @@ class Parameter:
             self._set_data_arr(NDArray(data, c))
         else:
             self._data._rebind(jnp.asarray(data, self._data._data.dtype))
+            self._sync_replicas()
 
     def zero_grad(self):
         if self._replicas is not None:
@@ -320,11 +321,13 @@ class Parameter:
 
     def cast(self, dtype):
         self.dtype = dtype
-        if self._data is not None:
-            had_grad = self._data._grad is not None
-            self._data._rebind(self._data._data.astype(jnp.dtype(dtype)))
+        arrs = list(self._replicas.values()) if self._replicas is not None \
+            else ([self._data] if self._data is not None else [])
+        for arr in arrs:
+            had_grad = arr._grad is not None
+            arr._rebind(arr._data.astype(jnp.dtype(dtype)))
             if had_grad:
-                self._data.attach_grad(self._grad_req)
+                arr.attach_grad(self._grad_req)
 
     # -- sharding (TPU-native extension) -------------------------------- #
     def set_sharding(self, sharding):

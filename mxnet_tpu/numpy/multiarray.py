@@ -1016,6 +1016,44 @@ def resize(a, new_shape):
     return ndarray(jnp.resize(arr._data, new_shape))
 
 
+def bitwise_not(a):
+    return _run("bitwise_not", jnp.bitwise_not, [a])
+
+
+invert = bitwise_not
+
+
+def polyval(p, x):
+    return _run("polyval", jnp.polyval, [p, x])
+
+
+def blackman(M, dtype=None):
+    return ndarray(jnp.blackman(M).astype(jnp.dtype(dtype or "float32")))
+
+
+def hamming(M, dtype=None):
+    return ndarray(jnp.hamming(M).astype(jnp.dtype(dtype or "float32")))
+
+
+def hanning(M, dtype=None):
+    return ndarray(jnp.hanning(M).astype(jnp.dtype(dtype or "float32")))
+
+
+def diag_indices_from(arr):
+    a = _coerce_arr(arr)
+    r, c = jnp.diag_indices_from(a._data)
+    return ndarray(r), ndarray(c)
+
+
+def share_memory(a, b):
+    # jax arrays are immutable buffers; views never alias mutably
+    return False
+
+
+def may_share_memory(a, b):
+    return False
+
+
 # everything public defined in this module (functions, constants, dtypes)
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_")

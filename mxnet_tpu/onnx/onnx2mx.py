@@ -42,14 +42,31 @@ def _imp_gemm(sym, ins, attrs, consts, name):
     if w_shape is None:
         raise MXNetError(f"onnx import: Gemm {name} needs a weight "
                          "initializer to size num_hidden")
-    if not attrs.get("transB", 0):
-        raise MXNetError("onnx import: only transB=1 Gemm supported "
-                         "(the exporter's FullyConnected form)")
-    return sym.FullyConnected(ins[0], ins[1],
-                              ins[2] if len(ins) > 2 else None,
-                              num_hidden=int(w_shape[0]),
-                              no_bias=len(ins) <= 2, flatten=False,
-                              name=name)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    a = sym.transpose(ins[0], name=f"{name}_tA") \
+        if attrs.get("transA", 0) else ins[0]
+    if attrs.get("transB", 0) and alpha == 1.0 and beta == 1.0:
+        return sym.FullyConnected(a, ins[1],
+                                  ins[2] if len(ins) > 2 else None,
+                                  num_hidden=int(w_shape[0]),
+                                  no_bias=len(ins) <= 2, flatten=False,
+                                  name=name)
+    # general form: alpha * A @ op(B) + beta * C
+    b = sym.transpose(ins[1], name=f"{name}_tB") \
+        if attrs.get("transB", 0) else ins[1]
+    out = sym.matmul(a, b, name=f"{name}_mm")
+    if alpha != 1.0:
+        out = out * alpha
+    if len(ins) > 2:
+        c = ins[2] if beta == 1.0 else ins[2] * beta
+        out = sym.broadcast_add(out, c, name=name)
+    return out
+
+
+def _no_w(name):
+    raise MXNetError(f"onnx import: Conv {name} needs a weight "
+                     "initializer to size num_filter")
 
 
 def _sym_pads(pads, k, name):
@@ -74,7 +91,7 @@ def _imp_conv(sym, ins, attrs, consts, name):
         stride=tuple(attrs.get("strides", (1,) * len(kernel))),
         dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
         pad=_sym_pads(pads, len(kernel), name),
-        num_filter=int(w_shape[0]) if w_shape is not None else 0,
+        num_filter=int(w_shape[0]) if w_shape is not None else _no_w(name),
         num_group=int(attrs.get("group", 1)),
         no_bias=len(ins) <= 2, name=name)
 
@@ -215,9 +232,11 @@ def _imp_reduce_sum(sym, ins, attrs, consts, name):
 
 @register_importer("ReduceMean")
 def _imp_reduce_mean(sym, ins, attrs, consts, name):
+    # axes: attr (≤ opset 17) or second constant input (opset 18+)
+    axes = consts.get(ins[1].name) if len(ins) > 1 else attrs.get("axes")
     kw = {"keepdims": bool(attrs.get("keepdims", 1))}
-    if attrs.get("axes") is not None:
-        kw["axis"] = tuple(int(a) for a in attrs["axes"])
+    if axes is not None:
+        kw["axis"] = tuple(int(a) for a in onp.asarray(axes).reshape(-1))
     return sym.mean(ins[0], name=name, **kw)
 
 

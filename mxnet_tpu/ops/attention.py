@@ -128,14 +128,14 @@ def _blockwise_attn(q, k, v, bias, seed, scale, causal, dropout, q_block):
             (bias.shape[0], bias.shape[1], Lq, Lk))
         bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_q), (0, 0))) \
             if pad_q else bias
-    k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
     kpos = lax.broadcasted_iota(jnp.int32, (1, Lk), 1)
     bh = (lax.broadcasted_iota(jnp.int32, (B, H), 0) * H +
           lax.broadcasted_iota(jnp.int32, (B, H), 1))[..., None, None]
 
     def one_block(i, qb):
-        s = jnp.einsum("bhqd,bhkd->bhqk", qb.astype(jnp.float32), k32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qb, k,
+                       preferred_element_type=jnp.float32)
         s = s * scale
         if bias is not None:
             s = s + lax.dynamic_slice_in_dim(bias, i * q_block, q_block,
@@ -758,8 +758,10 @@ _PLAIN_ATTN_MAX_SCORES = 512 * 512
 
 def _plain_attn(q, k, v, bias, scale, causal, dropout=0.0, seed=None):
     B, H = q.shape[0], q.shape[1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    # bf16 inputs stay bf16 into the MXU; accumulation is f32 via
+    # preferred_element_type (an f32 upcast first would halve MXU rate)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     Lq, Lk = q.shape[2], k.shape[2]

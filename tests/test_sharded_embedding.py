@@ -1,0 +1,117 @@
+"""Sharded embedding tables at scale (SURVEY.md §3.3 "Sparse / large
+embedding DP" row; VERDICT r1 missing item 5): the reference's
+``row_sparse`` embedding + ``row_sparse_pull(row_ids)`` maps to a
+GSPMD row-sharded dense table + gather — demonstrated here on the
+8-device mesh with training parity against the replicated run."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.parallel import P, ShardingRules
+
+
+VOCAB, DIM = 64 * 1024, 32
+
+
+class _EmbedNet(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(VOCAB, DIM)
+            self.head = gluon.nn.Dense(4, flatten=False, in_units=DIM)
+
+    def hybrid_forward(self, F, x):
+        return self.head(self.embed(x))
+
+
+def _mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    return parallel.make_mesh({"dp": 1, "tp": 8})
+
+
+def _rules():
+    return ShardingRules([(r".*embedding\d*_weight", P("tp", None))])
+
+
+class TestShardedEmbedding:
+    def test_table_is_row_sharded_across_devices(self):
+        mesh = _mesh()
+        mx.random.seed(0)
+        net = _EmbedNet()
+        net.initialize(mx.init.Normal(0.02))
+        parallel.shard_block(net, mesh, _rules())
+        w = net.embed.weight._data._data
+        shards = w.addressable_shards
+        assert len(shards) == 8
+        # each device holds 1/8 of the rows — the EP memory win
+        assert shards[0].data.shape == (VOCAB // 8, DIM)
+        ids = {s.device.id for s in shards}
+        assert len(ids) == 8
+
+    def test_training_parity_with_replicated(self):
+        mesh = _mesh()
+        rng = onp.random.RandomState(0)
+        toks = rng.randint(0, VOCAB, (4, 8, 16))
+        labs = rng.randint(0, 4, (4, 8, 16)).astype(onp.float32)
+
+        def run(rules):
+            mx.random.seed(0)
+            net = _EmbedNet()
+            net.initialize(mx.init.Normal(0.02))
+            tr = parallel.SPMDTrainer(
+                net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                {"learning_rate": 0.5}, mesh=mesh, rules=rules)
+            losses = tr.run_steps(mx.nd.array(toks), mx.nd.array(labs))
+            return (onp.asarray(losses.asnumpy()),
+                    net.embed.weight.data().asnumpy())
+
+        l_sharded, w_sharded = run(_rules())
+        l_repl, w_repl = run(None)
+        onp.testing.assert_allclose(l_sharded, l_repl, rtol=1e-5,
+                                    atol=1e-6)
+        onp.testing.assert_allclose(w_sharded, w_repl, rtol=1e-4,
+                                    atol=1e-6)
+        # training touched only the gathered rows (sparse-update reality)
+        touched = onp.unique(toks)
+        untouched = onp.setdiff1d(onp.arange(512), touched)[:16]
+        mx.random.seed(0)
+        ref = _EmbedNet()
+        ref.initialize(mx.init.Normal(0.02))
+        w0 = ref.embed.weight.data().asnumpy()
+        onp.testing.assert_allclose(w_sharded[untouched], w0[untouched],
+                                    rtol=1e-6)
+
+    def test_row_pull_gather_on_sharded_table(self):
+        """row_sparse_pull(row_ids) analog: gather specific rows from the
+        sharded table without materializing it."""
+        mesh = _mesh()
+        mx.random.seed(0)
+        net = _EmbedNet()
+        net.initialize(mx.init.Normal(0.02))
+        parallel.shard_block(net, mesh, _rules())
+        full = net.embed.weight.data().asnumpy()
+        row_ids = onp.array([0, 13, 8191, VOCAB - 1])
+        rows = mx.nd.take(net.embed.weight.data(),
+                          mx.nd.array(row_ids.astype(onp.int32)))
+        onp.testing.assert_allclose(rows.asnumpy(), full[row_ids],
+                                    rtol=1e-6)
+
+    def test_kvstore_row_sparse_pull_api(self):
+        """The legacy kvstore row_sparse_pull surface works against the
+        same table semantics (reference PullRowSparse)."""
+        kv = mx.kv.create("device")
+        table = mx.nd.array(onp.random.RandomState(0)
+                            .rand(64, 4).astype(onp.float32))
+        kv.init("emb", table)
+        out = mx.nd.zeros((64, 4))
+        kv.row_sparse_pull("emb", out=out,
+                           row_ids=mx.nd.array(onp.array([3, 9])))
+        got = out.asnumpy()
+        onp.testing.assert_allclose(got[3], table.asnumpy()[3], rtol=1e-6)
+        assert (got[4] == 0).all()  # un-pulled rows stay zero
