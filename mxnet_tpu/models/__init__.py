@@ -10,6 +10,7 @@ matmul, big batched GEMMs).  Vision models live in
 """
 from .transformer import (MultiHeadAttention, PositionwiseFFN,
                           TransformerEncoderCell, TransformerDecoderCell)
+from .decoding import kv_generate
 from .gpt import GPT, GPTConfig, gpt2_small, gpt2_medium, gpt2_large, \
     gpt2_774m, gpt_tp_rules
 from .bert import BERTModel, BERTConfig, bert_base, bert_large

@@ -1041,8 +1041,7 @@ def hanning(M, dtype=None):
 
 def diag_indices_from(arr):
     a = _coerce_arr(arr)
-    r, c = jnp.diag_indices_from(a._data)
-    return ndarray(r), ndarray(c)
+    return tuple(ndarray(ix) for ix in jnp.diag_indices_from(a._data))
 
 
 def share_memory(a, b):

@@ -39,14 +39,13 @@ def register_importer(op_type):
 @register_importer("Gemm")
 def _imp_gemm(sym, ins, attrs, consts, name):
     w_shape = consts.get("__shape__", {}).get(ins[1].name)
-    if w_shape is None:
-        raise MXNetError(f"onnx import: Gemm {name} needs a weight "
-                         "initializer to size num_hidden")
     alpha = float(attrs.get("alpha", 1.0))
     beta = float(attrs.get("beta", 1.0))
     a = sym.transpose(ins[0], name=f"{name}_tA") \
         if attrs.get("transA", 0) else ins[0]
-    if attrs.get("transB", 0) and alpha == 1.0 and beta == 1.0:
+    if attrs.get("transB", 0) and alpha == 1.0 and beta == 1.0 \
+            and w_shape is not None:
+        # FullyConnected fast path (needs the weight initializer's shape)
         return sym.FullyConnected(a, ins[1],
                                   ins[2] if len(ins) > 2 else None,
                                   num_hidden=int(w_shape[0]),

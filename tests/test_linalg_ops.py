@@ -237,3 +237,82 @@ class TestMaketrianOffsets:
         M = mx.nd.linalg_maketrian(packed, offset=offset, lower=lower)
         assert M.shape == (4, 4)
         onp.testing.assert_allclose(M.asnumpy(), tri, rtol=1e-6)
+
+
+class TestOptimizerOpsGolden:
+    """Golden formulas for the round-2 update ops not covered above."""
+
+    def test_nag_mom(self):
+        w0 = onp.array([1.0, 2.0], onp.float32)
+        g0 = onp.array([0.5, -0.5], onp.float32)
+        w, mom = mx.nd.nag_mom_update(mx.nd.array(w0), mx.nd.array(g0),
+                                      mx.nd.zeros((2,)), lr=0.1,
+                                      momentum=0.9)
+        mom_ref = g0
+        w_ref = w0 - 0.1 * (g0 + 0.9 * mom_ref)
+        onp.testing.assert_allclose(mom.asnumpy(), mom_ref, rtol=1e-6)
+        onp.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-6)
+
+    def test_signsgd_and_signum(self):
+        w0 = onp.array([1.0, 1.0], onp.float32)
+        g0 = onp.array([0.3, -0.7], onp.float32)
+        w = mx.nd.signsgd_update(mx.nd.array(w0), mx.nd.array(g0), lr=0.1)
+        onp.testing.assert_allclose(w.asnumpy(),
+                                    w0 - 0.1 * onp.sign(g0), rtol=1e-6)
+        w2, m2 = mx.nd.signum_update(mx.nd.array(w0), mx.nd.array(g0),
+                                     mx.nd.zeros((2,)), lr=0.1,
+                                     momentum=0.9)
+        m_ref = -(1 - 0.9) * g0
+        onp.testing.assert_allclose(m2.asnumpy(), m_ref, rtol=1e-6)
+        onp.testing.assert_allclose(w2.asnumpy(),
+                                    w0 + 0.1 * onp.sign(m_ref), rtol=1e-6)
+
+    def test_adadelta(self):
+        w0 = onp.array([1.0], onp.float32)
+        g0 = onp.array([0.5], onp.float32)
+        w, ag, ad = mx.nd.adadelta_update(
+            mx.nd.array(w0), mx.nd.array(g0), mx.nd.zeros((1,)),
+            mx.nd.zeros((1,)), rho=0.9, epsilon=1e-5)
+        ag_ref = 0.1 * g0 * g0
+        delta = onp.sqrt(1e-5) / onp.sqrt(ag_ref + 1e-5) * g0
+        onp.testing.assert_allclose(ag.asnumpy(), ag_ref, rtol=1e-5)
+        onp.testing.assert_allclose(w.asnumpy(), w0 - delta, rtol=1e-5)
+        onp.testing.assert_allclose(ad.asnumpy(), 0.1 * delta * delta,
+                                    rtol=1e-5)
+
+    def test_rmspropalex_centered(self):
+        w0 = onp.array([1.0], onp.float32)
+        g0 = onp.array([0.5], onp.float32)
+        w, n, gs, d = mx.nd.rmspropalex_update(
+            mx.nd.array(w0), mx.nd.array(g0), mx.nd.zeros((1,)),
+            mx.nd.zeros((1,)), mx.nd.zeros((1,)), lr=0.1, gamma1=0.95,
+            gamma2=0.9, epsilon=1e-8)
+        n_ref = 0.05 * g0 * g0
+        g_ref = 0.05 * g0
+        d_ref = -0.1 * g0 / onp.sqrt(n_ref - g_ref * g_ref + 1e-8)
+        onp.testing.assert_allclose(d.asnumpy(), d_ref, rtol=1e-5)
+        onp.testing.assert_allclose(w.asnumpy(), w0 + d_ref, rtol=1e-5)
+
+    def test_ftrl_sparse_zeroing(self):
+        """FTRL zeroes weights whose |z| <= lamda1 (the L1 sparsity)."""
+        w0 = onp.array([1.0, 1.0], onp.float32)
+        g0 = onp.array([1e-4, 5.0], onp.float32)
+        w, z, n = mx.nd.ftrl_update(
+            mx.nd.array(w0), mx.nd.array(g0), mx.nd.zeros((2,)),
+            mx.nd.zeros((2,)), lr=0.1, lamda1=0.01)
+        out = w.asnumpy()
+        assert abs(out[0]) < 1e-6       # tiny |z| -> zeroed
+        assert abs(out[1]) > 0.0        # large grad -> survives
+
+    def test_ftml(self):
+        w0 = onp.array([1.0], onp.float32)
+        g0 = onp.array([0.5], onp.float32)
+        w, d, v, z = mx.nd.ftml_update(
+            mx.nd.array(w0), mx.nd.array(g0), mx.nd.zeros((1,)),
+            mx.nd.zeros((1,)), mx.nd.zeros((1,)), lr=0.1, beta1=0.6,
+            beta2=0.999, epsilon=1e-8, t=1)
+        v_ref = 0.001 * g0 * g0
+        d_ref = (1 - 0.6) / 0.1 * (onp.sqrt(v_ref / (1 - 0.999)) + 1e-8)
+        sigma = d_ref
+        z_ref = (1 - 0.6) * g0 - sigma * w0
+        onp.testing.assert_allclose(w.asnumpy(), -z_ref / d_ref, rtol=1e-4)
