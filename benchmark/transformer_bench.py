@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""BASELINE configs 4 + 5 throughput on one chip.
+
+- config 4: Transformer-big (WMT14-geometry seq2seq: 1024 units, 4096 FF,
+  16 heads, 6+6 layers) training tokens/sec/chip.
+- config 5: GPT-2-medium (345M) single-chip train MFU (the TP×DP sharding
+  itself is validated by ``__graft_entry__.dryrun_multichip`` on the
+  virtual mesh; a pod is needed for real multi-chip rates).
+
+Prints one JSON line per config.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+PEAK_TFLOPS = 197.0
+
+
+def _bench_steps(trainer, mx, data, label, n_steps, reps=3):
+    sd = mx.nd.array(onp.broadcast_to(data, (n_steps,) + data.shape))
+    sl = mx.nd.array(onp.broadcast_to(label, (n_steps,) + label.shape))
+    float(onp.asarray(trainer.run_steps(sd, sl).asnumpy()).reshape(-1)[0])
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(onp.asarray(trainer.run_steps(sd, sl).asnumpy())
+              .reshape(-1)[-1])
+        dt = (time.perf_counter() - t0) / n_steps
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    dt_str = "bfloat16" if on_tpu else "float32"
+    mesh = parallel.make_mesh({"dp": len(jax.devices())})
+    rng = onp.random.RandomState(0)
+
+    # ---- config 4: Transformer-big seq2seq --------------------------- #
+    from mxnet_tpu.models import TransformerSeq2Seq as Transformer
+
+    V, L = (32768, 64) if on_tpu else (512, 16)
+    B = 16 if on_tpu else 2
+    mx.random.seed(0)
+    net = Transformer(V, units=1024 if on_tpu else 64,
+                      hidden_size=4096 if on_tpu else 128,
+                      num_heads=16 if on_tpu else 4,
+                      num_enc_layers=6 if on_tpu else 2,
+                      num_dec_layers=6 if on_tpu else 2,
+                      max_length=L, dropout=0.0, dtype=dt_str)
+    net.initialize(mx.init.Xavier())
+
+    class _Wrap(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.net = net
+
+        def forward(self, both):
+            src = both[:, 0]
+            tgt_in = both[:, 1]
+            return self.net(src, tgt_in)
+
+    wrap = _Wrap()
+    src = rng.randint(0, V, (B, L))
+    tgt = rng.randint(0, V, (B, L))
+    both = onp.stack([src, tgt], axis=1)               # (B, 2, L)
+    trainer = parallel.SPMDTrainer(
+        wrap, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-4}, mesh=mesh)
+    best = _bench_steps(trainer, mx, both, tgt, 8 if on_tpu else 2)
+    toks = B * L  # target tokens per step
+    # Transformer-big ≈ 213M params excl. embeddings; ~6*N flops/token
+    tok_s = toks / best
+    print(json.dumps({
+        "bench": "transformer_big_wmt14", "tokens_per_sec_per_chip":
+        round(tok_s / max(1, len(jax.devices())), 1),
+        "step_ms": round(best * 1e3, 2), "batch": B, "seq": L,
+        "platform": platform,
+        "mfu_pct": round(100 * tok_s * 6 * 213e6 / 1e12 / PEAK_TFLOPS, 1)
+        if on_tpu else None}))
+    sys.stdout.flush()
+
+    # ---- config 5: GPT-2-medium single-chip MFU ---------------------- #
+    from mxnet_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=50304, max_length=512, num_layers=24,
+                    units=1024, num_heads=16, hidden_size=4096,
+                    dtype=dt_str) if on_tpu else \
+        GPTConfig(vocab_size=512, max_length=64, num_layers=2, units=64,
+                  num_heads=4, hidden_size=128)
+    mx.random.seed(0)
+    gpt = GPT(cfg)
+    gpt.initialize(mx.init.Normal(0.02))
+    B2, L2 = (8, 512) if on_tpu else (2, 16)
+    toks2 = rng.randint(0, cfg.vocab_size, (B2, L2 + 1))
+    trainer2 = parallel.SPMDTrainer(
+        gpt, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-4}, mesh=mesh)
+    best2 = _bench_steps(trainer2, mx, toks2[:, :-1], toks2[:, 1:],
+                         4 if on_tpu else 2)
+    n_tok = B2 * L2
+    flops_per_tok = 6 * cfg.num_params
+    tok_s2 = n_tok / best2
+    print(json.dumps({
+        "bench": "gpt2_medium_train", "tokens_per_sec_per_chip":
+        round(tok_s2 / max(1, len(jax.devices())), 1),
+        "step_ms": round(best2 * 1e3, 2), "batch": B2, "seq": L2,
+        "params_m": round(cfg.num_params / 1e6, 1), "platform": platform,
+        "mfu_pct": round(100 * tok_s2 * flops_per_tok / 1e12 /
+                         PEAK_TFLOPS, 1) if on_tpu else None}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
