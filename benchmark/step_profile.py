@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Per-XLA-op profile of a full fused train step (ResNet-50 or BERT).
+
+Uses ``mxnet_tpu.profiler_xla`` (the trace-parsing device profiler,
+SURVEY.md §5.1 parity) to attribute every microsecond of the compiled
+SPMD step to an HLO op / source jaxpr op — the tool the reference gets
+from engine hooks, recovered here from the ``jax.profiler`` device trace.
+
+  python benchmark/step_profile.py resnet  [--bs 256] [--by tf_op]
+  python benchmark/step_profile.py bert    [--bs 64]  [--by category]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+PEAK_TFLOPS = 197.0
+
+
+def build_resnet(bs):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    hw = 224 if on_tpu else 32
+    mx.random.seed(0)
+    net = get_resnet(1, 50, classes=1000)
+    net.initialize(mx.init.Xavier())
+    if on_tpu:
+        net.cast("bfloat16")
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        mesh=parallel.make_mesh({"dp": len(jax.devices())}))
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.rand(bs, 3, hw, hw).astype(
+        "bfloat16" if on_tpu else "float32"))
+    y = mx.nd.array(rng.randint(0, 1000, bs).astype(onp.float32))
+    return trainer, x, y
+
+
+def build_bert(bs):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models import BERTConfig, BERTModel
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seq = 128
+    mx.random.seed(0)
+    cfg = BERTConfig(vocab_size=30528, max_length=seq, num_layers=12,
+                     units=768, num_heads=12, hidden_size=3072,
+                     dtype="bfloat16" if on_tpu else "float32")
+    bert = BERTModel(cfg, use_pooler=False, use_mlm=True)
+
+    class _MLMHeadOnly(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.bert = bert
+
+        def forward(self, tokens):
+            return self.bert(tokens)[-1]
+
+    net = _MLMHeadOnly()
+    net.initialize(mx.init.Normal(0.02))
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-4},
+        mesh=parallel.make_mesh({"dp": len(jax.devices())}))
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randint(0, cfg.vocab_size, (bs, seq)))
+    y = mx.nd.array(rng.randint(0, cfg.vocab_size, (bs, seq)))
+    return trainer, x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", choices=["resnet", "bert"])
+    ap.add_argument("--bs", type=int, default=0)
+    ap.add_argument("--by", default="tf_op",
+                    choices=["tf_op", "name", "category", "source"])
+    ap.add_argument("--limit", type=int, default=40)
+    ap.add_argument("--iters", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    from mxnet_tpu import profiler_xla
+
+    bs = args.bs or (256 if args.model == "resnet" else 64)
+    trainer, x, y = (build_resnet if args.model == "resnet" else
+                     build_bert)(bs)
+
+    def run():
+        return trainer.step(x, y)
+
+    # compile + warmup
+    loss = run()
+    print("warmup loss:", float(onp.asarray(loss.asnumpy()).reshape(-1)[0]))
+    run()
+
+    import tempfile
+    td = tempfile.mkdtemp(prefix="mxtpu_step_prof_")
+    jax.profiler.start_trace(td)
+    out = None
+    for _ in range(args.iters):
+        out = run()
+    onp.asarray(out.asnumpy())  # readback sync through the tunnel
+    jax.profiler.stop_trace()
+
+    records = profiler_xla.parse_trace(td)
+    for r in records:
+        r["dur_us"] /= args.iters
+    rows = profiler_xla.aggregate(records, by=args.by)
+    tot_us = sum(r["dur_us"] for r in rows)
+    tot_fl = sum(r["flops"] for r in rows)
+    print(f"\ndevice step time: {tot_us / 1e3:.2f} ms   "
+          f"model TFLOP: {tot_fl / 1e12:.3f}   "
+          f"achieved {tot_fl / tot_us / 1e6:.1f} TFLOP/s "
+          f"({100 * tot_fl / tot_us / 1e6 / PEAK_TFLOPS:.1f}% MFU)\n")
+    print(profiler_xla.format_table(rows, peak_tflops=PEAK_TFLOPS,
+                                    limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,8 +25,8 @@ from collections import defaultdict
 import jax
 
 __all__ = ["set_config", "profiler_set_config", "start", "stop", "pause",
-           "resume", "dump", "dumps", "set_state", "state", "Task",
-           "Frame", "Counter", "Marker", "Scope", "TraceAnnotation"]
+           "resume", "dump", "dumps", "device_dumps", "set_state", "state",
+           "Task", "Frame", "Counter", "Marker", "Scope", "TraceAnnotation"]
 
 _lock = threading.Lock()
 _config = {
@@ -156,6 +156,28 @@ def dumps(reset=False):
     if reset and st:
         _state["op_stats"] = _OpStats()
     return s
+
+
+def device_dumps(by="tf_op", peak_tflops=None, limit=30):
+    """Per-XLA-op device-time table for the last ``start()``/``stop()``
+    window — the reference's per-op aggregate, recovered *inside* fused
+    jit steps by parsing the device trace (see ``profiler_xla``).
+
+    ``by``: "tf_op" (jaxpr-level provenance), "name" (HLO op),
+    "category" (convolution/fusion/copy/all-reduce...), or "source"."""
+    from . import profiler_xla
+    if by not in ("tf_op", "name", "category", "source"):
+        raise ValueError(f"by={by!r}: expected one of "
+                         "'tf_op', 'name', 'category', 'source'")
+    td = _state["trace_dir"]
+    if not td:
+        return ""
+    try:
+        rows = profiler_xla.aggregate(profiler_xla.parse_trace(td), by=by)
+    except Exception:
+        return ""  # missing/truncated/in-flight trace: best-effort dump
+    return profiler_xla.format_table(rows, peak_tflops=peak_tflops,
+                                     limit=limit)
 
 
 def set_state(state="stop", profile_process="worker"):
