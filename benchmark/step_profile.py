@@ -120,6 +120,8 @@ def main():
     records = profiler_xla.parse_trace(td)
     for r in records:
         r["dur_us"] /= args.iters
+        r["flops"] //= args.iters
+        r["bytes"] //= args.iters
     rows = profiler_xla.aggregate(records, by=args.by)
     tot_us = sum(r["dur_us"] for r in rows)
     tot_fl = sum(r["flops"] for r in rows)

@@ -1,37 +1,174 @@
-"""Sparse storage types (row_sparse / csr).
+"""Sparse storage types (row_sparse / csr) with real O(nnz) kernels.
 
 Reference surface: ``python/mxnet/ndarray/sparse.py`` + sparse kernels in
-``src/operator/tensor`` (SURVEY.md §3.1 NDArray storage types, §3.3 "Sparse
+``src/operator/tensor`` (SURVEY.md §3.1 NDArray storage types + "sparse
+kernels for row_sparse/csr (dot, elemwise, sparse_retain)", §3.3 "Sparse
 / large embedding DP").
 
-TPU-native stance: XLA is dense-only; ``row_sparse`` is represented as
-(indices, values) pairs materialized to dense on op boundaries, which keeps
-the API (``tostype``, ``row_sparse_array``, ``retain``) working while the
-performant path is sharded dense embedding tables + gather (see
-parallel/).  This mirrors SURVEY.md §7 Phase 5 "row_sparse emulation +
-documented descopes"."""
+TPU-native stance (r3 upgrade over the dense-emulation classes):
+
+- storage is **component-based**: a ``CSRNDArray`` holds device arrays
+  ``(data, indices, indptr, row_ids)``; a ``RowSparseNDArray`` holds
+  ``(data, indices)``.  The dense mirror is materialized **lazily**, only
+  when something outside the sparse API touches ``._data`` (XLA is
+  dense-only, so interop with the rest of the framework goes through the
+  mirror) — constructing a sparse array no longer allocates the dense
+  buffer.
+- the kernels compute **from the components** at O(nnz) cost:
+  ``dot(csr, dense)`` is a gather + ``segment_sum`` (one MXU-friendly
+  elementwise-times-gathered-rows followed by a segmented reduction —
+  the TPU-native answer to the reference's CPU/GPU csr kernels),
+  ``dot(row_sparse, dense)`` is a gathered matmul + scatter,
+  ``sparse_retain`` / ``retain`` are gathers over kept rows.
+- structure-changing ops (csr ± csr with different sparsity patterns)
+  union the pattern on host via scipy — documented host path; the values
+  math still runs on device arrays.
+
+Gradients: the dot kernels are registered ops, so the standard vjp-based
+tape (ops/registry.py) differentiates them; the backward of
+``dot(csr, x)`` w.r.t. ``x`` is itself an O(nnz) segment-sum.
+"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as onp
 
 from ..base import MXNetError
+from ..ops.registry import op
 from .ndarray import NDArray, array
 
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "tostype", "retain",
+           "sparse_retain", "zeros", "dot", "cast_storage", "add",
+           "subtract", "multiply"]
+
+
+# --------------------------------------------------------------------------- #
+# registered kernels (pure jax, O(nnz)) — differentiable via the tape
+# --------------------------------------------------------------------------- #
+
+@op("_sparse_segment_dot")
+def _segment_dot(data, gather_ids, segment_ids, rhs, *, num_segments):
+    """out[num_segments, N] = Σ_j data[j] · rhs[gather_ids[j], :] scattered
+    into row segment_ids[j] — the one kernel behind csr·dense and its
+    transpose (reference csr dot kernels, SURVEY.md §3.1 sparse rows)."""
+    vals = data[:, None] * rhs[gather_ids]
+    return jax.ops.segment_sum(vals, segment_ids,
+                               num_segments=num_segments)
+
+
+@op("_sparse_rowsparse_dot")
+def _rowsparse_dot(values, indices, rhs, *, num_rows):
+    """dot(row_sparse, dense): gathered matmul + scatter of result rows."""
+    out_rows = jnp.matmul(values, rhs)
+    out = jnp.zeros((num_rows, rhs.shape[1]), out_rows.dtype)
+    return out.at[indices].set(out_rows)
+
+
+@op("_sparse_rowsparse_dot_t")
+def _rowsparse_dot_t(values, indices, rhs, *, num_cols):
+    """dot(row_sparse, dense, transpose_a=True): lhsᵀ·rhs =
+    valuesᵀ · rhs[indices] — O(nnz_rows) gather, dense matmul."""
+    del num_cols
+    return jnp.matmul(values.T, rhs[indices])
+
+
+# --------------------------------------------------------------------------- #
+# shared host-side helpers (scipy has no bf16 — round-trip through f32,
+# values are cast back to the array's dtype by the callers)
+# --------------------------------------------------------------------------- #
+
+def _np_f32(x):
+    a = onp.asarray(x)
+    return a.astype(onp.float32) if a.dtype.name == "bfloat16" else a
+
+
+def _dense_to_scipy_csr(dense):
+    import scipy.sparse as sp
+    return sp.csr_matrix(_np_f32(dense))
+
+
+def _dense_to_rs(dense):
+    """(nonzero row indices, those rows) of a dense array."""
+    a = onp.asarray(dense)
+    nz = onp.where(onp.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+    return nz, a[nz]
+
+
+def _rowids_of(indptr):
+    ip = onp.asarray(indptr, onp.int64)
+    return jnp.asarray(onp.repeat(
+        onp.arange(len(ip) - 1, dtype=onp.int32), onp.diff(ip)))
+
+
+# --------------------------------------------------------------------------- #
+# NDArray subclasses with lazy dense mirrors
+# --------------------------------------------------------------------------- #
 
 class BaseSparseNDArray(NDArray):
-    pass
+    """Component storage + lazy dense mirror.  ``_data`` is a property:
+    reading it materializes (and caches) the dense array; writing it (e.g.
+    an in-place rebind from autograd) stores the dense value and marks the
+    components stale, after which component accessors re-derive from the
+    mirror."""
+
+    __slots__ = ("_sp_shape", "_sp_dtype", "_dense_cache", "_stale")
+
+    def _init_base(self, shape, dtype, ctx):
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._sp_dtype = jnp.dtype(dtype)
+        self._dense_cache = None
+        self._stale = False
+        super().__init__(None, ctx)
+
+    # -- lazy mirror ---------------------------------------------------- #
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+        if value is not None:
+            self._stale = True  # components no longer describe the value
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._sp_dtype)
+
+    def _to_dense(self):
+        raise NotImplementedError
+
+    def _refresh(self):
+        """Recompute components from the dense mirror after a rebind."""
+        raise NotImplementedError
+
+    def _components(self):
+        if self._stale:
+            self._refresh()
+            self._stale = False
+        return None
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """(indices, data) pair; dense shape known."""
+    """(indices, values) pair; dense shape known; values on device."""
+
+    __slots__ = ("_rs_data", "_rs_indices")
 
     def __init__(self, data, indices, shape, ctx=None):
-        dense = jnp.zeros(shape, data.dtype).at[
-            jnp.asarray(indices, jnp.int32)].set(jnp.asarray(data))
-        super().__init__(dense, ctx)
-        self._rs_data = jnp.asarray(data)
-        self._rs_indices = jnp.asarray(indices, jnp.int32)
+        data = jnp.asarray(data)
+        self._init_base(shape, data.dtype, ctx)
+        self._rs_data = data
+        self._rs_indices = jnp.asarray(indices, jnp.int64) \
+            if jnp.asarray(indices).dtype == jnp.int64 \
+            else jnp.asarray(indices, jnp.int32)
 
     @property
     def stype(self):
@@ -39,11 +176,22 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
+        self._components()
         return NDArray(self._rs_indices)
 
     @property
     def data(self):
+        self._components()
         return NDArray(self._rs_data)
+
+    def _to_dense(self):
+        return jnp.zeros(self._sp_shape, self._sp_dtype).at[
+            self._rs_indices].set(self._rs_data)
+
+    def _refresh(self):
+        nz, rows = _dense_to_rs(self._dense_cache)
+        self._rs_indices = jnp.asarray(nz, jnp.int32)
+        self._rs_data = jnp.asarray(rows).astype(self._sp_dtype)
 
     def tostype(self, stype):
         if stype == "default":
@@ -52,30 +200,82 @@ class RowSparseNDArray(BaseSparseNDArray):
             return self
         raise MXNetError(f"unsupported stype {stype}")
 
+    def copyto(self, other):
+        return NDArray(self._data).copyto(other)
+
 
 class CSRNDArray(BaseSparseNDArray):
+    """(data, indices, indptr) CSR triple; ``row_ids`` (the expanded row
+    index per nonzero) is precomputed once at construction so every dot is
+    a pure static-shape device kernel."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr", "_csr_rowids")
+
     def __init__(self, data, indptr, indices, shape, ctx=None):
-        dense = onp.zeros(shape, onp.asarray(data).dtype)
-        d, ip, ix = map(onp.asarray, (data, indptr, indices))
-        for r in range(shape[0]):
-            for j in range(ip[r], ip[r + 1]):
-                dense[r, ix[j]] = d[j]
-        super().__init__(jnp.asarray(dense), ctx)
+        data = jnp.asarray(data)
+        self._init_base(shape, data.dtype, ctx)
+        ip = onp.asarray(indptr, onp.int64)
+        self._csr_data = data
+        self._csr_indices = jnp.asarray(indices, jnp.int32)
+        self._csr_indptr = jnp.asarray(ip)
+        self._csr_rowids = _rowids_of(ip)
 
     @property
     def stype(self):
         return "csr"
 
+    @property
+    def data(self):
+        self._components()
+        return NDArray(self._csr_data)
+
+    @property
+    def indices(self):
+        self._components()
+        return NDArray(self._csr_indices)
+
+    @property
+    def indptr(self):
+        self._components()
+        return NDArray(self._csr_indptr)
+
+    def _to_dense(self):
+        m, _n = self._sp_shape
+        out = jnp.zeros(self._sp_shape, self._sp_dtype)
+        return out.at[self._csr_rowids, self._csr_indices].set(
+            self._csr_data)
+
+    def _refresh(self):
+        m = _dense_to_scipy_csr(self._dense_cache)
+        self._csr_data = jnp.asarray(m.data).astype(self._sp_dtype)
+        self._csr_indices = jnp.asarray(m.indices, jnp.int32)
+        self._csr_indptr = jnp.asarray(m.indptr, onp.int64)
+        self._csr_rowids = _rowids_of(m.indptr)
+
     def tostype(self, stype):
         if stype == "default":
             return NDArray(self._data, self._ctx)
-        return self
+        if stype == "csr":
+            return self
+        raise MXNetError(f"unsupported stype {stype}")
 
+    def _scipy(self):
+        import scipy.sparse as sp
+        self._components()
+        return sp.csr_matrix(
+            (_np_f32(self._csr_data), onp.asarray(self._csr_indices),
+             onp.asarray(self._csr_indptr)), shape=self._sp_shape)
+
+
+# --------------------------------------------------------------------------- #
+# constructors
+# --------------------------------------------------------------------------- #
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
-        return RowSparseNDArray(jnp.asarray(data, dtype), indices, shape, ctx)
+        return RowSparseNDArray(jnp.asarray(data, dtype), indices, shape,
+                                ctx)
     dense = array(arg1, ctx=ctx, dtype=dtype)
     return tostype(dense, "row_sparse")
 
@@ -83,62 +283,180 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
-        return CSRNDArray(jnp.asarray(data, dtype), indptr, indices, shape, ctx)
-    raise MXNetError("csr_matrix: pass (data, indices, indptr)")
+        return CSRNDArray(jnp.asarray(data, dtype), indptr, indices, shape,
+                          ctx)
+    if isinstance(arg1, NDArray) or hasattr(arg1, "__array__"):
+        dense = array(arg1, ctx=ctx, dtype=dtype)
+        return tostype(dense, "csr")
+    raise MXNetError("csr_matrix: pass (data, indices, indptr) or a dense "
+                     "array")
 
 
 def tostype(nd: NDArray, stype: str):
     if stype == "default":
         return NDArray(nd._data, nd._ctx)
     if stype == "row_sparse":
-        dense = onp.asarray(nd._data)
-        nz = onp.where(onp.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
-        return RowSparseNDArray(dense[nz], nz, dense.shape)
+        nz, rows = _dense_to_rs(nd._data)
+        return RowSparseNDArray(rows, nz, tuple(nd.shape))
     if stype == "csr":
-        import scipy.sparse as sp  # available via numpy stack
-        m = sp.csr_matrix(onp.asarray(nd._data))
-        return CSRNDArray(m.data, m.indptr, m.indices, m.shape)
+        m = _dense_to_scipy_csr(nd._data)
+        return CSRNDArray(jnp.asarray(m.data).astype(nd.dtype),
+                          m.indptr, m.indices, m.shape)
     raise MXNetError(f"unknown stype {stype}")
 
 
-def retain(rs: RowSparseNDArray, indices):
-    idx = onp.asarray(indices._data if isinstance(indices, NDArray) else indices,
-                      onp.int32)
-    keep = onp.isin(onp.asarray(rs._rs_indices), idx)
-    return RowSparseNDArray(onp.asarray(rs._rs_data)[keep],
-                            onp.asarray(rs._rs_indices)[keep], rs.shape)
+def cast_storage(nd: NDArray, stype: str):
+    """Reference anchor ``cast_storage``: convert between storage types."""
+    if isinstance(nd, BaseSparseNDArray):
+        return nd.tostype(stype)
+    return tostype(nd, stype)
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
     """``mx.nd.sparse.zeros('row_sparse', shape)`` (reference surface)."""
-    import jax.numpy as _jnp
     if stype == "row_sparse":
-        return RowSparseNDArray(_jnp.zeros((0,) + tuple(shape[1:]),
-                                           _jnp.dtype(dtype)),
-                                _jnp.zeros((0,), _jnp.int32), shape, ctx)
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]),
+                                          jnp.dtype(dtype)),
+                                jnp.zeros((0,), jnp.int32), shape, ctx)
     if stype == "csr":
-        return CSRNDArray(onp.zeros((0,), dtype), onp.zeros(shape[0] + 1,
-                                                            onp.int64),
+        return CSRNDArray(onp.zeros((0,), dtype),
+                          onp.zeros(shape[0] + 1, onp.int64),
                           onp.zeros((0,), onp.int64), shape, ctx)
     raise MXNetError(f"unknown stype {stype}")
 
 
-def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """``mx.nd.sparse.dot`` — csr/row_sparse × dense matmul.  Dense
-    compute under the hood (XLA; PARITY.md sparse row), sparse-typed API."""
-    from . import dot as _dense_dot
-    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
-    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
-    return _dense_dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+# --------------------------------------------------------------------------- #
+# kernels' NDArray-level surface
+# --------------------------------------------------------------------------- #
+
+def retain(rs: RowSparseNDArray, indices):
+    """Keep only the listed rows (reference ``sparse_retain``): host index
+    set-intersection (tiny), device gather for the values."""
+    idx = onp.asarray(indices._data if isinstance(indices, NDArray)
+                      else indices, onp.int64)
+    rs._components()
+    keep = onp.isin(onp.asarray(rs._rs_indices), idx)
+    keep_pos = jnp.asarray(onp.where(keep)[0], jnp.int32)
+    return RowSparseNDArray(rs._rs_data[keep_pos],
+                            onp.asarray(rs._rs_indices)[keep], rs.shape)
 
 
 def sparse_retain(data, indices):
-    """Reference anchor ``sparse_retain`` op: keep only the listed rows."""
     if not isinstance(data, RowSparseNDArray):
         raise MXNetError("sparse_retain expects a RowSparseNDArray")
     return retain(data, indices)
 
 
-__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
-           "row_sparse_array", "csr_matrix", "tostype", "retain",
-           "sparse_retain", "zeros", "dot"]
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """``mx.nd.sparse.dot`` — csr/row_sparse × dense at O(nnz) cost.
+
+    csr·dense and csrᵀ·dense run the ``_sparse_segment_dot`` kernel
+    (gather + segment_sum); row_sparse·dense runs a gathered matmul.
+    Dense×dense falls through to the dense op.  Gradients w.r.t. the
+    dense operand flow through the registered kernels."""
+    from . import dot as _dense_dot
+
+    if not isinstance(lhs, BaseSparseNDArray):
+        return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                          transpose_b=transpose_b)
+    rhs_d = NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs
+    if transpose_b:
+        rhs_d = NDArray(jnp.swapaxes(rhs_d._data, -1, -2))
+
+    if isinstance(lhs, CSRNDArray):
+        lhs._components()
+        m, k = lhs.shape
+        if transpose_a:
+            return _segment_dot(NDArray(lhs._csr_data),
+                                NDArray(lhs._csr_rowids),
+                                NDArray(lhs._csr_indices), rhs_d,
+                                num_segments=k)
+        return _segment_dot(NDArray(lhs._csr_data),
+                            NDArray(lhs._csr_indices),
+                            NDArray(lhs._csr_rowids), rhs_d,
+                            num_segments=m)
+    if isinstance(lhs, RowSparseNDArray):
+        lhs._components()
+        if transpose_a:
+            return _rowsparse_dot_t(NDArray(lhs._rs_data),
+                                    NDArray(lhs._rs_indices), rhs_d,
+                                    num_cols=lhs.shape[1])
+        return _rowsparse_dot(NDArray(lhs._rs_data),
+                              NDArray(lhs._rs_indices), rhs_d,
+                              num_rows=lhs.shape[0])
+    raise MXNetError(f"sparse.dot: unsupported lhs type {type(lhs)}")
+
+
+def _csr_elemwise(opname, a: CSRNDArray, b: CSRNDArray):
+    """Structure-changing csr elemwise: pattern union on host (scipy),
+    result back as csr.  Documented host path — the reference's CPU csr
+    kernels play the same role."""
+    if a.shape != b.shape:
+        raise MXNetError(f"csr elemwise {opname}: shape mismatch "
+                         f"{a.shape} vs {b.shape}")
+    sa, sb = a._scipy(), b._scipy()
+    if opname == "add":
+        out = sa + sb
+    elif opname == "subtract":
+        out = sa - sb
+    elif opname == "multiply":
+        out = sa.multiply(sb).tocsr()
+    else:
+        raise MXNetError(f"unsupported csr elemwise {opname}")
+    out.sort_indices()
+    # scipy computed in f32 (no bf16 support); restore the operand dtype
+    return CSRNDArray(jnp.asarray(out.data).astype(a._sp_dtype),
+                      out.indptr, out.indices, out.shape)
+
+
+def _rs_elemwise(opname, a: RowSparseNDArray, b: RowSparseNDArray):
+    """row_sparse elemwise: index union on host, value math on device."""
+    if a.shape != b.shape:
+        raise MXNetError(f"row_sparse elemwise {opname}: shape mismatch "
+                         f"{a.shape} vs {b.shape}")
+    a._components()
+    b._components()
+    ia = onp.asarray(a._rs_indices)
+    ib = onp.asarray(b._rs_indices)
+    union = onp.union1d(ia, ib)
+    pa = onp.searchsorted(union, ia)
+    pb = onp.searchsorted(union, ib)
+    cols = a.shape[1:]
+    va = jnp.zeros((len(union),) + cols, a._rs_data.dtype).at[
+        jnp.asarray(pa)].set(a._rs_data)
+    vb = jnp.zeros((len(union),) + cols, b._rs_data.dtype).at[
+        jnp.asarray(pb)].set(b._rs_data)
+    if opname == "add":
+        vals = va + vb
+    elif opname == "subtract":
+        vals = va - vb
+    elif opname == "multiply":
+        vals = va * vb
+    else:
+        raise MXNetError(f"unsupported row_sparse elemwise {opname}")
+    return RowSparseNDArray(vals, union, a.shape)
+
+
+def _elemwise(opname, a, b):
+    if isinstance(a, CSRNDArray) and isinstance(b, CSRNDArray):
+        return _csr_elemwise(opname, a, b)
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        return _rs_elemwise(opname, a, b)
+    # mixed / dense operand: dense result (reference behavior)
+    ad = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    bd = b._data if isinstance(b, NDArray) else jnp.asarray(b)
+    fn = {"add": jnp.add, "subtract": jnp.subtract,
+          "multiply": jnp.multiply}[opname]
+    return NDArray(fn(ad, bd))
+
+
+def add(a, b):
+    return _elemwise("add", a, b)
+
+
+def subtract(a, b):
+    return _elemwise("subtract", a, b)
+
+
+def multiply(a, b):
+    return _elemwise("multiply", a, b)

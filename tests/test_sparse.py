@@ -1,0 +1,191 @@
+"""Sparse kernels (csr/row_sparse): goldens vs scipy + gradients.
+
+Reference test model (SURVEY.md §4-of-reference test strategy): op-level
+golden tests vs NumPy + gradient checks on the registered kernels."""
+import numpy as onp
+import pytest
+import scipy.sparse as sp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr(m, n, density=0.3, seed=0):
+    rng = onp.random.RandomState(seed)
+    mat = sp.random(m, n, density=density, random_state=rng,
+                    format="csr", dtype=onp.float32)
+    return mat
+
+
+class TestCSR:
+    def test_construct_lazy(self):
+        mat = _rand_csr(8, 6)
+        a = sparse.csr_matrix((mat.data, mat.indices, mat.indptr),
+                              shape=mat.shape)
+        # construction must NOT materialize the dense mirror
+        assert a._dense_cache is None
+        assert a.stype == "csr"
+        assert a.shape == (8, 6)
+        onp.testing.assert_allclose(a.asnumpy(), mat.toarray(), rtol=1e-6)
+
+    def test_dot_golden(self):
+        mat = _rand_csr(16, 12)
+        rhs = onp.random.RandomState(1).randn(12, 5).astype(onp.float32)
+        a = sparse.csr_matrix((mat.data, mat.indices, mat.indptr),
+                              shape=mat.shape)
+        out = sparse.dot(a, nd.array(rhs))
+        onp.testing.assert_allclose(out.asnumpy(), mat @ rhs, rtol=1e-5)
+
+    def test_dot_transpose_golden(self):
+        mat = _rand_csr(16, 12, seed=2)
+        rhs = onp.random.RandomState(3).randn(16, 7).astype(onp.float32)
+        a = sparse.csr_matrix((mat.data, mat.indices, mat.indptr),
+                              shape=mat.shape)
+        out = sparse.dot(a, nd.array(rhs), transpose_a=True)
+        onp.testing.assert_allclose(out.asnumpy(), mat.T @ rhs, rtol=1e-5,
+                                    atol=1e-6)
+
+    def test_dot_grad_wrt_dense(self):
+        mat = _rand_csr(10, 8, seed=4)
+        a = sparse.csr_matrix((mat.data, mat.indices, mat.indptr),
+                              shape=mat.shape)
+        rhs = nd.array(onp.random.RandomState(5).randn(8, 4)
+                       .astype(onp.float32))
+        rhs.attach_grad()
+        with autograd.record():
+            out = sparse.dot(a, rhs)
+            loss = out.sum()
+        loss.backward()
+        # d/d(rhs) of sum(csr @ rhs) = csr^T @ ones
+        expect = mat.T @ onp.ones((10, 4), onp.float32)
+        onp.testing.assert_allclose(rhs.grad.asnumpy(), expect, rtol=1e-5,
+                                    atol=1e-6)
+
+    def test_elemwise_union(self):
+        a_s = _rand_csr(6, 6, seed=6)
+        b_s = _rand_csr(6, 6, seed=7)
+        a = sparse.csr_matrix((a_s.data, a_s.indices, a_s.indptr),
+                              shape=a_s.shape)
+        b = sparse.csr_matrix((b_s.data, b_s.indices, b_s.indptr),
+                              shape=b_s.shape)
+        out = sparse.add(a, b)
+        assert out.stype == "csr"
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    (a_s + b_s).toarray(), rtol=1e-6)
+        out = sparse.multiply(a, b)
+        assert out.stype == "csr"
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    a_s.multiply(b_s).toarray(), rtol=1e-6)
+
+    def test_bf16_refresh_and_elemwise_keep_dtype(self):
+        """scipy has no bf16 — the host round-trips must still work and
+        must NOT silently promote to f32 (the round-1 dtype-leak trap)."""
+        import jax.numpy as jnp
+        mat = _rand_csr(6, 6, seed=9)
+        a = sparse.csr_matrix((mat.data, mat.indices, mat.indptr),
+                              shape=mat.shape, dtype="bfloat16")
+        assert a.dtype == onp.dtype("bfloat16") if hasattr(
+            onp, "bfloat16") else str(a.dtype) == "bfloat16"
+        out = sparse.add(a, a)
+        assert str(out.dtype) == "bfloat16"
+        # rebind the mirror -> components re-derive through f32 scipy
+        a._data = jnp.asarray(a._data) * 2
+        assert str(a.data.dtype) == "bfloat16"
+        onp.testing.assert_allclose(
+            onp.asarray(a.asnumpy(), onp.float32),
+            onp.asarray((2 * mat).toarray().astype("float32")), rtol=2e-2,
+            atol=1e-2)
+
+    def test_csr_shape_mismatch_raises(self):
+        a_s, b_s = _rand_csr(4, 4), _rand_csr(5, 4, seed=1)
+        a = sparse.csr_matrix((a_s.data, a_s.indices, a_s.indptr),
+                              shape=a_s.shape)
+        b = sparse.csr_matrix((b_s.data, b_s.indices, b_s.indptr),
+                              shape=b_s.shape)
+        with pytest.raises(mx.base.MXNetError):
+            sparse.add(a, b)
+
+    def test_cast_storage_round_trip(self):
+        dense = onp.random.RandomState(8).randn(5, 5).astype(onp.float32)
+        dense[dense < 0.5] = 0
+        a = sparse.cast_storage(nd.array(dense), "csr")
+        assert a.stype == "csr"
+        back = sparse.cast_storage(a, "default")
+        assert back.stype == "default"
+        onp.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+
+
+class TestRowSparse:
+    def test_dot_golden(self):
+        vals = onp.random.RandomState(0).randn(3, 6).astype(onp.float32)
+        idx = onp.array([1, 4, 7])
+        a = sparse.row_sparse_array((vals, idx), shape=(9, 6))
+        assert a._dense_cache is None  # lazy
+        rhs = onp.random.RandomState(1).randn(6, 4).astype(onp.float32)
+        out = sparse.dot(a, nd.array(rhs))
+        dense = onp.zeros((9, 6), onp.float32)
+        dense[idx] = vals
+        onp.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+
+    def test_dot_transpose_golden(self):
+        vals = onp.random.RandomState(2).randn(3, 6).astype(onp.float32)
+        idx = onp.array([0, 2, 5])
+        a = sparse.row_sparse_array((vals, idx), shape=(7, 6))
+        rhs = onp.random.RandomState(3).randn(7, 4).astype(onp.float32)
+        out = sparse.dot(a, nd.array(rhs), transpose_a=True)
+        dense = onp.zeros((7, 6), onp.float32)
+        dense[idx] = vals
+        onp.testing.assert_allclose(out.asnumpy(), dense.T @ rhs,
+                                    rtol=1e-5, atol=1e-6)
+
+    def test_retain(self):
+        vals = onp.arange(12, dtype=onp.float32).reshape(4, 3)
+        idx = onp.array([0, 2, 5, 6])
+        a = sparse.row_sparse_array((vals, idx), shape=(8, 3))
+        kept = sparse.sparse_retain(a, nd.array(onp.array([2, 6])))
+        onp.testing.assert_array_equal(kept.indices.asnumpy(), [2, 6])
+        onp.testing.assert_allclose(kept.data.asnumpy(), vals[[1, 3]])
+
+    def test_elemwise_index_union(self):
+        a = sparse.row_sparse_array(
+            (onp.ones((2, 3), onp.float32), onp.array([1, 3])), shape=(6, 3))
+        b = sparse.row_sparse_array(
+            (2 * onp.ones((2, 3), onp.float32), onp.array([3, 5])),
+            shape=(6, 3))
+        out = sparse.add(a, b)
+        assert out.stype == "row_sparse"
+        onp.testing.assert_array_equal(out.indices.asnumpy(), [1, 3, 5])
+        expect = onp.zeros((6, 3), onp.float32)
+        expect[1] = 1
+        expect[3] = 3
+        expect[5] = 2
+        onp.testing.assert_allclose(out.asnumpy(), expect)
+
+    def test_rebind_refreshes_components(self):
+        """After something outside the sparse API rebinds ._data, the
+        component accessors re-derive from the dense mirror."""
+        a = sparse.row_sparse_array(
+            (onp.ones((1, 2), onp.float32), onp.array([1])), shape=(4, 2))
+        import jax.numpy as jnp
+        new = onp.zeros((4, 2), onp.float32)
+        new[3] = 7
+        a._data = jnp.asarray(new)
+        onp.testing.assert_array_equal(a.indices.asnumpy(), [3])
+        onp.testing.assert_allclose(a.data.asnumpy(), [[7, 7]])
+
+    def test_shape_mismatch_raises(self):
+        a = sparse.row_sparse_array(
+            (onp.ones((1, 3), onp.float32), onp.array([1])), shape=(4, 3))
+        b = sparse.row_sparse_array(
+            (onp.ones((1, 3), onp.float32), onp.array([5])), shape=(6, 3))
+        with pytest.raises(mx.base.MXNetError):
+            sparse.add(a, b)
+
+    def test_zeros(self):
+        z = sparse.zeros("row_sparse", (5, 4))
+        assert z.stype == "row_sparse" and z.shape == (5, 4)
+        assert onp.all(z.asnumpy() == 0)
+        z = sparse.zeros("csr", (5, 4))
+        assert z.stype == "csr"
+        assert onp.all(z.asnumpy() == 0)
