@@ -58,7 +58,23 @@ def _read_nd(f) -> NDArray:
     for d in shape:
         n *= d
     buf = f.read(n * onp.dtype(dtype).itemsize)
-    return array(onp.frombuffer(buf, dtype=dtype).reshape(shape).copy())
+    arr = onp.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+    if onp.dtype(dtype) in (onp.int64, onp.uint64, onp.float64):
+        # jax (x64 disabled) demotes 64-bit dtypes to 32-bit.  Demote only
+        # when the values survive exactly; otherwise fail loudly instead
+        # of silently truncating (e.g. reference int64 large-tensor files)
+        narrow = {onp.dtype(onp.int64): onp.int32,
+                  onp.dtype(onp.uint64): onp.uint32,
+                  onp.dtype(onp.float64): onp.float32}[onp.dtype(dtype)]
+        demoted = arr.astype(narrow)
+        if not onp.array_equal(demoted.astype(dtype), arr,
+                               equal_nan=onp.dtype(dtype).kind == "f"):
+            raise MXNetError(
+                f"load: {onp.dtype(dtype).name} array does not fit "
+                f"{onp.dtype(narrow).name} exactly and jax x64 is "
+                "disabled; enable jax_enable_x64 to load this file")
+        arr = demoted
+    return array(arr)
 
 
 def save(fname: str, data):

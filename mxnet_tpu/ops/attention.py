@@ -620,15 +620,17 @@ def _pallas_bwd_dkv(q, k, v, g, lse_rep, dlt_rep, scale, causal, kmask=None,
 # custom VJP: blockwise recompute backward (flash-attention-2 scheme)
 # --------------------------------------------------------------------------- #
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash(q, k, v, bias, seed, scale, causal, dropout=0.0):
-    out, _ = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, bias, seed, scale, causal, dropout=0.0, impl="auto"):
+    out, _ = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout,
+                             impl)
     return out
 
 
-def _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout):
+def _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout,
+                    impl="auto"):
     L = q.shape[2]
-    if _pallas_eligible(q, k, bias):
+    if impl != "xla" and _pallas_eligible(q, k, bias):
         kmask = _kmask_arrays(bias, q.shape[0]) if bias is not None \
             else None
         return _pallas_fwd(q, k, v, scale, causal, kmask=kmask, seed=seed,
@@ -637,12 +639,14 @@ def _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout):
                            q_block=min(128, max(16, L)))
 
 
-def _flash_fwd(q, k, v, bias, seed, scale, causal, dropout=0.0):
-    out, lse = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout)
+def _flash_fwd(q, k, v, bias, seed, scale, causal, dropout=0.0,
+               impl="auto"):
+    out, lse = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, dropout,
+                               impl)
     return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, dropout, res, g):
+def _flash_bwd(scale, causal, dropout, impl, res, g):
     q, k, v, bias, seed, out, lse = res
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
@@ -650,7 +654,7 @@ def _flash_bwd(scale, causal, dropout, res, g):
     # delta_i = sum_d o_i * do_i  (row-wise), standard flash backward
     delta = jnp.sum(o32 * g32, axis=-1)                 # (B,H,Lq)
 
-    if _pallas_eligible(q, k, bias):
+    if impl != "xla" and _pallas_eligible(q, k, bias):
         kmask = _kmask_arrays(bias, B) if bias is not None else None
         lse_rep = _rep(lse.reshape(B * H, Lq))
         dlt_rep = _rep(delta.reshape(B * H, Lq))
@@ -755,6 +759,68 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # actually matters
 _PLAIN_ATTN_MAX_SCORES = 512 * 512
 
+# --------------------------------------------------------------------------- #
+# measured dispatch (VERDICT r2 item 4: "chosen path == fastest measured
+# path").  Constants are the crossover sequence lengths from
+# ``benchmark/attention_bench.py`` on v5e (causal, B4 H8 D64, bf16) — see
+# the sweep table in BASELINE.md.  Entries are (max_seq, impl); the first
+# row whose bound covers max(Lq, Lk) wins.  "plain" materializes O(L²)
+# scores (fused-softmax), "xla" is the blockwise lax.scan path, "pallas"
+# the Pallas kernels (fwd + bwd).
+# --------------------------------------------------------------------------- #
+_PATH_TABLE = {
+    # inference: XLA blockwise wins the mid range; Pallas from 8k up
+    # (sequences <= 512 already took the plain path via
+    # _PLAIN_ATTN_MAX_SCORES before the table is consulted)
+    "fwd": ((4096, "xla"), (None, "pallas")),
+    # training: plain wins short (cheap bwd), Pallas from 2k up
+    "train": ((1024, "plain"), (2048, "xla"), (None, "pallas")),
+}
+
+
+def _choose_path(Lq, Lk, bias, training):
+    """Pick the implementation per the measured table.  Dense biases
+    (anything that is not a full-width key-padding mask) never run the
+    Pallas kernels, so their long-seq rows degrade to the XLA blockwise
+    path."""
+    L = max(Lq, Lk)
+    if Lq * Lk <= _PLAIN_ATTN_MAX_SCORES:
+        return "plain"
+    # pallas needs the kmask's key dim to be exactly Lk — a broadcast
+    # (..., 1) bias cannot be padded into a valid kernel mask
+    pallas_bias_ok = bias is None or (_is_kmask(bias) and
+                                      bias.shape[3] == Lk)
+    for bound, impl in _PATH_TABLE["train" if training else "fwd"]:
+        if bound is None or L <= bound:
+            if impl == "pallas" and (not pallas_bias_ok or
+                                     not _use_pallas()):
+                return "xla"
+            return impl
+    return "xla"
+
+
+def _pad_to_block(q, k, v, bias):
+    """Pad seq dims to the 128 multiple the Pallas kernels need and merge
+    the padding into a key-mask bias, so real tokenized batches (e.g.
+    seq 1000) still hit the kernel (VERDICT r2 item 4).  Returns
+    (q, k, v, bias, orig_Lq)."""
+    Lq, Lk = q.shape[2], k.shape[2]
+    pq = (-Lq) % _BLOCK
+    pk = (-Lk) % _BLOCK
+    if not pq and not pk:
+        return q, k, v, bias, Lq
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+    if pk or bias is not None:
+        if bias is None:
+            bias = jnp.zeros((1, 1, 1, Lk), q.dtype)
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pk)),
+                       constant_values=_NEG_INF)
+    return q, k, v, bias, Lq
+
 
 def _plain_attn(q, k, v, bias, scale, causal, dropout=0.0, seed=None):
     B, H = q.shape[0], q.shape[1]
@@ -793,11 +859,14 @@ def flash_attention(q, k, v, bias=None, *, scale: Optional[float] = None,
     Dropout inside ``MultiheadAttention``) when training — in training
     mode (``autograd.is_training()``) unless ``training`` overrides.
 
-    Short sequences (score matrix ≤ ~512²) take an unblocked fused-softmax
-    path; long sequences run the O(L)-memory blockwise kernel.  On TPU,
-    128-aligned sequences with no bias or a key-padding-mask bias
-    (layout ``(B|1, 1, 1, Lk)``) run Pallas kernels forward AND backward;
-    general dense biases take the XLA blockwise path."""
+    The implementation is chosen from the MEASURED dispatch table
+    ``_PATH_TABLE`` (benchmark/attention_bench.py sweep): short sequences
+    take the unblocked fused-softmax path, the mid range the XLA blockwise
+    kernel, long sequences the Pallas kernels (fwd AND bwd).  ``training``
+    selects the train-tuned (fwd+bwd) vs inference-tuned column.  On the
+    Pallas path 128-unaligned lengths are padded inside the op (the pad
+    keys are masked via the key-mask bias channel); general dense biases
+    (not a ``(B|1,1,1,Lk)`` key mask) always use the XLA paths."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if training is None:
@@ -809,10 +878,17 @@ def flash_attention(q, k, v, bias=None, *, scale: Optional[float] = None,
         seed = jax.random.bits(mxrandom.next_key(), dtype=jnp.uint32)
     else:
         seed = jnp.uint32(0)
-    if q.shape[2] * k.shape[2] <= _PLAIN_ATTN_MAX_SCORES:
+    path = _choose_path(q.shape[2], k.shape[2], bias, bool(training))
+    if path == "plain":
         return _plain_attn(q, k, v, bias, float(scale), bool(causal),
                            dropout=rate, seed=seed)
-    return _flash(q, k, v, bias, seed, float(scale), bool(causal), rate)
+    if path == "pallas":
+        q2, k2, v2, bias2, Lq = _pad_to_block(q, k, v, bias)
+        out = _flash(q2, k2, v2, bias2, seed, float(scale), bool(causal),
+                     rate, "pallas")
+        return out[:, :, :Lq] if out.shape[2] != Lq else out
+    return _flash(q, k, v, bias, seed, float(scale), bool(causal), rate,
+                  "xla")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Generate ``golden.params`` — a byte-level fixture of the public
+apache/mxnet NDArray binary format (the ``.params`` file layout).
+
+This generator is deliberately INDEPENDENT of ``mxnet_tpu``: it writes
+the bytes with ``struct.pack`` straight from the format specification
+(``NDArray::Save`` in the public apache/mxnet ``src/ndarray/ndarray.cc``;
+SURVEY.md §5.4a), so the committed fixture pins the on-disk layout the
+framework's serializer must produce and parse bit-exactly.
+
+Provenance: the environment has no network and the reference mount is
+empty (SURVEY.md §0), so these bytes are derived from the public format
+spec, not captured from a live MXNet run.  Layout:
+
+  file := u64 0x112 (kMXAPINDArrayListMagic) | u64 reserved=0
+        | u64 n_arrays | n * ndarray_v2_blob
+        | u64 n_names  | n * (u64 len | utf8 bytes)
+  ndarray_v2_blob := u32 0xF993FAC9 (NDARRAY_V2_MAGIC) | i32 stype(0=dense)
+        | u32 ndim | i64 dims[ndim] | i32 devtype(1=cpu) | i32 devid
+        | i32 type_flag | raw little-endian data
+
+type_flag: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64.
+"""
+import struct
+import sys
+
+import numpy as onp
+
+
+def golden_arrays():
+    """The fixture contents, reproducible from seeds/arange."""
+    return [
+        ("dense_f32", onp.arange(12, dtype=onp.float32).reshape(3, 4) / 8),
+        ("vec_f16", onp.asarray([1.5, -2.25, 0.125, 1024.0],
+                                dtype=onp.float16)),
+        ("ints_i32", onp.asarray([[7, -3], [0, 2**31 - 1]],
+                                 dtype=onp.int32)),
+        ("small_i8", onp.asarray([[-128, 127]], dtype=onp.int8)),
+        ("bytes_u8", onp.arange(256, dtype=onp.uint8).reshape(16, 16)),
+    ]
+
+
+TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+             "int32": 4, "int8": 5, "int64": 6}
+
+
+def write_blob(f, arr):
+    arr = onp.ascontiguousarray(arr)
+    f.write(struct.pack("<I", 0xF993FAC9))          # NDARRAY_V2_MAGIC
+    f.write(struct.pack("<i", 0))                   # stype: dense
+    f.write(struct.pack("<I", arr.ndim))
+    for d in arr.shape:
+        f.write(struct.pack("<q", d))
+    f.write(struct.pack("<ii", 1, 0))               # saved ctx: cpu(0)
+    f.write(struct.pack("<i", TYPE_FLAG[arr.dtype.name]))
+    f.write(arr.astype(arr.dtype.newbyteorder("<")).tobytes())
+
+
+def main(out="golden.params"):
+    items = golden_arrays()
+    with open(out, "wb") as f:
+        f.write(struct.pack("<QQ", 0x112, 0))
+        f.write(struct.pack("<Q", len(items)))
+        for _name, arr in items:
+            write_blob(f, arr)
+        f.write(struct.pack("<Q", len(items)))
+        for name, _arr in items:
+            b = name.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -423,3 +423,110 @@ def test_ring_attention_grads_match_full():
     for a, b in zip(g1, g2):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
                                     rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------------------------- #
+# measured dispatch table (VERDICT r2 item 4)
+# --------------------------------------------------------------------------- #
+
+class TestDispatch:
+    def _choose(self, Lq, Lk=None, bias=None, training=True, pallas_ok=True):
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import attention as attn
+        Lk = Lk or Lq
+        q = jnp.zeros((1, 1, Lq, 8))
+        saved = attn._use_pallas
+        attn._use_pallas = lambda: pallas_ok
+        try:
+            return attn._choose_path(Lq, Lk, bias, training)
+        finally:
+            attn._use_pallas = saved
+
+    def test_short_is_plain(self):
+        assert self._choose(128) == "plain"
+        assert self._choose(512) == "plain"
+
+    def test_mid_range_follows_table(self):
+        from mxnet_tpu.ops.attention import _PATH_TABLE
+        # training column: the table rows must be respected exactly
+        for bound, impl in _PATH_TABLE["train"]:
+            if bound is None or bound <= 512:
+                continue
+            assert self._choose(bound, training=True) == impl
+
+    def test_long_is_pallas(self):
+        assert self._choose(8192, training=True) == "pallas"
+        assert self._choose(8192, training=False) == "pallas"
+
+    def test_unaligned_long_still_pallas(self):
+        # 128-unaligned lengths are padded inside the op, not demoted
+        assert self._choose(8000, training=True) == "pallas"
+
+    def test_dense_bias_never_pallas(self):
+        import jax.numpy as jnp
+        dense_bias = jnp.zeros((1, 1, 8192, 8192))
+        assert self._choose(8192, bias=dense_bias) == "xla"
+
+    def test_no_pallas_backend_degrades_to_xla(self):
+        assert self._choose(8192, pallas_ok=False) == "xla"
+
+
+class TestPadding:
+    def test_pad_to_block_shapes_and_mask(self):
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.attention import _pad_to_block, _NEG_INF
+        q = jnp.ones((2, 3, 200, 16))
+        k = jnp.ones((2, 3, 250, 16))
+        v = jnp.ones((2, 3, 250, 16))
+        q2, k2, v2, bias2, Lq = _pad_to_block(q, k, v, None)
+        assert Lq == 200
+        assert q2.shape[2] == 256 and k2.shape[2] == 256
+        assert v2.shape == k2.shape
+        # synthesized key mask: 0 for real keys, -inf for pad keys
+        assert bias2.shape == (1, 1, 1, 256)
+        assert float(bias2[0, 0, 0, 249]) == 0.0
+        assert float(bias2[0, 0, 0, 250]) <= _NEG_INF / 2
+
+    def test_pad_preserves_existing_kmask(self):
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.attention import _pad_to_block, _NEG_INF
+        q = jnp.ones((2, 1, 128, 8))
+        k = jnp.ones((2, 1, 130, 8))
+        bias = jnp.zeros((2, 1, 1, 130)).at[0, 0, 0, 5].set(_NEG_INF)
+        q2, k2, v2, bias2, _ = _pad_to_block(q, k, jnp.ones_like(k), bias)
+        assert bias2.shape == (2, 1, 1, 256)
+        assert float(bias2[0, 0, 0, 5]) <= _NEG_INF / 2   # user mask kept
+        assert float(bias2[1, 0, 0, 129]) == 0.0          # real key open
+        assert float(bias2[1, 0, 0, 130]) <= _NEG_INF / 2  # pad key masked
+
+    def test_padded_pallas_matches_naive(self, monkeypatch):
+        """Unaligned seq through the actual Pallas kernel (interpret mode)
+        must equal the naive reference after the in-op pad+slice."""
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import attention as attn
+        monkeypatch.setenv("MXNET_FLASH_INTERPRET", "1")
+        q, k, v = (jnp.asarray(_rand(1, 2, 200, 16)) for _ in range(3))
+        q2, k2, v2, bias2, Lq = attn._pad_to_block(q, k, v, None)
+        out = attn._flash(q2, k2, v2, bias2, jnp.uint32(0), 0.25, False,
+                          0.0, "pallas")[:, :, :Lq]
+        ref = _naive(q, k, v, causal=False, scale=0.25)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                    rtol=3e-5, atol=3e-5)
+
+    def test_broadcast_kmask_bias_not_pallas(self):
+        """A (B,1,1,1) broadcast bias cannot become a padded kernel mask —
+        dispatch must route it to the XLA path, and the op must compute
+        correctly (review regression)."""
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import attention as attn
+        bias = jnp.zeros((1, 1, 1, 1))
+        assert attn._choose_path(8000, 8000, bias, False) == "xla"
+        q, k, v = (jnp.asarray(_rand(1, 1, 600, 8)) for _ in range(3))
+        out = mx.nd.flash_attention(mx.nd.array(onp.asarray(q)),
+                                    mx.nd.array(onp.asarray(k)),
+                                    mx.nd.array(onp.asarray(v)),
+                                    bias=mx.nd.array(onp.zeros(
+                                        (1, 1, 1, 1), "float32")))
+        ref = _naive(q, k, v, causal=False)
+        onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
+                                    rtol=3e-5, atol=3e-5)
