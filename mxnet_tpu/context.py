@@ -57,14 +57,21 @@ class Context:
 
     # -- jax mapping -------------------------------------------------------
     def jax_device(self) -> jax.Device:
-        """Resolve to the concrete jax.Device backing this context."""
+        """Resolve to the concrete jax.Device backing this context.
+
+        Always resolves within THIS process's addressable devices
+        (``jax.local_devices``) — under multi-process SPMD the global
+        device list leads with other hosts' devices, which cannot be
+        device_put targets (SURVEY.md §4.4 process boundaries)."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = _local_devices("cpu") if _has_platform("cpu") \
+                else _local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         accel = _accel_devices()
         if not accel:
             # graceful degrade: no accelerator present, run on host
-            return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+            devs = _local_devices()
+            return devs[min(self.device_id, len(devs) - 1)]
         if self.device_id >= len(accel):
             raise MXNetError(
                 f"context {self} out of range: {len(accel)} device(s) visible")
@@ -98,11 +105,28 @@ def _has_platform(name: str) -> bool:
 _ACCEL_CACHE = None
 
 
+def _local_devices(platform: str = None):
+    """This process's addressable devices, optionally of one backend.
+    Falls back to filtering the global list by process_index on backends
+    without the local/global distinction."""
+    try:
+        return jax.local_devices(backend=platform) if platform \
+            else jax.local_devices()
+    except Exception:
+        devs = jax.devices(platform) if platform else jax.devices()
+        try:
+            me = jax.process_index()
+        except Exception:
+            me = 0
+        local = [d for d in devs if getattr(d, "process_index", me) == me]
+        return local or devs
+
+
 def _accel_devices():
-    """Non-CPU jax devices (TPU chips), else empty."""
+    """Non-CPU jax devices addressable by this process, else empty."""
     global _ACCEL_CACHE
     if _ACCEL_CACHE is None:
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in _local_devices() if d.platform != "cpu"]
         _ACCEL_CACHE = devs
     return _ACCEL_CACHE
 

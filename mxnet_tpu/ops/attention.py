@@ -769,12 +769,15 @@ _PLAIN_ATTN_MAX_SCORES = 512 * 512
 # the Pallas kernels (fwd + bwd).
 # --------------------------------------------------------------------------- #
 _PATH_TABLE = {
-    # inference: XLA blockwise wins the mid range; Pallas from 8k up
+    # measured 2026-07-30 on v5e (see BASELINE.md sweep):
+    #   fwd:   512 plain 0.80ms | 1k-4k xla (1.17/2.02/5.92ms, pallas
+    #          1.58/3.43/10.63) | 8k pallas 38.8ms (xla 39.0)
+    #   train: 512 plain 0.79ms | 1k xla 1.74ms (plain 2.12, pallas 2.27)
+    #          | 2k+ pallas 6.41/22.1/78.2ms (xla 6.88/25.1/122.5)
     # (sequences <= 512 already took the plain path via
     # _PLAIN_ATTN_MAX_SCORES before the table is consulted)
     "fwd": ((4096, "xla"), (None, "pallas")),
-    # training: plain wins short (cheap bwd), Pallas from 2k up
-    "train": ((1024, "plain"), (2048, "xla"), (None, "pallas")),
+    "train": ((1024, "xla"), (None, "pallas")),
 }
 
 

@@ -77,6 +77,7 @@ def parse_trace(trace_dir):
                 "category": args.get("hlo_category", "?"),
                 "tf_op": args.get("tf_op", ""),
                 "source": args.get("source", ""),
+                "long_name": args.get("long_name", ""),
                 "dur_us": dur_us,
                 "flops": int(args.get("model_flops", 0)),
                 "bytes": int(args.get("raw_bytes_accessed",

@@ -471,6 +471,22 @@ class TestDispatch:
         assert self._choose(8192, pallas_ok=False) == "xla"
 
 
+    def test_dispatch_matches_measured_best(self):
+        """Frozen copy of the v5e sweep (benchmark/attention_bench.py,
+        2026-07-30): chosen path == fastest measured path at every
+        measured (seq, pass) point (VERDICT r2 item 4 done-criterion)."""
+        measured_best = {
+            (512, False): "plain", (512, True): "plain",
+            (1024, False): "xla", (1024, True): "xla",
+            (2048, False): "xla", (2048, True): "pallas",
+            (4096, False): "xla", (4096, True): "pallas",
+            (8192, False): "pallas", (8192, True): "pallas",
+        }
+        for (seq, training), want in measured_best.items():
+            got = self._choose(seq, training=training)
+            assert got == want, (seq, training, got, want)
+
+
 class TestPadding:
     def test_pad_to_block_shapes_and_mask(self):
         import jax.numpy as jnp

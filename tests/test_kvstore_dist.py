@@ -135,3 +135,54 @@ class TestLauncher:
             timeout=180)
         assert out.returncode == 0, out.stderr[-2000:]
         assert "RANK0_OK" in out.stdout and "RANK1_OK" in out.stdout
+
+    def test_real_two_process_spmd_train_step(self, tmp_path):
+        """A GSPMD train step over a TWO-PROCESS mesh (DCN axis on
+        localhost, SURVEY.md §7 hard-part 7 / VERDICT r2 item 5): the dp
+        axis spans processes, grads are reduced by the compiler across
+        them, both ranks must see the identical finite loss."""
+        script = tmp_path / "spmd_prog.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import mxnet_tpu as mx\n"
+            "from mxnet_tpu.parallel import init_distributed\n"
+            "init_distributed()\n"
+            "import jax, numpy as onp\n"
+            "from mxnet_tpu import gluon, parallel\n"
+            "assert jax.process_count() == 2, jax.process_count()\n"
+            "assert len(jax.devices()) == 2  # 1 local x 2 procs\n"
+            "mx.random.seed(0)\n"
+            "net = gluon.nn.HybridSequential()\n"
+            "net.add(gluon.nn.Dense(16, activation='relu', in_units=8))\n"
+            "net.add(gluon.nn.Dense(4, in_units=16))\n"
+            "net.initialize(mx.init.Xavier())\n"
+            "mesh = parallel.make_mesh({'dp': 2})\n"
+            "tr = parallel.SPMDTrainer(net,\n"
+            "    gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',\n"
+            "    {'learning_rate': 0.1}, mesh=mesh)\n"
+            "rng = onp.random.RandomState(0)\n"
+            "x = rng.randn(8, 8).astype('float32')\n"
+            "y = rng.randint(0, 4, 8).astype('float32')\n"
+            "losses = [float(onp.asarray(\n"
+            "    tr.step(mx.nd.array(x), mx.nd.array(y)).asnumpy())\n"
+            "    .reshape(())) for _ in range(3)]\n"
+            "assert all(onp.isfinite(l) for l in losses), losses\n"
+            "assert losses[-1] < losses[0], losses  # actually training\n"
+            "print('RANK%d_SPMD_OK loss=%.5f' % (jax.process_index(),\n"
+            "                                    losses[-1]), flush=True)\n")
+        import os
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "2", "--launcher",
+             "local", sys.executable, str(script)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=300)
+        assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+        assert "RANK0_SPMD_OK" in out.stdout and \
+            "RANK1_SPMD_OK" in out.stdout
+        import re
+        vals = {m.group(1) for m in
+                re.finditer(r"SPMD_OK loss=([\d.]+)", out.stdout)}
+        assert len(vals) == 1, f"ranks disagree: {vals}"

@@ -213,3 +213,50 @@ class TestKVCacheDecoding:
         with pytest.raises(ValueError, match="max_length"):
             kv_generate(net, onp.zeros((1, 60), onp.int32),
                         max_new_tokens=10)
+
+    def test_sampling_parity_with_full_recompute(self):
+        """Sampled (temperature>0, top_k) decode must match a reference
+        full-recompute loop that uses the identical fold_in/categorical
+        sampler — not just greedy (VERDICT r2 item 8)."""
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.models import kv_generate
+        net = self._model()
+        prompt = onp.random.RandomState(2).randint(0, 97, (2, 4))
+        T, K, SEED = 0.7, 7, 11
+        out = kv_generate(net, prompt, max_new_tokens=6, temperature=T,
+                          top_k=K, seed=SEED)
+
+        # reference: full-prefix recompute + the same documented sampler
+        key0 = jax.random.PRNGKey(SEED)
+        ref = onp.asarray(prompt, onp.int32)
+        for t_ in range(prompt.shape[1] - 1, prompt.shape[1] + 5):
+            logits = net(mx.nd.array(ref, dtype="int32")).asnumpy()
+            lg = jnp.asarray(logits[:, -1].astype(onp.float32)) / T
+            kth = jax.lax.top_k(lg, K)[0][:, -1]
+            lg = jnp.where(lg < kth[:, None], -jnp.inf, lg)
+            nxt = onp.asarray(jax.random.categorical(
+                jax.random.fold_in(key0, t_), lg, axis=-1), onp.int32)
+            ref = onp.concatenate([ref, nxt[:, None]], axis=1)
+        onp.testing.assert_array_equal(out, ref)
+
+    def test_second_model_config_relu_ffn(self):
+        """The decoder derives layer math from the Block itself: a model
+        variant with a RELU FFN (different activation inside ffn) must
+        decode in exact greedy parity with its own full recompute — the
+        old inline-GELU decoder would silently diverge here."""
+        from mxnet_tpu.models import GPT, GPTConfig, kv_generate
+        from mxnet_tpu.models.transformer import PositionwiseFFN
+        mx.random.seed(4)
+        cfg = GPTConfig(vocab_size=61, max_length=48, num_layers=3,
+                        units=48, num_heads=6, hidden_size=96)
+        net = GPT(cfg)
+        for i, blk in enumerate(net.blocks):
+            blk.ffn = PositionwiseFFN(cfg.units, cfg.hidden_size,
+                                      activation="relu",
+                                      prefix=f"h{i}_ffn_")
+        net.initialize(mx.init.Normal(0.02))
+        prompt = onp.random.RandomState(5).randint(0, 61, (2, 3))
+        ref = net.generate(prompt, max_new_tokens=10, temperature=0.0)
+        out = kv_generate(net, prompt, max_new_tokens=10, temperature=0.0)
+        onp.testing.assert_array_equal(out, ref)
