@@ -17,7 +17,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as onp
 
 PEAK_TFLOPS = 197.0
-GFLOP_PER_IMG_TRAIN = 4.1 * 3
+# ResNet-50 fwd ~= 4.1 GMACs = 8.2 GFLOP/img at 224^2 (2 flops per
+# multiply-add; cross-checked against XLA's own model_flops in the step
+# trace: 7.4 GFLOP/img conv-only fwd, 22.2 train).  Train ~= 3x fwd.
+# The r1/r2 bench used 4.1 GFLOP here — counting MACs as FLOPs — which
+# UNDERSTATED MFU by 2x (the r2 "12.7% MFU" was really ~25%).
+GFLOP_PER_IMG_TRAIN = 8.2 * 3
 
 
 def main():

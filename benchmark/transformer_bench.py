@@ -3,9 +3,10 @@
 
 - config 4: Transformer-big (WMT14-geometry seq2seq: 1024 units, 4096 FF,
   16 heads, 6+6 layers) training tokens/sec/chip.
-- config 5: GPT-2-medium (345M) single-chip train MFU (the TP×DP sharding
-  itself is validated by ``__graft_entry__.dryrun_multichip`` on the
-  virtual mesh; a pod is needed for real multi-chip rates).
+- config 5: GPT-2-774M (36 layers / 1280 units / 20 heads / 5120 FF —
+  the geometry BASELINE.json names) single-chip train MFU.  The TP×DP
+  sharding itself is validated by ``__graft_entry__.dryrun_multichip``
+  on the virtual mesh; a pod is needed for real multi-chip rates.
 
 Prints one JSON line per config.
 """
@@ -53,7 +54,7 @@ def main():
     from mxnet_tpu.models import TransformerSeq2Seq as Transformer
 
     V, L = (32768, 64) if on_tpu else (512, 16)
-    B = 16 if on_tpu else 2
+    B = 64 if on_tpu else 2
     mx.random.seed(0)
     net = Transformer(V, units=1024 if on_tpu else 64,
                       hidden_size=4096 if on_tpu else 128,
@@ -93,18 +94,18 @@ def main():
         if on_tpu else None}))
     sys.stdout.flush()
 
-    # ---- config 5: GPT-2-medium single-chip MFU ---------------------- #
+    # ---- config 5: GPT-2-774M single-chip MFU ------------------------ #
     from mxnet_tpu.models import GPT, GPTConfig
 
-    cfg = GPTConfig(vocab_size=50304, max_length=512, num_layers=24,
-                    units=1024, num_heads=16, hidden_size=4096,
+    cfg = GPTConfig(vocab_size=50304, max_length=512, num_layers=36,
+                    units=1280, num_heads=20, hidden_size=5120,
                     dtype=dt_str) if on_tpu else \
         GPTConfig(vocab_size=512, max_length=64, num_layers=2, units=64,
                   num_heads=4, hidden_size=128)
     mx.random.seed(0)
     gpt = GPT(cfg)
     gpt.initialize(mx.init.Normal(0.02))
-    B2, L2 = (8, 512) if on_tpu else (2, 16)
+    B2, L2 = (4, 512) if on_tpu else (2, 16)
     toks2 = rng.randint(0, cfg.vocab_size, (B2, L2 + 1))
     trainer2 = parallel.SPMDTrainer(
         gpt, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
@@ -115,7 +116,7 @@ def main():
     flops_per_tok = 6 * cfg.num_params
     tok_s2 = n_tok / best2
     print(json.dumps({
-        "bench": "gpt2_medium_train", "tokens_per_sec_per_chip":
+        "bench": "gpt2_774m_train", "tokens_per_sec_per_chip":
         round(tok_s2 / max(1, len(jax.devices())), 1),
         "step_ms": round(best2 * 1e3, 2), "batch": B2, "seq": L2,
         "params_m": round(cfg.num_params / 1e6, 1), "platform": platform,

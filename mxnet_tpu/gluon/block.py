@@ -545,28 +545,20 @@ class _CachedOp:
 
         def fn(key, *arrays):
             from .. import autograd, random as mxrandom
+            from .parameter import params_swapped
             n = len(param_objs)
             param_vals, inputs = arrays[:n], arrays[n:]
-            saved = [p._data._data for p in param_objs]
-            saved_nodes = [(p._data._autograd_node, p._data._autograd_idx)
-                           for p in param_objs]
             aux: OrderedDict = OrderedDict()
             _trace_state.stack.append(aux)
             mxrandom.push_trace_key(key)
             try:
-                for p, v in zip(param_objs, param_vals):
-                    p._data._data = v
-                    p._data._autograd_node = None
-                nd_inputs = [NDArray(x) if not isinstance(x, NDArray) else x
-                             for x in inputs]
-                with autograd.pause(train_mode=training):
-                    with _no_hybrid():
-                        out = block.forward(*nd_inputs)
+                with params_swapped(param_objs, param_vals):
+                    nd_inputs = [NDArray(x) if not isinstance(x, NDArray)
+                                 else x for x in inputs]
+                    with autograd.pause(train_mode=training):
+                        with _no_hybrid():
+                            out = block.forward(*nd_inputs)
             finally:
-                for p, v, (node, idx) in zip(param_objs, saved, saved_nodes):
-                    p._data._data = v
-                    p._data._autograd_node = node
-                    p._data._autograd_idx = idx
                 mxrandom.pop_trace_key()
                 _trace_state.stack.pop()
 

@@ -25,7 +25,32 @@ from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray
 from .. import initializer as init_mod
 
-__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError", "params_swapped"]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def params_swapped(params, vals):
+    """Temporarily rebind each Parameter's NDArray to a (traced) value,
+    clearing autograd entries, and restore on exit — the trace-time swap
+    discipline shared by ``_CachedOp`` tracing, ``SPMDTrainer``'s fused
+    step, and ``kv_generate`` (weights ride as traced jit ARGUMENTS, so
+    weight updates never invalidate compiled programs)."""
+    saved = [(p._data._data, p._data._autograd_node, p._data._autograd_idx)
+             for p in params]
+    try:
+        for p, v in zip(params, vals):
+            p._data._data = v
+            p._data._autograd_node = None
+        yield
+    finally:
+        for p, (v, node, idx) in zip(params, saved):
+            p._data._data = v
+            p._data._autograd_node = node
+            p._data._autograd_idx = idx
 
 
 class DeferredInitializationError(MXNetError):

@@ -116,12 +116,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
 
     if cache_key not in cache:
         def run(param_vals, prompt_dev, key0):
-            saved = [(p._data._data, p._data._autograd_node,
-                      p._data._autograd_idx) for p in params]
-            try:
-                for p, v in zip(params, param_vals):
-                    p._data._data = v
-                    p._data._autograd_node = None
+            from ..gluon.parameter import params_swapped
+            with params_swapped(params, param_vals):
 
                 def scan_body(carry, t):
                     tok, ck, cv = carry
@@ -148,11 +144,6 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                 (_, _, _), toks = lax.scan(scan_body, (tok0, ck, cv),
                                            jnp.arange(total - 1))
                 return toks                                    # (T-1, B)
-            finally:
-                for p, (v, node, i_) in zip(params, saved):
-                    p._data._data = v
-                    p._data._autograd_node = node
-                    p._data._autograd_idx = i_
 
         cache[cache_key] = jax.jit(run)
 

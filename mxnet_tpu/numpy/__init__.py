@@ -1,5 +1,6 @@
 """``mx.np`` package (reference ``python/mxnet/numpy/``)."""
 from .multiarray import *  # noqa: F401,F403
 from .multiarray import (ndarray, array, _coerce_arr, _run)  # noqa: F401
+from .extensions import *  # noqa: F401,F403  (r3 breadth additions)
 from . import linalg  # noqa: F401
 from . import random  # noqa: F401

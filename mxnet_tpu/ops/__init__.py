@@ -7,3 +7,4 @@ from . import attention
 from . import linalg
 from . import optimizer_ops
 from . import extended
+from . import legacy

@@ -1,0 +1,308 @@
+"""Legacy-surface and utility operators (round-3 corpus expansion).
+
+Families covered (SURVEY.md §3.1 "Operator corpus"):
+- ``im2col``/``col2im`` — the patch-extraction utilities behind the
+  reference's CPU conv path (``src/operator/nn/im2col.h``); on TPU they
+  are layout transforms (gather/scatter) XLA fuses, useful for custom
+  conv formulations and for API parity.
+- Module-era output heads: ``LinearRegressionOutput``,
+  ``LogisticRegressionOutput``, ``MAERegressionOutput``, ``SVMOutput`` —
+  forward is identity/sigmoid on data; their defining property is the
+  *backward* (gradient = d(loss)/d(data) w.r.t. the attached label), so
+  each is a ``jax.custom_vjp`` reproducing the reference gradients.
+- legacy indexing: ``choose_element_0index``, ``fill_element_0index``.
+- activation ops the reference registers as standalone names: ``gelu``,
+  ``selu``, ``elu``, ``prelu``, ``erfc``, ``logit``.
+- optimizer ops: ``group_adagrad_update`` (contrib GroupAdaGrad),
+  ``lans_update`` (LANS = LAMB with normalized gradients).
+- ``softmax_cross_entropy`` — fused softmax+CE (reference op of the same
+  name), ``rnn_param_concat`` (flat RNN parameter packing).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import alias, op
+
+__all__ = [
+    "im2col", "col2im", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput", "SVMOutput",
+    "choose_element_0index", "fill_element_0index", "gelu", "selu", "elu",
+    "prelu", "erfc", "logit", "softmax_cross_entropy",
+    "group_adagrad_update", "lans_update", "rnn_param_concat",
+]
+
+
+def _pair(v, n=2):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v + (v[-1],) * (n - len(v)) if len(v) < n else v
+
+
+# --------------------------------------------------------------------------- #
+# im2col / col2im (reference anchors ``im2col``/``col2im`` ops)
+# --------------------------------------------------------------------------- #
+
+@op("im2col")
+def im2col(data, *, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """(N, C, H, W) -> (N, C*kh*kw, L) patch matrix, L = out_h*out_w.
+
+    Implemented as ``lax.conv_general_dilated_patches`` — XLA lowers it to
+    fused gathers (no materialized loop)."""
+    kernel = _pair(kernel)
+    stride = _pair(stride or 1)
+    dilate = _pair(dilate or 1)
+    pad = _pair(pad or 0)
+    n, c = data.shape[0], data.shape[1]
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: (N, C*kh*kw, out_h, out_w)
+    return patches.reshape(n, c * kernel[0] * kernel[1], -1)
+
+
+@op("col2im")
+def col2im(data, *, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Inverse of :func:`im2col`: scatter-add the (N, C*kh*kw, L) patch
+    matrix back to (N, C, H, W).  Overlapping patches accumulate (the
+    reference semantics)."""
+    kernel = _pair(kernel)
+    stride = _pair(stride or 1)
+    dilate = _pair(dilate or 1)
+    pad = _pair(pad or 0)
+    oh, ow = _pair(output_size)
+    n = data.shape[0]
+    kh, kw = kernel
+    c = data.shape[1] // (kh * kw)
+    out_h = (oh + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    out_w = (ow + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+    cols = data.reshape(n, c, kh, kw, out_h, out_w)
+    padded = jnp.zeros((n, c, oh + 2 * pad[0], ow + 2 * pad[1]),
+                       data.dtype)
+    # scatter each (ki, kj) tap at its strided offsets (static py loop of
+    # kh*kw scatter-adds; XLA fuses)
+    for ki in range(kh):
+        for kj in range(kw):
+            hi = ki * dilate[0]
+            wj = kj * dilate[1]
+            sl = padded[:, :, hi:hi + out_h * stride[0]:stride[0],
+                        wj:wj + out_w * stride[1]:stride[1]]
+            padded = padded.at[
+                :, :, hi:hi + out_h * stride[0]:stride[0],
+                wj:wj + out_w * stride[1]:stride[1]].set(
+                sl + cols[:, :, ki, kj])
+    return padded[:, :, pad[0]:pad[0] + oh, pad[1]:pad[1] + ow]
+
+
+# --------------------------------------------------------------------------- #
+# Module-era output heads: identity-ish forward, loss-defining backward
+# --------------------------------------------------------------------------- #
+
+def _output_head(name, fwd, dgrad):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def head(data, label, grad_scale=1.0):
+        return fwd(data)
+
+    def head_fwd(data, label, grad_scale):
+        return fwd(data), (data, label)
+
+    def head_bwd(grad_scale, res, g):
+        data, label = res
+        # reference semantics: out_grad is ignored; the head IS the loss
+        d = dgrad(data, label) * grad_scale
+        return d.astype(data.dtype), jnp.zeros_like(label)
+
+    head.defvjp(head_fwd, head_bwd)
+
+    def wrapper(data, label, *, grad_scale=1.0):
+        return head(data, label, float(grad_scale))
+
+    wrapper.__name__ = name
+    return op(name)(wrapper)
+
+
+LinearRegressionOutput = _output_head(
+    "LinearRegressionOutput", lambda d: d,
+    lambda d, l: (d - l.reshape(d.shape)) / d.shape[0])
+MAERegressionOutput = _output_head(
+    "MAERegressionOutput", lambda d: d,
+    lambda d, l: jnp.sign(d - l.reshape(d.shape)) / d.shape[0])
+LogisticRegressionOutput = _output_head(
+    "LogisticRegressionOutput", jax.nn.sigmoid,
+    lambda d, l: (jax.nn.sigmoid(d) - l.reshape(d.shape)) / d.shape[0])
+
+
+@op("SVMOutput")
+def SVMOutput(data, label, *, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False):
+    """Reference anchor ``SVMOutput``: forward is identity; the hinge
+    gradient flows in backward (custom vjp below)."""
+    return _svm(data, label, float(margin),
+                float(regularization_coefficient), bool(use_linear))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm(data, label, margin, reg, linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg, linear, res, g):
+    data, label = res
+    n, k = data.shape[0], data.shape[-1]
+    lab = label.astype(jnp.int32).reshape(n)
+    onehot = jax.nn.one_hot(lab, k, dtype=data.dtype)
+    score_y = jnp.sum(data * onehot, axis=-1, keepdims=True)
+    viol = (data - score_y + margin) > 0               # margin violations
+    viol = jnp.logical_and(viol, onehot == 0)
+    if linear:
+        dwrong = jnp.where(viol, 1.0, 0.0)
+    else:  # squared hinge
+        dwrong = jnp.where(viol, 2.0 * (data - score_y + margin), 0.0)
+    dright = -jnp.sum(dwrong, axis=-1, keepdims=True) * onehot
+    d = (dwrong * (1 - onehot) + dright) * reg
+    return d.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm.defvjp(_svm_fwd, _svm_bwd)
+
+
+# --------------------------------------------------------------------------- #
+# legacy indexing
+# --------------------------------------------------------------------------- #
+
+@op("choose_element_0index")
+def choose_element_0index(data, index):
+    """Reference anchor ``choose_element_0index`` — row-wise pick:
+    out[i] = data[i, index[i]]."""
+    idx = index.astype(jnp.int32).reshape(-1)
+    return jnp.take_along_axis(
+        data, idx[:, None], axis=-1)[:, 0]
+
+
+@op("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (reference anchor)."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs.reshape(-1).astype(lhs.dtype))
+
+
+# --------------------------------------------------------------------------- #
+# standalone activation ops
+# --------------------------------------------------------------------------- #
+
+@op("gelu")
+def gelu(data, *, approximation="erf"):
+    return jax.nn.gelu(data, approximate=approximation != "erf")
+
+
+@op("selu")
+def selu(data):
+    return jax.nn.selu(data)
+
+
+@op("elu")
+def elu(data, *, alpha=1.0):
+    return jax.nn.elu(data, alpha=alpha)
+
+
+@op("prelu")
+def prelu(data, gamma):
+    shape = [1] * data.ndim
+    if gamma.ndim and data.ndim > 1:
+        shape[1] = gamma.shape[0] if gamma.shape else 1
+    return jnp.where(data >= 0, data,
+                     data * gamma.reshape(shape).astype(data.dtype))
+
+
+@op("erfc")
+def erfc(data):
+    return jax.scipy.special.erfc(data)
+
+
+@op("logit")
+def logit(data, *, eps=None):
+    x = jnp.clip(data, eps, 1 - eps) if eps else data
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+# --------------------------------------------------------------------------- #
+# fused softmax cross-entropy (reference op ``softmax_cross_entropy``)
+# --------------------------------------------------------------------------- #
+
+@op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Scalar summed CE over the batch: -sum_i log softmax(data)_i[label_i]
+    (reference op semantics: sparse labels, sum reduction)."""
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lab = label.astype(jnp.int32).reshape(-1)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# --------------------------------------------------------------------------- #
+# optimizer update ops
+# --------------------------------------------------------------------------- #
+
+@op("group_adagrad_update")
+def group_adagrad_update(weight, grad, history, *, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Contrib GroupAdaGrad (reference ``_contrib_group_adagrad_update``):
+    one accumulator per ROW (group) instead of per element."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    red = tuple(range(1, g.ndim))
+    new_hist = history + jnp.mean(g * g, axis=red, keepdims=True) \
+        if g.ndim > 1 else history + g * g
+    upd = lr * g / (jnp.sqrt(new_hist) + epsilon)
+    return (weight.astype(jnp.float32) - upd).astype(weight.dtype), \
+        new_hist.astype(history.dtype)
+
+
+@op("lans_update")
+def lans_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-6, t=1, wd=0.0, rescale_grad=1.0):
+    """LANS (LAMB with per-step gradient normalization; reference contrib
+    ``_contrib_lans_update`` family, one fused op here)."""
+    w32 = weight.astype(jnp.float32)
+    g = grad.astype(jnp.float32) * rescale_grad
+    g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)     # normalized grad
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * g * g
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    update = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w32
+    wnorm = jnp.linalg.norm(w32)
+    unorm = jnp.linalg.norm(update)
+    trust = jnp.where(jnp.logical_and(wnorm > 0, unorm > 0),
+                      wnorm / unorm, 1.0)
+    return (w32 - lr * trust * update).astype(weight.dtype), \
+        m.astype(mean.dtype), v.astype(var.dtype)
+
+
+@op("rnn_param_concat", variadic=True)
+def rnn_param_concat(*arrays, dim=0):
+    """Reference anchor ``_rnn_param_concat``: flatten + concat the RNN
+    weight list into the fused parameter vector."""
+    return jnp.concatenate([a.reshape(-1) if dim == 0 else a
+                            for a in arrays], axis=0)
+
+
+# legacy alternate names (SwapAxis already aliased in ops/defs.py)
+alias("stop_gradient", "BlockGrad")
+alias("crop", "slice")
+alias("_contrib_group_adagrad_update", "group_adagrad_update")

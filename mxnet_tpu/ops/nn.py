@@ -277,8 +277,26 @@ def _BatchNormStats(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
     bshape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # ONE-PASS stats: E[x-s] and E[(x-s)²] are sibling reductions over
+        # the same read, which XLA fuses into a single HBM pass (vs
+        # mean-then-var = two full passes — measured 2x BN-stat traffic on
+        # the ResNet-50 step).  The per-channel shift s = moving_mean is
+        # the standard shifted-data guard against E[x²]-E[x]² catastrophic
+        # cancellation: after warm-up s tracks the true mean, so the
+        # squared terms stay O(var) instead of O(mean²).  f32 accumulation
+        # for bf16 inputs.
+        x32 = data.astype(jnp.float32) if data.dtype in (
+            jnp.float16, jnp.bfloat16) else data
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        shift = lax.stop_gradient(moving_mean).astype(
+            jnp.float32).reshape(bshape)
+        d = x32 - shift
+        s1 = jnp.sum(d, axis=red) / n
+        s2 = jnp.sum(d * d, axis=red) / n
+        mean = (shift.reshape(-1) + s1).astype(moving_mean.dtype)
+        var = jnp.maximum(s2 - s1 * s1, 0.0).astype(moving_var.dtype)
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)
     else:

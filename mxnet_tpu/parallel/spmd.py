@@ -195,30 +195,23 @@ class SPMDTrainer:
         (running-stat) updates."""
         from ..ndarray.ndarray import NDArray
         from ..gluon.block import _no_hybrid, _trace_state
+        from ..gluon.parameter import params_swapped
         from .. import autograd, random as mxrandom
 
         all_params = self._train_params + self._frozen_params
         all_vals = list(train_vals) + list(frozen_vals)
-        saved = [(p._data._data, p._data._autograd_node,
-                  p._data._autograd_idx) for p in all_params]
         aux: OrderedDict = OrderedDict()
         _trace_state.stack.append(aux)
         mxrandom.push_trace_key(key)
         try:
-            for p, v in zip(all_params, all_vals):
-                p._data._data = v
-                p._data._autograd_node = None
-            with autograd.pause(train_mode=True), _no_hybrid():
+            with params_swapped(all_params, all_vals), \
+                    autograd.pause(train_mode=True), _no_hybrid():
                 out = self._block(NDArray(data))
                 out0 = out[0] if isinstance(out, (list, tuple)) else out
                 loss = self._loss_fn(out0, NDArray(label))
                 loss_val = jnp.mean(loss._data if isinstance(loss, NDArray)
                                     else loss)
         finally:
-            for p, (v, node, idx) in zip(all_params, saved):
-                p._data._data = v
-                p._data._autograd_node = node
-                p._data._autograd_idx = idx
             mxrandom.pop_trace_key()
             _trace_state.stack.pop()
         aux_out.append([(p, jax.lax.stop_gradient(v))

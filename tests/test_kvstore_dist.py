@@ -186,3 +186,45 @@ class TestLauncher:
         vals = {m.group(1) for m in
                 re.finditer(r"SPMD_OK loss=([\d.]+)", out.stdout)}
         assert len(vals) == 1, f"ranks disagree: {vals}"
+
+    def test_two_process_bucketed_pushpull(self, tmp_path):
+        """A key-list pushpull on a dist store must coalesce into one
+        AllReduce per dtype (bucketing) and still sum correctly across
+        processes — including mixed dtypes and an fp misaligned tail."""
+        script = tmp_path / "bucket_prog.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import mxnet_tpu as mx\n"
+            "from mxnet_tpu.parallel import init_distributed\n"
+            "init_distributed()\n"
+            "import jax, numpy as onp\n"
+            "rank = jax.process_index()\n"
+            "kv = mx.kv.create('dist_sync')\n"
+            "keys = ['a', 'b', 'c']\n"
+            "shapes = [(3,), (2, 2), (5,)]\n"
+            "dts = ['float32', 'float16', 'float32']\n"
+            "for k, s, dt in zip(keys, shapes, dts):\n"
+            "    kv.init(k, mx.nd.zeros(s, dtype=dt))\n"
+            "vals = [mx.nd.array(onp.full(s, float(rank + 1), dt))\n"
+            "        for s, dt in zip(shapes, dts)]\n"
+            "outs = [mx.nd.zeros(s, dtype=dt)\n"
+            "        for s, dt in zip(shapes, dts)]\n"
+            "kv.pushpull(keys, vals, out=outs)\n"
+            "for s, dt, o in zip(shapes, dts, outs):\n"
+            "    assert str(o.dtype) == dt, (dt, o.dtype)\n"
+            "    onp.testing.assert_allclose(\n"
+            "        o.asnumpy().astype('float32'), onp.full(s, 3.0))\n"
+            "kv.barrier()\n"
+            "print('RANK%d_BUCKET_OK' % rank, flush=True)\n")
+        import os
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "2", "--launcher",
+             "local", sys.executable, str(script)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=300)
+        assert out.returncode == 0, (out.stdout[-800:], out.stderr[-2000:])
+        assert "RANK0_BUCKET_OK" in out.stdout
+        assert "RANK1_BUCKET_OK" in out.stdout

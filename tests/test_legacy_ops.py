@@ -1,0 +1,214 @@
+"""Round-3 operator-corpus expansion: im2col/col2im, Module-era output
+heads, legacy indexing, standalone activations, LANS/GroupAdaGrad
+(SURVEY.md §3.1 operator corpus; golden + gradient tests per the
+reference test model)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _rand(*shape):
+    return onp.random.RandomState(0).randn(*shape).astype("float32")
+
+
+class TestIm2Col:
+    def test_im2col_golden(self):
+        x = onp.arange(2 * 3 * 5 * 5, dtype=onp.float32).reshape(2, 3, 5, 5)
+        out = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1),
+                        pad=(0, 0)).asnumpy()
+        assert out.shape == (2, 3 * 9, 9)
+        # golden: manual patch extraction at position (0,0) and (2,2)
+        patch00 = x[0, :, 0:3, 0:3].reshape(-1)
+        onp.testing.assert_allclose(out[0, :, 0], patch00)
+        patch22 = x[0, :, 2:5, 2:5].reshape(-1)
+        onp.testing.assert_allclose(out[0, :, 8], patch22)
+
+    def test_im2col_stride_pad(self):
+        x = _rand(1, 2, 6, 6)
+        out = nd.im2col(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                        pad=(1, 1)).asnumpy()
+        assert out.shape == (1, 18, 9)
+        xp = onp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        onp.testing.assert_allclose(
+            out[0, :, 0], xp[0, :, 0:3, 0:3].reshape(-1), rtol=1e-6)
+
+    def test_col2im_inverts_nonoverlapping(self):
+        x = _rand(2, 3, 6, 6)
+        cols = nd.im2col(nd.array(x), kernel=(2, 2), stride=(2, 2))
+        back = nd.col2im(cols, output_size=(6, 6), kernel=(2, 2),
+                         stride=(2, 2)).asnumpy()
+        onp.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_col2im_accumulates_overlap(self):
+        x = onp.ones((1, 1, 4, 4), onp.float32)
+        cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1))
+        back = nd.col2im(cols, output_size=(4, 4), kernel=(3, 3),
+                         stride=(1, 1)).asnumpy()
+        # center pixels belong to 4 overlapping 3x3 patches
+        assert back[0, 0, 1, 1] == 4.0
+        assert back[0, 0, 0, 0] == 1.0
+
+    def test_conv_via_im2col_matches_convolution(self):
+        """im2col + GEMM == Convolution (the reference's CPU conv path)."""
+        x = _rand(2, 3, 8, 8)
+        w = _rand(4, 3, 3, 3)
+        ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             num_filter=4, no_bias=True, pad=(1, 1))
+        cols = nd.im2col(nd.array(x), kernel=(3, 3), pad=(1, 1))
+        gemm = onp.einsum("ok,nkl->nol", w.reshape(4, -1), cols.asnumpy())
+        onp.testing.assert_allclose(gemm.reshape(ref.shape), ref.asnumpy(),
+                                    rtol=1e-4, atol=1e-4)
+
+
+class TestOutputHeads:
+    def test_linear_regression_grad(self):
+        d = nd.array(_rand(4, 3))
+        lab = nd.array(_rand(4, 3))
+        d.attach_grad()
+        with autograd.record():
+            out = nd.LinearRegressionOutput(d, lab)
+        out.backward()
+        onp.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+        onp.testing.assert_allclose(
+            d.grad.asnumpy(), (d.asnumpy() - lab.asnumpy()) / 4, rtol=1e-5)
+
+    def test_logistic_regression_grad(self):
+        d = nd.array(_rand(5, 2))
+        lab = nd.array((onp.random.RandomState(1).rand(5, 2) > 0.5)
+                       .astype("float32"))
+        d.attach_grad()
+        with autograd.record():
+            out = nd.LogisticRegressionOutput(d, lab)
+        out.backward()
+        sig = 1 / (1 + onp.exp(-d.asnumpy()))
+        onp.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+        onp.testing.assert_allclose(d.grad.asnumpy(),
+                                    (sig - lab.asnumpy()) / 5, rtol=1e-5)
+
+    def test_mae_regression_grad(self):
+        d = nd.array(_rand(3, 2))
+        lab = nd.array(onp.zeros((3, 2), "float32"))
+        d.attach_grad()
+        with autograd.record():
+            out = nd.MAERegressionOutput(d, lab)
+        out.backward()
+        onp.testing.assert_allclose(d.grad.asnumpy(),
+                                    onp.sign(d.asnumpy()) / 3, rtol=1e-5)
+
+    def test_svm_output_grad_squared_hinge(self):
+        d = nd.array(onp.asarray([[2.0, 1.5, -1.0]], "float32"))
+        lab = nd.array(onp.asarray([0.0], "float32"))
+        d.attach_grad()
+        with autograd.record():
+            out = nd.SVMOutput(d, lab, margin=1.0)
+        out.backward()
+        onp.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+        g = d.grad.asnumpy()[0]
+        # class 1 violates the margin (1.5 - 2 + 1 = 0.5 > 0): grad 2*0.5
+        assert g[1] == pytest.approx(1.0)
+        assert g[2] == pytest.approx(0.0)      # no violation
+        assert g[0] == pytest.approx(-1.0)     # minus the sum
+
+
+class TestLegacyIndexing:
+    def test_choose_element(self):
+        d = nd.array(_rand(4, 5))
+        idx = nd.array(onp.asarray([0, 2, 4, 1], "float32"))
+        out = nd.choose_element_0index(d, idx).asnumpy()
+        expect = d.asnumpy()[onp.arange(4), [0, 2, 4, 1]]
+        onp.testing.assert_allclose(out, expect)
+
+    def test_fill_element(self):
+        d = nd.array(onp.zeros((3, 4), "float32"))
+        vals = nd.array(onp.asarray([7.0, 8.0, 9.0], "float32"))
+        idx = nd.array(onp.asarray([1, 0, 3], "float32"))
+        out = nd.fill_element_0index(d, vals, idx).asnumpy()
+        assert out[0, 1] == 7 and out[1, 0] == 8 and out[2, 3] == 9
+        assert out.sum() == 24
+
+
+class TestActivationOps:
+    @pytest.mark.parametrize("name,ref", [
+        ("selu", lambda x: 1.0507009873554805 * onp.where(
+            x > 0, x, 1.6732632423543772 * (onp.exp(x) - 1))),
+        ("erfc", lambda x: 1 - onp.vectorize(__import__("math").erf)(x)),
+    ])
+    def test_golden(self, name, ref):
+        x = _rand(3, 4)
+        out = getattr(nd, name)(nd.array(x)).asnumpy()
+        onp.testing.assert_allclose(out, ref(x), rtol=1e-5, atol=1e-6)
+
+    def test_elu_prelu(self):
+        x = _rand(2, 3)
+        out = nd.elu(nd.array(x), alpha=0.5).asnumpy()
+        onp.testing.assert_allclose(
+            out, onp.where(x > 0, x, 0.5 * (onp.exp(x) - 1)), rtol=1e-5)
+        g = nd.array(onp.asarray([0.1, 0.2, 0.3], "float32"))
+        out = nd.prelu(nd.array(x), g).asnumpy()
+        onp.testing.assert_allclose(
+            out, onp.where(x >= 0, x, x * onp.asarray([0.1, 0.2, 0.3])),
+            rtol=1e-5)
+
+    def test_logit_inverts_sigmoid(self):
+        p = onp.asarray([0.1, 0.5, 0.9], "float32")
+        out = nd.logit(nd.array(p)).asnumpy()
+        onp.testing.assert_allclose(1 / (1 + onp.exp(-out)), p, rtol=1e-5)
+
+    def test_gelu_matches_erf_form(self):
+        from math import erf, sqrt
+        x = _rand(5)
+        out = nd.gelu(nd.array(x)).asnumpy()
+        ref = onp.asarray([0.5 * v * (1 + erf(v / sqrt(2))) for v in x],
+                          "float32")
+        onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestMiscOps:
+    def test_softmax_cross_entropy_golden(self):
+        d = _rand(4, 6)
+        lab = onp.asarray([0, 3, 5, 2], "float32")
+        out = float(nd.softmax_cross_entropy(
+            nd.array(d), nd.array(lab)).asnumpy())
+        e = onp.exp(d - d.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -sum(onp.log(p[i, int(lab[i])]) for i in range(4))
+        assert out == pytest.approx(ref, rel=1e-4)
+
+    def test_group_adagrad_row_groups(self):
+        w = nd.array(onp.ones((3, 4), "float32"))
+        g = nd.array(_rand(3, 4))
+        h = nd.array(onp.zeros((3, 1), "float32"))
+        nw, nh = nd.group_adagrad_update(w, g, h, lr=0.1)
+        assert nh.shape == (3, 1)
+        expect_h = (g.asnumpy() ** 2).mean(axis=1, keepdims=True)
+        onp.testing.assert_allclose(nh.asnumpy(), expect_h, rtol=1e-5)
+        expect_w = 1 - 0.1 * g.asnumpy() / (onp.sqrt(expect_h) + 1e-5)
+        onp.testing.assert_allclose(nw.asnumpy(), expect_w, rtol=1e-5)
+
+    def test_lans_update_moves_weights(self):
+        w = nd.array(_rand(8, 8))
+        g = nd.array(_rand(8, 8))
+        m = nd.array(onp.zeros((8, 8), "float32"))
+        v = nd.array(onp.zeros((8, 8), "float32"))
+        nw, nm, nv = nd.lans_update(w, g, m, v, lr=0.01, t=1)
+        assert not onp.allclose(nw.asnumpy(), w.asnumpy())
+        assert onp.isfinite(nw.asnumpy()).all()
+        # trust-ratio scaling keeps the step bounded
+        assert onp.linalg.norm(nw.asnumpy() - w.asnumpy()) < \
+            0.05 * onp.linalg.norm(w.asnumpy())
+
+    def test_rnn_param_concat(self):
+        a = nd.array(_rand(2, 3))
+        b = nd.array(_rand(4,))
+        out = nd.rnn_param_concat([a, b], dim=0)
+        assert out.shape == (10,)
+
+    def test_aliases(self):
+        x = nd.array(_rand(2, 3))
+        onp.testing.assert_allclose(nd.SwapAxis(x, dim1=0, dim2=1).asnumpy(),
+                                    x.asnumpy().T)
+        onp.testing.assert_allclose(
+            nd.crop(x, begin=(0, 1), end=(2, 3)).asnumpy(),
+            x.asnumpy()[:, 1:3])

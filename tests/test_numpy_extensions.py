@@ -1,0 +1,150 @@
+"""mx.np breadth extensions (round-3): golden tests vs host numpy."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+np = mx.np
+
+
+def _r(*s):
+    return onp.random.RandomState(0).randn(*s).astype("float32")
+
+
+class TestAliases:
+    def test_numpy2_names(self):
+        x = _r(5)
+        onp.testing.assert_allclose(np.acos(np.array(x * 0.1)).asnumpy(),
+                                    onp.arccos(x * 0.1), rtol=1e-5)
+        onp.testing.assert_allclose(
+            np.atan2(np.array(x), np.array(x + 1)).asnumpy(),
+            onp.arctan2(x, x + 1), rtol=1e-5)
+        onp.testing.assert_allclose(
+            np.pow(np.array(abs(x)), np.array(2.0)).asnumpy(),
+            onp.abs(x) ** 2, rtol=1e-5)
+        onp.testing.assert_allclose(
+            np.permute_dims(np.array(_r(2, 3, 4)), (2, 0, 1)).shape,
+            (4, 2, 3))
+
+    def test_concat(self):
+        a, b = _r(2, 3), _r(1, 3)
+        out = np.concat([np.array(a), np.array(b)], axis=0)
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    onp.concatenate([a, b]), rtol=1e-6)
+
+
+class TestStructured:
+    def test_cov_vander_trapezoid(self):
+        x = _r(3, 8)
+        onp.testing.assert_allclose(np.cov(np.array(x)).asnumpy(),
+                                    onp.cov(x), rtol=1e-4, atol=1e-5)
+        v = _r(4)
+        onp.testing.assert_allclose(np.vander(np.array(v)).asnumpy(),
+                                    onp.vander(v), rtol=1e-4)
+        y = _r(9)
+        onp.testing.assert_allclose(
+            float(np.trapezoid(np.array(y)).asnumpy()),
+            onp.trapezoid(y) if hasattr(onp, "trapezoid")
+            else onp.trapz(y), rtol=1e-5)
+
+    def test_partition_lexsort(self):
+        x = _r(10)
+        out = np.partition(np.array(x), 4).asnumpy()
+        assert (out[:4] <= out[4]).all() and (out[5:] >= out[4]).all()
+        a = onp.asarray([1, 1, 2, 2], "float32")
+        b = onp.asarray([3.0, 1.0, 2.0, 0.0], "float32")
+        idx = np.lexsort([np.array(b), np.array(a)]).asnumpy()
+        onp.testing.assert_array_equal(idx, onp.lexsort([b, a]))
+
+    def test_select_choose_compress(self):
+        x = _r(6)
+        out = np.select([np.array(x > 0), np.array(x <= 0)],
+                        [np.array(x), np.array(-x)])
+        onp.testing.assert_allclose(out.asnumpy(), onp.abs(x), rtol=1e-6)
+        idx = onp.asarray([0, 1, 0], "int32")
+        out = np.choose(np.array(idx),
+                        [np.array(_r(3)), np.array(_r(3) + 10)])
+        assert out.shape == (3,)
+        out = np.compress(onp.asarray([True, False, True]),
+                          np.array(_r(3, 2)), axis=0)
+        assert out.shape == (2, 2)
+
+    def test_put_along_axis_fill_diagonal(self):
+        a = np.array(onp.zeros((3, 3), "float32"))
+        idx = np.array(onp.asarray([[0], [1], [2]], "int64"))
+        vals = np.array(onp.ones((3, 1), "float32"))
+        out = np.put_along_axis(a, idx, vals, 1).asnumpy()
+        onp.testing.assert_allclose(out, onp.eye(3), rtol=1e-6)
+        out = np.fill_diagonal(a, 5.0).asnumpy()
+        onp.testing.assert_allclose(out, 5 * onp.eye(3), rtol=1e-6)
+
+    def test_divmod_modf_frexp(self):
+        x = onp.asarray([5.5, -2.25], "float32")
+        q, r = np.divmod(np.array(x), np.array(2.0))
+        onp.testing.assert_allclose(q.asnumpy(), [2, -2])
+        onp.testing.assert_allclose(r.asnumpy(), [1.5, 1.75])
+        frac, whole = np.modf(np.array(x))
+        onp.testing.assert_allclose(frac.asnumpy(), [0.5, -0.25])
+        m, e = np.frexp(np.array(onp.asarray([8.0], "float32")))
+        assert float(m.asnumpy()) == 0.5 and int(e.asnumpy()) == 4
+
+    def test_unwrap_apply_along_axis(self):
+        ph = onp.asarray([0, 1, 2, -2.5, -1.0], "float32") * onp.pi
+        onp.testing.assert_allclose(np.unwrap(np.array(ph)).asnumpy(),
+                                    onp.unwrap(ph), rtol=1e-5)
+        import jax.numpy as jnp
+        out = np.apply_along_axis(lambda r: r.sum(), 1,
+                                  np.array(_r(3, 4)))
+        assert out.shape == (3,)
+
+    def test_block_geomspace(self):
+        a = np.array(onp.ones((2, 2), "float32"))
+        out = np.block([[a, a], [a, a]])
+        assert out.shape == (4, 4)
+        g = np.geomspace(1, 1000, 4).asnumpy()
+        onp.testing.assert_allclose(g, [1, 10, 100, 1000], rtol=1e-4)
+
+
+class TestSetOps:
+    def test_isin_and_friends(self):
+        a = onp.asarray([1, 2, 3, 4], "int32")
+        b = onp.asarray([2, 4, 6], "int32")
+        onp.testing.assert_array_equal(
+            np.isin(np.array(a), np.array(b)).asnumpy(),
+            [False, True, False, True])
+        onp.testing.assert_array_equal(
+            np.intersect1d(np.array(a), np.array(b)).asnumpy(), [2, 4])
+        onp.testing.assert_array_equal(
+            np.union1d(np.array(a), np.array(b)).asnumpy(),
+            [1, 2, 3, 4, 6])
+        onp.testing.assert_array_equal(
+            np.setdiff1d(np.array(a), np.array(b)).asnumpy(), [1, 3])
+        onp.testing.assert_array_equal(
+            np.setxor1d(np.array(a), np.array(b)).asnumpy(), [1, 3, 6])
+
+    def test_unique_family(self):
+        a = onp.asarray([3, 1, 3, 2, 1], "int32")
+        onp.testing.assert_array_equal(
+            np.unique_values(np.array(a)).asnumpy(), [1, 2, 3])
+        vals, counts = np.unique_counts(np.array(a))
+        onp.testing.assert_array_equal(counts.asnumpy(), [2, 1, 2])
+
+
+class TestIntrospection:
+    def test_dtype_helpers(self):
+        assert np.finfo("float32").eps == onp.finfo("float32").eps
+        assert np.iinfo("int32").max == 2**31 - 1
+        assert np.issubdtype(onp.float32, onp.floating)
+        assert np.promote_types("float32", "float64") == onp.float64
+        assert np.broadcast_shapes((2, 1), (1, 3)) == (2, 3)
+        assert np.isscalar(3.0) and not np.isscalar([3.0])
+
+    def test_isreal_obj(self):
+        x = np.array(_r(3))
+        assert np.isrealobj(x) and not np.iscomplexobj(x)
+        onp.testing.assert_array_equal(np.isreal(x).asnumpy(),
+                                       [True, True, True])
+
+    def test_array_equiv_astype(self):
+        a = np.array(onp.ones((2, 2), "float32"))
+        assert np.array_equiv(a, np.array(onp.ones((2, 2), "float32")))
+        assert np.astype(a, "int32").asnumpy().dtype == onp.int32
