@@ -44,6 +44,23 @@ def main():
                       "platform": platform}))
     sys.stdout.flush()
 
+    # batch-1 latency (interactive serving).  The compiled scan runs
+    # P-1 teacher-forced prefill steps + N decode steps, all timed —
+    # divide by the actual step count so ms_per_token is the per-position
+    # step latency, and report prefill separately via new-token rate.
+    p1 = prompt[:1]
+    steps = P - 1 + N
+    kv_generate(net, p1, max_new_tokens=N, temperature=0.0)  # compile
+    t0 = time.perf_counter()
+    kv_generate(net, p1, max_new_tokens=N, temperature=0.0)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"bench": "decode", "mode": "kv_cache_batch1",
+                      "new_tokens_per_sec": round(N / dt, 1),
+                      "ms_per_token": round(dt / steps * 1e3, 3),
+                      "batch": 1, "new_tokens": N, "prompt": P,
+                      "platform": platform}))
+    sys.stdout.flush()
+
     # full-recompute path (the reference-style loop); fewer tokens — it
     # retraces per length and does O(L^2) work
     n2 = min(N, 16)

@@ -117,11 +117,17 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        if self._sparse_label:
+        if self._sparse_label and not self._from_logits:
+            # fused path: lse - pred[label], f32 math inside the
+            # reductions — never materializes f32 log-probs (the separate
+            # log_softmax+pick path cost an (N, V) f32 convert per step
+            # on big-vocab heads)
+            loss = F._sparse_softmax_ce(pred, label, axis=self._axis)
+        elif self._sparse_label:
             loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
         else:
+            if not self._from_logits:
+                pred = F.log_softmax(pred, axis=self._axis)
             label = _reshape_like(F, label, pred)
             loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)

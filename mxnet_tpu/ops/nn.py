@@ -99,6 +99,30 @@ def log_softmax(data, *, axis=-1, temperature=None):
     return jax.nn.log_softmax(x, axis=axis)
 
 
+@op("_sparse_softmax_ce")
+def _sparse_softmax_ce(pred, label, *, axis=-1):
+    """Fused sparse-label softmax cross-entropy: per-element
+    ``lse(pred) - pred[label]`` with keepdims on the class axis.
+
+    The f32 math happens INSIDE the reductions (max + sum-of-exp chains
+    XLA fuses into loop fusions), so no (N, V) f32 logits array is ever
+    materialized — on the BERT MLM head that materialized convert alone
+    was 1.5 ms/step (3% of the step).  The autodiff backward is
+    ``softmax - onehot`` recomputed elementwise from the bf16 logits."""
+    ax = axis % pred.ndim
+    m = jnp.max(pred, axis=ax, keepdims=True)
+    z = jnp.exp(pred.astype(jnp.float32) - m.astype(jnp.float32))
+    lse = m.astype(jnp.float32) + jnp.log(
+        jnp.sum(z, axis=ax, keepdims=True))
+    lab = jnp.expand_dims(label.astype(jnp.int32), ax) \
+        if label.ndim == pred.ndim - 1 else label.astype(jnp.int32)
+    # clamp like the pick path (mxnet 'clip' mode): ignore/pad labels
+    # outside [0, V) must not produce NaN/wrapped gathers
+    lab = jnp.clip(lab, 0, pred.shape[ax] - 1)
+    picked = jnp.take_along_axis(pred, lab, axis=ax).astype(jnp.float32)
+    return (lse - picked).astype(pred.dtype)
+
+
 @op("softmin")
 def softmin(data, *, axis=-1):
     return jax.nn.softmax(-data, axis=axis)
