@@ -8,3 +8,5 @@ from . import linalg
 from . import optimizer_ops
 from . import extended
 from . import legacy
+from . import image_ops
+from . import samplers

@@ -1,0 +1,152 @@
+"""``mx.nd.image.*`` registered operators.
+
+Reference surface: ``src/operator/image/`` (``_image_to_tensor``,
+``_image_normalize``, ``_image_resize``, ``_image_crop``,
+``_image_flip_left_right`` / ``_image_flip_top_bottom``,
+``_image_random_*`` — SURVEY.md §3.1 operator corpus + §3.2 "io /
+recordio / image" row).  Layout follows the reference: HWC or NHWC uint8/
+float input; ``to_tensor`` converts to CHW float scaled to [0, 1].
+
+These are device ops (jnp) — the host-side pipeline augmenters live in
+``mxnet_tpu/image/image.py``; both exist in the reference too.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+__all__ = ["image_to_tensor", "image_normalize", "image_resize",
+           "image_crop", "image_flip_left_right", "image_flip_top_bottom",
+           "image_random_flip_left_right", "image_random_flip_top_bottom",
+           "image_random_brightness", "image_random_contrast",
+           "image_random_saturation"]
+
+
+def _is_batch(data):
+    return data.ndim == 4
+
+
+@op("_image_to_tensor")
+def image_to_tensor(data):
+    """HWC [0,255] -> CHW float32 [0,1] (reference ``ToTensor``)."""
+    x = data.astype(jnp.float32) / 255.0
+    if _is_batch(data):
+        return jnp.transpose(x, (0, 3, 1, 2))
+    return jnp.transpose(x, (2, 0, 1))
+
+
+@op("_image_normalize")
+def image_normalize(data, *, mean=(0.0,), std=(1.0,)):
+    """CHW (or NCHW) channel-wise (x - mean) / std.  Float input only
+    (the reference op errors on integer input — a silent uint8 cast-back
+    would saturate to garbage)."""
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        raise TypeError(
+            f"image_normalize: float input required, got {data.dtype} "
+            "(run image.to_tensor first)")
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    c_axis = 1 if _is_batch(data) else 0
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    return ((data.astype(jnp.float32) - mean.reshape(shape))
+            / std.reshape(shape)).astype(data.dtype)
+
+
+@op("_image_resize")
+def image_resize(data, *, size=None, keep_ratio=False, interp=1):
+    """HWC/NHWC resize (bilinear for interp=1, nearest for 0).
+    ``keep_ratio=True`` with an int ``size`` resizes the SHORTER edge to
+    ``size`` (reference semantics), preserving aspect ratio."""
+    method = "nearest" if interp == 0 else "bilinear"
+    in_h = data.shape[-3]
+    in_w = data.shape[-2]
+    if isinstance(size, int):
+        if keep_ratio:
+            if in_h < in_w:
+                h, w = size, max(1, round(in_w * size / in_h))
+            else:
+                h, w = max(1, round(in_h * size / in_w)), size
+        else:
+            h = w = size
+    else:
+        w, h = size  # reference passes (w, h)
+    if _is_batch(data):
+        shape = (data.shape[0], h, w, data.shape[3])
+    else:
+        shape = (h, w, data.shape[2])
+    return jax.image.resize(data.astype(jnp.float32), shape,
+                            method=method).astype(data.dtype)
+
+
+@op("_image_crop")
+def image_crop(data, *, x=0, y=0, width=1, height=1):
+    if _is_batch(data):
+        return data[:, y:y + height, x:x + width, :]
+    return data[y:y + height, x:x + width, :]
+
+
+@op("_image_flip_left_right")
+def image_flip_left_right(data):
+    return jnp.flip(data, axis=-2)
+
+
+@op("_image_flip_top_bottom")
+def image_flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)
+
+
+def _coin(seed_like):
+    from .. import random as mxrandom
+    return jax.random.bernoulli(mxrandom.next_key())
+
+
+@op("_image_random_flip_left_right", differentiable=False)
+def image_random_flip_left_right(data):
+    return jnp.where(_coin(data), jnp.flip(data, axis=-2), data)
+
+
+@op("_image_random_flip_top_bottom", differentiable=False)
+def image_random_flip_top_bottom(data):
+    return jnp.where(_coin(data), jnp.flip(data, axis=-3), data)
+
+
+def _rand_factor(lo, hi):
+    from .. import random as mxrandom
+    return jax.random.uniform(mxrandom.next_key(), (), jnp.float32,
+                              lo, hi)
+
+
+def _photometric_dtype(data, x):
+    """Float inputs keep their dtype; integer inputs return float32 (a
+    cast back to uint8 would silently saturate)."""
+    return x.astype(data.dtype) if jnp.issubdtype(
+        data.dtype, jnp.floating) else x
+
+
+@op("_image_random_brightness", differentiable=False)
+def image_random_brightness(data, *, min_factor=0.5, max_factor=1.5):
+    f = _rand_factor(min_factor, max_factor)
+    return _photometric_dtype(data, data.astype(jnp.float32) * f)
+
+
+@op("_image_random_contrast", differentiable=False)
+def image_random_contrast(data, *, min_factor=0.5, max_factor=1.5):
+    f = _rand_factor(min_factor, max_factor)
+    x = data.astype(jnp.float32)
+    # PER-IMAGE luminance-mean contrast pivot (reference coefficients)
+    coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    gray = jnp.mean(jnp.tensordot(x, coef, axes=([-1], [0])),
+                    axis=(-2, -1), keepdims=True)[..., None]
+    return _photometric_dtype(data, gray * (1 - f) + x * f)
+
+
+@op("_image_random_saturation", differentiable=False)
+def image_random_saturation(data, *, min_factor=0.5, max_factor=1.5):
+    f = _rand_factor(min_factor, max_factor)
+    x = data.astype(jnp.float32)
+    coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    gray = jnp.tensordot(x, coef, axes=([-1], [0]))[..., None]
+    return _photometric_dtype(data, gray * (1 - f) + x * f)

@@ -1,0 +1,389 @@
+"""Registered random-sampling + remaining fused-optimizer + quantized ops.
+
+Reference families (SURVEY.md §3.1 operator corpus):
+- ``_random_*`` ops (``random_uniform``...): tensor-shaped draws with
+  scalar parameters.
+- ``sample_*`` ops: PER-ROW parameter arrays — ``sample_normal(mu, sigma,
+  shape=(s,))`` draws ``s`` values for every element of ``mu``.
+- ``preloaded_multi_*`` / ``multi_adamw`` / ``multi_lamb`` fused
+  multi-tensor optimizer updates (variadic — whole parameter lists in one
+  op, the reference's ``aggregate_num`` path).
+- int8 ``quantized_*`` inference ops beyond conv/matmul.
+
+RNG keys come from ``mxnet_tpu.random`` (seeded, trace-aware), matching
+the reference's per-device RNG resource (anchor
+``ResourceRequest::kRandom``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import alias, op
+
+__all__: list = []
+
+
+def _key():
+    from .. import random as mxrandom
+    return mxrandom.next_key()
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+# --------------------------------------------------------------------------- #
+# _random_* (scalar-parameter draws)
+# --------------------------------------------------------------------------- #
+
+@op("_random_uniform", differentiable=False)
+def _random_uniform(*, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(_key(), _shape(shape), jnp.dtype(dtype),
+                              low, high)
+
+
+@op("_random_normal", differentiable=False)
+def _random_normal(*, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(_key(), _shape(shape),
+                                           jnp.dtype(dtype))
+
+
+@op("_random_gamma", differentiable=False)
+def _random_gamma(*, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(_key(), alpha, _shape(shape),
+                                   jnp.dtype(dtype))
+
+
+@op("_random_exponential", differentiable=False)
+def _random_exponential(*, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(_key(), _shape(shape),
+                                  jnp.dtype(dtype)) / lam
+
+
+@op("_random_poisson", differentiable=False)
+def _random_poisson(*, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(_key(), lam, _shape(shape)).astype(
+        jnp.dtype(dtype))
+
+
+@op("_random_negative_binomial", differentiable=False)
+def _random_negative_binomial(*, k=1, p=0.5, shape=(), dtype="float32"):
+    g = jax.random.gamma(_key(), k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(_key(), g, _shape(shape)).astype(
+        jnp.dtype(dtype))
+
+
+@op("_random_generalized_negative_binomial", differentiable=False)
+def _random_generalized_negative_binomial(*, mu=1.0, alpha=1.0, shape=(),
+                                          dtype="float32"):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    g = jax.random.gamma(_key(), k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(_key(), g, _shape(shape)).astype(
+        jnp.dtype(dtype))
+
+
+@op("_random_randint", differentiable=False)
+def _random_randint(*, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(_key(), _shape(shape), low, high,
+                              jnp.dtype(dtype))
+
+
+# --------------------------------------------------------------------------- #
+# sample_* (per-element parameter arrays; draws `shape` extra dims)
+# --------------------------------------------------------------------------- #
+
+def _sample(draw, param0, extra_shape):
+    s = _shape(extra_shape)
+    out_shape = tuple(param0.shape) + s
+    return draw(out_shape)
+
+
+@op("sample_uniform", differentiable=False)
+def sample_uniform(low, high, *, shape=(), dtype="float32"):
+    s = tuple(low.shape) + _shape(shape)
+    u = jax.random.uniform(_key(), s, jnp.dtype(dtype))
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    return low[ex] + (high - low)[ex] * u
+
+
+@op("sample_normal", differentiable=False)
+def sample_normal(mu, sigma, *, shape=(), dtype="float32"):
+    s = tuple(mu.shape) + _shape(shape)
+    n = jax.random.normal(_key(), s, jnp.dtype(dtype))
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    return mu[ex] + sigma[ex] * n
+
+
+@op("sample_gamma", differentiable=False)
+def sample_gamma(alpha, beta, *, shape=(), dtype="float32"):
+    s = tuple(alpha.shape) + _shape(shape)
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    a = jnp.broadcast_to(alpha[ex], s)
+    g = jax.random.gamma(_key(), a, s, jnp.dtype(dtype))
+    return g * jnp.broadcast_to(beta[ex], s)
+
+
+@op("sample_exponential", differentiable=False)
+def sample_exponential(lam, *, shape=(), dtype="float32"):
+    s = tuple(lam.shape) + _shape(shape)
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    return jax.random.exponential(_key(), s, jnp.dtype(dtype)) / \
+        jnp.broadcast_to(lam[ex], s)
+
+
+@op("sample_poisson", differentiable=False)
+def sample_poisson(lam, *, shape=(), dtype="float32"):
+    s = tuple(lam.shape) + _shape(shape)
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    return jax.random.poisson(_key(), jnp.broadcast_to(lam[ex], s),
+                              s).astype(jnp.dtype(dtype))
+
+
+@op("sample_multinomial", differentiable=False)
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32"):
+    """Rows of ``data`` are probability vectors; draw ``shape`` samples
+    per row (reference ``sample_multinomial``)."""
+    s = _shape(shape)
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    draws = jax.random.categorical(
+        _key(), logits[..., None, :].repeat(max(n, 1), axis=-2), axis=-1)
+    out = draws.reshape(tuple(data.shape[:-1]) + s) if s else \
+        draws.reshape(tuple(data.shape[:-1]))
+    out = out.astype(jnp.dtype(dtype))
+    if get_prob:
+        p = jnp.take_along_axis(
+            data, out.reshape(tuple(data.shape[:-1]) + (-1,)).astype(
+                jnp.int32), axis=-1).reshape(out.shape)
+        return out, jnp.log(p)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# fused multi-tensor optimizer ops (variadic, reference aggregate path)
+# --------------------------------------------------------------------------- #
+
+def _chunk(args, n_per):
+    n = len(args) // n_per
+    return [args[i * n_per:(i + 1) * n_per] for i in range(n)]
+
+
+@op("multi_adamw_update", variadic=True)
+def multi_adamw_update(*args, lrs, etas, beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, wds=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, step_count=1):
+    """Fused AdamW over N params: args = [w0,g0,m0,v0, w1,g1,m1,v1, ...];
+    returns the updated (w, m, v) triples flattened."""
+    groups = _chunk(list(args), 4)
+    n = len(groups)
+    lrs = lrs if isinstance(lrs, (list, tuple)) else [lrs] * n
+    etas = etas if isinstance(etas, (list, tuple)) else [etas] * n
+    wds = wds if isinstance(wds, (list, tuple)) else [wds] * n
+    outs = []
+    for (w, g, m, v), lr, eta, wd in zip(groups, lrs, etas, wds):
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nm = beta1 * m + (1 - beta1) * g
+        nv = beta2 * v + (1 - beta2) * g * g
+        mhat = nm / (1 - beta1 ** step_count)
+        vhat = nv / (1 - beta2 ** step_count)
+        nw = w.astype(jnp.float32) - eta * (
+            lr * mhat / (jnp.sqrt(vhat) + epsilon) + wd * w.astype(
+                jnp.float32))
+        outs += [nw.astype(w.dtype), nm.astype(m.dtype),
+                 nv.astype(v.dtype)]
+    return tuple(outs)
+
+
+@op("multi_lamb_update", variadic=True)
+def multi_lamb_update(*args, learning_rates, wds=0.0, beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, step_count=1,
+                      rescale_grad=1.0, lower_bound=-1.0,
+                      upper_bound=-1.0, clip_gradient=-1.0,
+                      bias_correction=True):
+    """Fused LAMB over N params (reference ``multi_lamb_update``)."""
+    groups = _chunk(list(args), 4)
+    n = len(groups)
+    lrs = learning_rates if isinstance(learning_rates, (list, tuple)) \
+        else [learning_rates] * n
+    wds = wds if isinstance(wds, (list, tuple)) else [wds] * n
+    outs = []
+    for (w, g, m, v), lr, wd in zip(groups, lrs, wds):
+        w32 = w.astype(jnp.float32)
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nm = beta1 * m + (1 - beta1) * g
+        nv = beta2 * v + (1 - beta2) * g * g
+        if bias_correction:
+            mhat = nm / (1 - beta1 ** step_count)
+            vhat = nv / (1 - beta2 ** step_count)
+        else:
+            mhat, vhat = nm, nv
+        upd = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w32
+        wnorm = jnp.linalg.norm(w32)
+        if lower_bound > 0:
+            wnorm = jnp.maximum(wnorm, lower_bound)
+        if upper_bound > 0:
+            wnorm = jnp.minimum(wnorm, upper_bound)
+        unorm = jnp.linalg.norm(upd)
+        trust = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+        outs += [(w32 - lr * trust * upd).astype(w.dtype),
+                 nm.astype(m.dtype), nv.astype(v.dtype)]
+    return tuple(outs)
+
+
+@op("preloaded_multi_sgd_update", variadic=True)
+def preloaded_multi_sgd_update(*args, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    """Reference ``preloaded_multi_sgd_update``: [w0,g0, w1,g1, ..., lrs,
+    wds] — the learning rates/wds ride as ARRAYS (preloaded on device)."""
+    lrs, wds = args[-2], args[-1]
+    groups = _chunk(list(args[:-2]), 2)
+    outs = []
+    for i, (w, g) in enumerate(groups):
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nw = w.astype(jnp.float32) - lrs[i] * (g + wds[i] * w.astype(
+            jnp.float32))
+        outs.append(nw.astype(w.dtype))
+    return tuple(outs)
+
+
+@op("preloaded_multi_sgd_mom_update", variadic=True)
+def preloaded_multi_sgd_mom_update(*args, momentum=0.9, rescale_grad=1.0,
+                                   clip_gradient=-1.0):
+    """[w0,g0,mom0, ..., lrs, wds] with device-resident lrs/wds."""
+    lrs, wds = args[-2], args[-1]
+    groups = _chunk(list(args[:-2]), 3)
+    outs = []
+    for i, (w, g, mom) in enumerate(groups):
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nmom = momentum * mom - lrs[i] * (g + wds[i] * w.astype(
+            jnp.float32))
+        outs += [(w.astype(jnp.float32) + nmom).astype(w.dtype),
+                 nmom.astype(mom.dtype)]
+    return tuple(outs)
+
+
+# --------------------------------------------------------------------------- #
+# additional int8 inference ops
+# --------------------------------------------------------------------------- #
+
+# int8 affine convention shared by the quantized_* ops below:
+#   real = (q + 128) * scale + min,  scale = (max - min) / 255
+# (q = -128 maps to min, q = 127 to max)
+
+@op("quantized_pooling_int8", differentiable=False)
+def quantized_pooling_int8(data, min_data, max_data, *, kernel=(),
+                           pool_type="max", stride=(), pad=(),
+                           global_pool=False):
+    """int8 pooling: max-pool runs directly on int8 (order-preserving);
+    avg-pool dequantizes per-tile (reference ``_contrib_quantized_pooling``)."""
+    from .nn import Pooling
+    if pool_type == "max":
+        out = Pooling.__wrapped__(data, kernel=kernel, pool_type="max",
+                                  stride=stride, pad=pad,
+                                  global_pool=global_pool)
+        return out, min_data, max_data
+    scale = jnp.maximum(max_data - min_data, 1e-12) / 255.0
+    x = (data.astype(jnp.float32) + 128.0) * scale + min_data
+    out = Pooling.__wrapped__(x, kernel=kernel, pool_type=pool_type,
+                              stride=stride, pad=pad,
+                              global_pool=global_pool)
+    q = jnp.clip(jnp.round((out - min_data) / scale) - 128.0,
+                 -128, 127).astype(jnp.int8)
+    return q, min_data, max_data
+
+
+@op("quantized_act_int8", differentiable=False)
+def quantized_act_int8(data, min_data, max_data, *, act_type="relu"):
+    """int8 ReLU: clamp at the zero point; the calibrated range is
+    returned UNCHANGED so consumers dequantize the clamped values
+    correctly (reference ``_contrib_quantized_act``)."""
+    if act_type != "relu":
+        raise ValueError(f"quantized_act_int8: unsupported {act_type}")
+    scale = jnp.maximum(max_data - min_data, 1e-12) / 255.0
+    # ceil: the clamp floor is the smallest NON-NEGATIVE representable
+    # value (relu output must dequantize to >= 0)
+    zero = jnp.ceil(-min_data / scale) - 128.0
+    out = jnp.maximum(data.astype(jnp.int32), zero.astype(jnp.int32))
+    return out.astype(jnp.int8), min_data, max_data
+
+
+# --------------------------------------------------------------------------- #
+# small contrib stragglers
+# --------------------------------------------------------------------------- #
+
+@op("_contrib_index_copy")
+def index_copy(old, index, new):
+    """out = old with out[index[i]] = new[i] (reference contrib op)."""
+    return old.at[index.astype(jnp.int32)].set(new.astype(old.dtype))
+
+
+@op("_contrib_index_add")
+def index_add(old, index, new):
+    return old.at[index.astype(jnp.int32)].add(new.astype(old.dtype))
+
+
+@op("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) — the attention-scale helper op."""
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+@op("_contrib_gradientmultiplier")
+def gradientmultiplier(data, *, scalar=1.0):
+    """Identity forward, grad scaled by ``scalar`` (gradient-reversal
+    when negative; reference contrib op)."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return ((g * scalar).astype(g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@op("quadratic")
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """a·x² + b·x + c (the reference's tutorial example op — part of its
+    public op list)."""
+    return a * data * data + b * data + c
+
+
+@op("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward (the KL sparsity penalty attaches in backward in
+    the reference; under tape autograd the penalty is a training-script
+    concern — API-parity identity, documented)."""
+    return data
+
+
+alias("RNN", "fused_rnn")
+alias("broadcast_axes", "broadcast_axis")
+alias("random_uniform", "_random_uniform")
+alias("random_normal", "_random_normal")
+alias("random_gamma", "_random_gamma")
+alias("random_exponential", "_random_exponential")
+alias("random_poisson", "_random_poisson")
+alias("random_negative_binomial", "_random_negative_binomial")
+alias("random_generalized_negative_binomial",
+      "_random_generalized_negative_binomial")
+alias("random_randint", "_random_randint")

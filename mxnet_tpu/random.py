@@ -84,19 +84,33 @@ def _sample(name, fn, shape, dtype, ctx, extra_arrays=(), **params):
     return out
 
 
+def _is_nd(x):
+    from .ndarray.ndarray import NDArray
+    return isinstance(x, NDArray)
+
+
 def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
-    r = _sample("_random_uniform",
-                lambda k: jax.random.uniform(k, tuple(_shape(shape)),
-                                             minval=low, maxval=high),
-                shape, dtype, ctx)
-    return _out(r, out)
+    from .ops import samplers as _s
+    if _is_nd(low) or _is_nd(high):
+        r = _s.sample_uniform(low, high, shape=_shape(shape),
+                              dtype=dtype or "float32")
+    else:
+        r = _s._random_uniform(low=float(low), high=float(high),
+                               shape=_shape(shape),
+                               dtype=dtype or "float32")
+    return _out(_ctx(r, ctx), out)
 
 
 def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
-    r = _sample("_random_normal",
-                lambda k: jax.random.normal(k, tuple(_shape(shape))) * scale
-                + loc, shape, dtype, ctx)
-    return _out(r, out)
+    from .ops import samplers as _s
+    if _is_nd(loc) or _is_nd(scale):
+        r = _s.sample_normal(loc, scale, shape=_shape(shape),
+                             dtype=dtype or "float32")
+    else:
+        r = _s._random_normal(loc=float(loc), scale=float(scale),
+                              shape=_shape(shape),
+                              dtype=dtype or "float32")
+    return _out(_ctx(r, ctx), out)
 
 
 def randn(*shape, dtype="float32", ctx=None):
@@ -104,31 +118,51 @@ def randn(*shape, dtype="float32", ctx=None):
 
 
 def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
-    r = _sample("_random_randint",
-                lambda k: jax.random.randint(k, tuple(_shape(shape)), low,
-                                             high), shape, dtype, ctx)
-    return _out(r, out)
+    from .ops import samplers as _s
+    r = _s._random_randint(low=int(low), high=int(high),
+                           shape=_shape(shape), dtype=dtype or "int32")
+    return _out(_ctx(r, ctx), out)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
-    r = _sample("_random_gamma",
-                lambda k: jax.random.gamma(k, alpha, tuple(_shape(shape)))
-                * beta, shape, dtype, ctx)
-    return _out(r, out)
+    from .ops import samplers as _s
+    if _is_nd(alpha) or _is_nd(beta):
+        r = _s.sample_gamma(alpha, beta, shape=_shape(shape),
+                            dtype=dtype or "float32")
+    else:
+        r = _s._random_gamma(alpha=float(alpha), beta=float(beta),
+                             shape=_shape(shape),
+                             dtype=dtype or "float32")
+    return _out(_ctx(r, ctx), out)
 
 
 def exponential(scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
-    r = _sample("_random_exponential",
-                lambda k: jax.random.exponential(k, tuple(_shape(shape)))
-                * scale, shape, dtype, ctx)
-    return _out(r, out)
+    from .ops import samplers as _s
+    if _is_nd(scale):
+        # reference parameterizes by scale = 1/lam; sample_exponential
+        # takes the rate lam
+        r = _s.sample_exponential(1.0 / scale, shape=_shape(shape),
+                                  dtype=dtype or "float32")
+    else:
+        r = _s._random_exponential(lam=1.0 / float(scale),
+                                   shape=_shape(shape),
+                                   dtype=dtype or "float32")
+    return _out(_ctx(r, ctx), out)
 
 
 def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
-    r = _sample("_random_poisson",
-                lambda k: jax.random.poisson(k, lam, tuple(_shape(shape))),
-                shape, dtype, ctx)
-    return _out(r, out)
+    from .ops import samplers as _s
+    if _is_nd(lam):
+        r = _s.sample_poisson(lam, shape=_shape(shape),
+                              dtype=dtype or "float32")
+    else:
+        r = _s._random_poisson(lam=float(lam), shape=_shape(shape),
+                               dtype=dtype or "float32")
+    return _out(_ctx(r, ctx), out)
+
+
+def _ctx(r, ctx):
+    return r.as_in_context(ctx) if ctx is not None else r
 
 
 def bernoulli(prob=0.5, shape=(1,), dtype="float32", ctx=None, out=None):

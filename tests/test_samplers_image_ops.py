@@ -1,0 +1,193 @@
+"""Round-3 corpus: random/sample_* ops, mx.nd.image.*, fused multi-tensor
+optimizer ops, int8 stragglers (golden + statistical tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestRandomOps:
+    def setup_method(self, _):
+        mx.random.seed(7)
+
+    def test_random_uniform_range(self):
+        x = nd._random_uniform(low=2.0, high=5.0, shape=(1000,)).asnumpy()
+        assert x.min() >= 2.0 and x.max() < 5.0
+        assert abs(x.mean() - 3.5) < 0.15
+
+    def test_random_normal_moments(self):
+        x = nd._random_normal(loc=1.0, scale=2.0, shape=(4000,)).asnumpy()
+        assert abs(x.mean() - 1.0) < 0.15 and abs(x.std() - 2.0) < 0.15
+
+    def test_random_poisson_mean(self):
+        x = nd._random_poisson(lam=4.0, shape=(2000,)).asnumpy()
+        assert abs(x.mean() - 4.0) < 0.3
+
+    def test_randint_bounds(self):
+        x = nd.random_randint(low=3, high=9, shape=(500,)).asnumpy()
+        assert x.min() >= 3 and x.max() < 9
+
+    def test_sample_normal_per_row_params(self):
+        mu = nd.array(onp.asarray([0.0, 10.0], "float32"))
+        sg = nd.array(onp.asarray([1.0, 0.1], "float32"))
+        x = nd.sample_normal(mu, sg, shape=(2000,)).asnumpy()
+        assert x.shape == (2, 2000)
+        assert abs(x[0].mean()) < 0.2
+        assert abs(x[1].mean() - 10) < 0.05
+        assert x[1].std() < 0.2
+
+    def test_sample_multinomial_distribution(self):
+        p = nd.array(onp.asarray([[0.8, 0.2, 0.0]], "float32"))
+        x = nd.sample_multinomial(p, shape=(3000,)).asnumpy()
+        assert x.shape == (1, 3000)
+        frac0 = (x == 0).mean()
+        assert 0.75 < frac0 < 0.85
+        assert not (x == 2).any()
+
+    def test_sample_gamma_mean(self):
+        a = nd.array(onp.asarray([2.0], "float32"))
+        b = nd.array(onp.asarray([3.0], "float32"))
+        x = nd.sample_gamma(a, b, shape=(4000,)).asnumpy()
+        assert abs(x.mean() - 6.0) < 0.5  # mean = alpha * beta
+
+
+class TestImageOps:
+    def test_to_tensor_and_normalize(self):
+        img = onp.random.RandomState(0).randint(
+            0, 255, (8, 6, 3)).astype("uint8")
+        t = nd.image.to_tensor(nd.array(img)).asnumpy()
+        assert t.shape == (3, 8, 6)
+        onp.testing.assert_allclose(
+            t, img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+        norm = nd.image.normalize(nd.array(t), mean=(0.5, 0.5, 0.5),
+                                  std=(0.25, 0.25, 0.25)).asnumpy()
+        onp.testing.assert_allclose(norm, (t - 0.5) / 0.25, rtol=1e-5)
+
+    def test_crop_and_flips(self):
+        img = onp.arange(4 * 5 * 3, dtype=onp.float32).reshape(4, 5, 3)
+        c = nd.image.crop(nd.array(img), x=1, y=2, width=3,
+                          height=2).asnumpy()
+        onp.testing.assert_allclose(c, img[2:4, 1:4])
+        lr = nd.image.flip_left_right(nd.array(img)).asnumpy()
+        onp.testing.assert_allclose(lr, img[:, ::-1])
+        tb = nd.image.flip_top_bottom(nd.array(img)).asnumpy()
+        onp.testing.assert_allclose(tb, img[::-1])
+
+    def test_resize_batch(self):
+        img = onp.random.RandomState(1).rand(2, 8, 8, 3).astype("float32")
+        out = nd.image.resize(nd.array(img), size=(4, 4)).asnumpy()
+        assert out.shape == (2, 4, 4, 3)
+
+    def test_random_brightness_scales(self):
+        mx.random.seed(0)
+        img = onp.full((4, 4, 3), 100.0, "float32")
+        out = nd.image.random_brightness(nd.array(img), min_factor=0.5,
+                                         max_factor=1.5).asnumpy()
+        f = out[0, 0, 0] / 100.0
+        assert 0.5 <= f <= 1.5
+        onp.testing.assert_allclose(out, 100 * f, rtol=1e-5)
+
+
+class TestMultiTensorOps:
+    def test_multi_adamw_matches_singles(self):
+        rng = onp.random.RandomState(0)
+        ws = [rng.randn(4, 4).astype("float32") for _ in range(3)]
+        gs = [rng.randn(4, 4).astype("float32") for _ in range(3)]
+        ms = [onp.zeros((4, 4), "float32") for _ in range(3)]
+        vs = [onp.zeros((4, 4), "float32") for _ in range(3)]
+        flat = []
+        for w, g, m, v in zip(ws, gs, ms, vs):
+            flat += [nd.array(w), nd.array(g), nd.array(m), nd.array(v)]
+        outs = nd.multi_adamw_update(flat, lrs=0.01, etas=1.0, wds=0.0,
+                                     step_count=1)
+        assert len(outs) == 9
+        # reference: single adamw math
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        for i, (w, g) in enumerate(zip(ws, gs)):
+            m = (1 - b1) * g
+            v = (1 - b2) * g * g
+            mhat = m / (1 - b1)
+            vhat = v / (1 - b2)
+            expect = w - 0.01 * mhat / (onp.sqrt(vhat) + eps)
+            onp.testing.assert_allclose(outs[3 * i].asnumpy(), expect,
+                                        rtol=1e-5, atol=1e-6)
+
+    def test_preloaded_multi_sgd(self):
+        w = nd.array(onp.ones((3,), "float32"))
+        g = nd.array(onp.full((3,), 2.0, "float32"))
+        lrs = nd.array(onp.asarray([0.1], "float32"))
+        wds = nd.array(onp.asarray([0.0], "float32"))
+        (nw,) = nd.preloaded_multi_sgd_update([w, g, lrs, wds])
+        onp.testing.assert_allclose(nw.asnumpy(), [0.8, 0.8, 0.8],
+                                    rtol=1e-6)
+
+    def test_multi_lamb_trust_ratio_bounded(self):
+        rng = onp.random.RandomState(1)
+        flat = [nd.array(rng.randn(8, 8).astype("float32")),
+                nd.array(rng.randn(8, 8).astype("float32")),
+                nd.array(onp.zeros((8, 8), "float32")),
+                nd.array(onp.zeros((8, 8), "float32"))]
+        nw, nm, nv = nd.multi_lamb_update(flat, learning_rates=0.01,
+                                          step_count=1)
+        assert onp.isfinite(nw.asnumpy()).all()
+        assert not onp.allclose(nw.asnumpy(), flat[0].asnumpy())
+
+
+class TestContribStragglers:
+    def test_index_copy_add(self):
+        old = nd.array(onp.zeros((5, 2), "float32"))
+        idx = nd.array(onp.asarray([1, 3], "float32"))
+        new = nd.array(onp.ones((2, 2), "float32"))
+        out = nd.contrib.index_copy(old, idx, new).asnumpy() \
+            if hasattr(nd.contrib, "index_copy") else \
+            nd._contrib_index_copy(old, idx, new).asnumpy()
+        assert out[1].sum() == 2 and out[3].sum() == 2 and out[0].sum() == 0
+        out = nd._contrib_index_add(nd.array(onp.ones((5, 2), "float32")),
+                                    idx, new).asnumpy()
+        assert out[1, 0] == 2 and out[0, 0] == 1
+
+    def test_div_sqrt_dim(self):
+        x = nd.array(onp.full((2, 16), 4.0, "float32"))
+        onp.testing.assert_allclose(nd._contrib_div_sqrt_dim(x).asnumpy(),
+                                    onp.full((2, 16), 1.0), rtol=1e-6)
+
+    def test_gradientmultiplier_reverses(self):
+        from mxnet_tpu import autograd
+        x = nd.array(onp.ones((3,), "float32"))
+        x.attach_grad()
+        with autograd.record():
+            y = nd._contrib_gradientmultiplier(x, scalar=-2.0)
+            loss = (y * y).sum()
+        loss.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [-4, -4, -4],
+                                    rtol=1e-5)
+
+    def test_quadratic(self):
+        x = nd.array(onp.asarray([1.0, 2.0], "float32"))
+        onp.testing.assert_allclose(
+            nd.quadratic(x, a=1.0, b=2.0, c=3.0).asnumpy(), [6, 11],
+            rtol=1e-6)
+
+    def test_quantized_act_relu(self):
+        d = nd.array(onp.asarray([-100, -5, 0, 50], "int8"))
+        mn = nd.array(onp.asarray(-1.0, "float32"))
+        mxv = nd.array(onp.asarray(1.0, "float32"))
+        q, qmin, qmax = nd.quantized_act_int8(d, mn, mxv)
+        # affine: real = (q+128)*scale + min; zero point for [-1,1] is
+        # q = round(1/scale) - 128 = round(127.5) - 128 = 0
+        onp.testing.assert_array_equal(q.asnumpy(), [0, 0, 0, 50])
+        # range unchanged so consumers dequantize clamped values exactly
+        assert float(onp.asarray(qmin.asnumpy()).reshape(())) == -1.0
+        assert float(onp.asarray(qmax.asnumpy()).reshape(())) == 1.0
+
+    def test_quantized_pooling_avg_round_trip(self):
+        x = onp.asarray([[[[0, 127], [-128, 1]]]], "int8")  # NCHW 2x2
+        q, mn, mx_ = nd.quantized_pooling_int8(
+            nd.array(x), nd.array(onp.float32(-1)),
+            nd.array(onp.float32(1)), kernel=(2, 2), pool_type="avg")
+        scale = 2.0 / 255
+        real = (x.astype("float32") + 128) * scale - 1
+        expect = real.mean()
+        got = (float(q.asnumpy().reshape(-1)[0]) + 128) * scale - 1
+        assert abs(got - expect) < scale  # within one quantization step
