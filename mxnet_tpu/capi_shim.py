@@ -1,0 +1,75 @@
+"""Python side of the flat C ABI (``native/mxtpu_c_api.cc``).
+
+The C library embeds CPython and forwards each ``MXPred*`` call here; this
+module keeps the handle table and does the numpy marshalling so the C
+layer stays a thin ABI shim (SURVEY.md §3.1 "C API" row — the reference's
+``c_predict_api.cc`` standalone inference ABI).
+
+All functions use only plain types (int handles, bytes, tuples) so the C
+caller needs nothing beyond the stable CPython object protocol.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+_lock = threading.Lock()
+_handles: dict = {}
+_next_id = [1]
+
+
+def create(symbol_file: str, param_file: str, keys, indptr, shape_data,
+           dev_type: int = 1, dev_id: int = 0) -> int:
+    """MXPredCreate: keys + CSR-packed input shapes -> handle id."""
+    from .predictor import Predictor
+
+    shapes = {}
+    for i, key in enumerate(keys):
+        dims = tuple(int(d) for d in shape_data[indptr[i]:indptr[i + 1]])
+        shapes[key] = dims
+    pred = Predictor(symbol_file, param_file or None, shapes)
+    with _lock:
+        h = _next_id[0]
+        _next_id[0] += 1
+        _handles[h] = {"pred": pred, "outputs": []}
+    return h
+
+
+def set_input(h: int, name: str, buf: bytes) -> None:
+    entry = _handles[h]
+    pred = entry["pred"]
+    shape = pred._input_shapes[name]
+    arr = onp.frombuffer(buf, dtype=onp.float32).reshape(shape)
+    pred.set_input(name, arr)
+
+
+def forward(h: int) -> None:
+    entry = _handles[h]
+    entry["pred"].run()
+    entry["outputs"] = [
+        onp.ascontiguousarray(
+            onp.asarray(entry["pred"].get_output(i).asnumpy(),
+                        dtype=onp.float32))
+        for i in range(entry["pred"].num_outputs)]
+
+
+def num_outputs(h: int) -> int:
+    return len(_handles[h]["outputs"])
+
+
+def output_shape(h: int, index: int) -> tuple:
+    return tuple(int(d) for d in _handles[h]["outputs"][index].shape)
+
+
+def output_bytes(h: int, index: int) -> bytes:
+    return _handles[h]["outputs"][index].tobytes()
+
+
+def free(h: int) -> None:
+    with _lock:
+        _handles.pop(h, None)
+
+
+def version() -> int:
+    return 10900  # parity: reports the MXNet 1.9 line

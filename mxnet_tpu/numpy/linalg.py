@@ -8,7 +8,8 @@ from .multiarray import _run, ndarray, _coerce_arr
 __all__ = ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
            "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
            "matrix_rank", "matrix_power", "multi_dot", "tensorinv",
-           "tensorsolve"]
+           "tensorsolve",
+           "LinAlgError", "cond", "cross", "diagonal", "matmul", "outer", "trace", "tensordot", "vecdot", "svdvals", "matrix_norm", "vector_norm", "matrix_transpose"]
 
 
 def norm(x, ord=None, axis=None, keepdims=False):  # noqa: A002
@@ -109,3 +110,67 @@ def tensorinv(a, ind=2):
 def tensorsolve(a, b, axes=None):
     return _run("linalg_tensorsolve",
                 lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes), [a, b])
+
+
+# numpy-2.0 additions (array-API names)
+class LinAlgError(Exception):
+    """Reference numpy.linalg.LinAlgError surface."""
+
+
+def cond(a, p=None):
+    return _run("linalg_cond", lambda x: jnp.linalg.cond(x, p=p), [a])
+
+
+def cross(a, b, axis=-1):
+    return _run("linalg_cross",
+                lambda x, y: jnp.linalg.cross(x, y, axis=axis), [a, b])
+
+
+def diagonal(a, offset=0):
+    return _run("linalg_diagonal",
+                lambda x: jnp.linalg.diagonal(x, offset=offset), [a])
+
+
+def matmul(a, b):
+    return _run("linalg_matmul", jnp.matmul, [a, b])
+
+
+def outer(a, b):
+    return _run("linalg_outer", jnp.outer, [a, b])
+
+
+def trace(a, offset=0, dtype=None):
+    return _run("linalg_trace",
+                lambda x: jnp.linalg.trace(x, offset=offset,
+                                           dtype=dtype), [a])
+
+
+def tensordot(a, b, axes=2):
+    return _run("linalg_tensordot",
+                lambda x, y: jnp.tensordot(x, y, axes=axes), [a, b])
+
+
+def vecdot(a, b, axis=-1):
+    return _run("linalg_vecdot",
+                lambda x, y: jnp.linalg.vecdot(x, y, axis=axis), [a, b])
+
+
+def svdvals(a):
+    return _run("linalg_svdvals", jnp.linalg.svdvals, [a])
+
+
+def matrix_norm(a, ord="fro", keepdims=False):
+    return _run("linalg_matrix_norm",
+                lambda x: jnp.linalg.matrix_norm(
+                    x, ord=ord, keepdims=keepdims), [a])
+
+
+def vector_norm(a, ord=2, axis=None, keepdims=False):
+    return _run("linalg_vector_norm",
+                lambda x: jnp.linalg.vector_norm(
+                    x, ord=ord, axis=axis, keepdims=keepdims), [a])
+
+
+def matrix_transpose(a):
+    return _run("linalg_matrix_transpose", jnp.linalg.matrix_transpose,
+                [a])

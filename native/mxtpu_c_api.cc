@@ -1,0 +1,254 @@
+// Flat C ABI — the reference's standalone inference surface
+// (src/c_api/c_predict_api.cc; SURVEY.md §3.1 "C API" row: MXPredCreate /
+// MXPredSetInput / MXPredForward / MXPredGetOutputShape / MXPredGetOutput /
+// MXPredFree + MXGetLastError/MXGetVersion).
+//
+// Design: the library embeds CPython and forwards each call to
+// mxnet_tpu/capi_shim.py, which owns the handle table and numpy
+// marshalling.  Any C/C++/FFI host (Scala, R, Julia bindings in the
+// reference sense) can link this .so; if the host process already runs a
+// Python interpreter (e.g. a ctypes caller), the existing interpreter is
+// reused instead of initializing a second one.
+//
+// Error model mirrors the reference: every function returns 0 on success,
+// -1 on failure, and MXGetLastError() returns the message (thread-local).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef uint32_t mx_uint;
+typedef void *PredictorHandle;
+
+static thread_local std::string g_last_error;
+static std::mutex g_init_mutex;
+
+struct MXPredState {
+  long shim_handle;
+  // backing store for MXPredGetOutputShape pointers (per reference
+  // semantics the pointers stay valid until the next call on the handle)
+  std::vector<mx_uint> shape_buf;
+};
+
+static void set_error(const std::string &msg) { g_last_error = msg; }
+
+static void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+static bool ensure_python() {
+  std::lock_guard<std::mutex> lk(g_init_mutex);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by initialization; every entry point
+    // below re-acquires via PyGILState_Ensure
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+namespace {
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+}  // namespace
+
+static PyObject *shim() {
+  static PyObject *mod = nullptr;  // borrowed forever once imported
+  if (!mod) {
+    mod = PyImport_ImportModule("mxnet_tpu.capi_shim");
+  }
+  return mod;
+}
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *m = shim();
+  if (!m) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(m, "version", nullptr);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredCreate(const char *symbol_json_file, const char *param_file,
+                 int dev_type, int dev_id, mx_uint num_input_nodes,
+                 const char **input_keys, const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *m = shim();
+  if (!m) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *indptr = PyList_New(num_input_nodes + 1);
+  for (mx_uint i = 0; i < num_input_nodes; ++i)
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+  for (mx_uint i = 0; i <= num_input_nodes; ++i)
+    PyList_SetItem(indptr, i,
+                   PyLong_FromUnsignedLong(input_shape_indptr[i]));
+  mx_uint n_dims = input_shape_indptr[num_input_nodes];
+  PyObject *dims = PyList_New(n_dims);
+  for (mx_uint i = 0; i < n_dims; ++i)
+    PyList_SetItem(dims, i, PyLong_FromUnsignedLong(input_shape_data[i]));
+  PyObject *r = PyObject_CallMethod(
+      m, "create", "ssOOOii", symbol_json_file,
+      param_file ? param_file : "", keys, indptr, dims, dev_type, dev_id);
+  Py_DECREF(keys);
+  Py_DECREF(indptr);
+  Py_DECREF(dims);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  auto *st = new MXPredState();
+  st->shim_handle = PyLong_AsLong(r);
+  Py_DECREF(r);
+  *out = st;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, mx_uint size) {
+  Gil gil;
+  auto *st = static_cast<MXPredState *>(handle);
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float));
+  PyObject *r = PyObject_CallMethod(shim(), "set_input", "lsO",
+                                    st->shim_handle, key, buf);
+  Py_DECREF(buf);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  auto *st = static_cast<MXPredState *>(handle);
+  PyObject *r =
+      PyObject_CallMethod(shim(), "forward", "l", st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetNumOutputs(PredictorHandle handle, mx_uint *out) {
+  Gil gil;
+  auto *st = static_cast<MXPredState *>(handle);
+  PyObject *r =
+      PyObject_CallMethod(shim(), "num_outputs", "l", st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  *out = static_cast<mx_uint>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  Gil gil;
+  auto *st = static_cast<MXPredState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "output_shape", "lI",
+                                    st->shim_handle, index);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(r);
+  st->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    st->shape_buf[static_cast<size_t>(i)] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(r, i)));
+  Py_DECREF(r);
+  *shape_data = st->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float *data,
+                    mx_uint size) {
+  Gil gil;
+  auto *st = static_cast<MXPredState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "output_bytes", "lI",
+                                    st->shim_handle, index);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    capture_py_error();
+    return -1;
+  }
+  if (static_cast<Py_ssize_t>(size) * 4 < len) {
+    Py_DECREF(r);
+    set_error("MXPredGetOutput: buffer too small");
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(len));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  auto *st = static_cast<MXPredState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "free", "l", st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    delete st;
+    return -1;
+  }
+  Py_DECREF(r);
+  delete st;
+  return 0;
+}
+
+}  // extern "C"

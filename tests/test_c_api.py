@@ -1,0 +1,204 @@
+"""Flat C ABI (native/mxtpu_c_api.cc — the reference's c_predict_api
+surface, SURVEY.md §3.1 "C API" row).
+
+Two hosts are exercised:
+- a ctypes caller (C ABI from an existing Python process: the embedded
+  interpreter is reused);
+- a REAL standalone C program, compiled with g++ at test time and run in
+  a subprocess — the multi-language-bindings story (SURVEY.md §1
+  capability 6): any FFI host can link libmxtpu_capi.so.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_capi.so")
+
+
+def _build_lib():
+    # unconditional: make is incremental, and a stale .so must never
+    # green-light old binaries
+    subprocess.run(["make", "capi"], cwd=os.path.join(REPO, "native"),
+                   check=True, capture_output=True)
+    return LIB
+
+
+def _export_model(tmp_path):
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu", in_units=4))
+    net.add(gluon.nn.Dense(3, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 4)
+                    .astype("float32"))
+    net(x)  # trace
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+    return prefix + "-symbol.json", prefix + "-0000.params", x
+
+
+class TestCtypesHost:
+    def test_predict_round_trip(self, tmp_path):
+        _build_lib()
+        sym, params, x = _export_model(tmp_path)
+        ref = None
+        from mxnet_tpu.predictor import Predictor
+        pred = Predictor(sym, params, {"data": (2, 4)})
+        pred.set_input("data", x.asnumpy())
+        pred.run()
+        ref = pred.get_output(0).asnumpy()
+
+        lib = ctypes.CDLL(LIB)
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        ver = ctypes.c_int()
+        assert lib.MXGetVersion(ctypes.byref(ver)) == 0
+        assert ver.value == 10900
+
+        handle = ctypes.c_void_p()
+        keys = (ctypes.c_char_p * 1)(b"data")
+        indptr = (ctypes.c_uint * 2)(0, 2)
+        shape = (ctypes.c_uint * 2)(2, 4)
+        rc = lib.MXPredCreate(sym.encode(), params.encode(), 1, 0, 1,
+                              keys, indptr, shape, ctypes.byref(handle))
+        assert rc == 0, lib.MXGetLastError()
+
+        data = x.asnumpy().reshape(-1)
+        buf = (ctypes.c_float * data.size)(*data.tolist())
+        assert lib.MXPredSetInput(handle, b"data", buf, data.size) == 0, \
+            lib.MXGetLastError()
+        assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+
+        n_out = ctypes.c_uint()
+        assert lib.MXPredGetNumOutputs(handle, ctypes.byref(n_out)) == 0
+        assert n_out.value == 1
+
+        sh_data = ctypes.POINTER(ctypes.c_uint)()
+        sh_ndim = ctypes.c_uint()
+        assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sh_data),
+                                        ctypes.byref(sh_ndim)) == 0
+        shape_out = tuple(sh_data[i] for i in range(sh_ndim.value))
+        assert shape_out == (2, 3)
+
+        n = 6
+        out = (ctypes.c_float * n)()
+        assert lib.MXPredGetOutput(handle, 0, out, n) == 0, \
+            lib.MXGetLastError()
+        got = onp.asarray(list(out), onp.float32).reshape(2, 3)
+        onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        assert lib.MXPredFree(handle) == 0
+
+    def test_error_surface(self, tmp_path):
+        _build_lib()
+        lib = ctypes.CDLL(LIB)
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        handle = ctypes.c_void_p()
+        keys = (ctypes.c_char_p * 1)(b"data")
+        indptr = (ctypes.c_uint * 2)(0, 1)
+        shape = (ctypes.c_uint * 1)(4)
+        rc = lib.MXPredCreate(b"/nonexistent-symbol.json", b"", 1, 0, 1,
+                              keys, indptr, shape, ctypes.byref(handle))
+        assert rc == -1
+        assert len(lib.MXGetLastError()) > 0
+
+
+C_HOST = r"""
+#include <stdio.h>
+#include <stdlib.h>
+typedef unsigned int mx_uint;
+typedef void* PredictorHandle;
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern const char* MXGetLastError();
+extern int MXGetVersion(int*);
+extern int MXPredCreate(const char*, const char*, int, int, mx_uint,
+                        const char**, const mx_uint*, const mx_uint*,
+                        PredictorHandle*);
+extern int MXPredSetInput(PredictorHandle, const char*, const float*,
+                          mx_uint);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutputShape(PredictorHandle, mx_uint, mx_uint**,
+                                mx_uint*);
+extern int MXPredGetOutput(PredictorHandle, mx_uint, float*, mx_uint);
+extern int MXPredFree(PredictorHandle);
+#ifdef __cplusplus
+}
+#endif
+
+#define CHECK(x) if ((x) != 0) { \
+    fprintf(stderr, "FAIL: %s\n", MXGetLastError()); return 1; }
+
+int main(int argc, char** argv) {
+  int ver; CHECK(MXGetVersion(&ver));
+  printf("version=%d\n", ver);
+  const char* keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {2, 4};
+  PredictorHandle h;
+  CHECK(MXPredCreate(argv[1], argv[2], 1, 0, 1, keys, indptr, shape, &h));
+  float in[8];
+  for (int i = 0; i < 8; ++i) in[i] = 0.125f * i;
+  CHECK(MXPredSetInput(h, "data", in, 8));
+  CHECK(MXPredForward(h));
+  mx_uint *sh, ndim;
+  CHECK(MXPredGetOutputShape(h, 0, &sh, &ndim));
+  printf("ndim=%u shape=%u,%u\n", ndim, sh[0], sh[1]);
+  float out[6];
+  CHECK(MXPredGetOutput(h, 0, out, 6));
+  printf("out=");
+  for (int i = 0; i < 6; ++i) printf("%.6f ", out[i]);
+  printf("\n");
+  CHECK(MXPredFree(h));
+  printf("C_HOST_OK\n");
+  return 0;
+}
+"""
+
+
+class TestStandaloneCHost:
+    def test_compiled_c_program(self, tmp_path):
+        """Compile a real C host with g++, link libmxtpu_capi.so, run it
+        in a fresh process (its own embedded interpreter), and check the
+        output matches the python-side predictor."""
+        _build_lib()
+        sym, params, _x = _export_model(tmp_path)
+        src = tmp_path / "host.c"
+        src.write_text(C_HOST)
+        exe = tmp_path / "host"
+        libdir = os.path.dirname(LIB)
+        subprocess.run(
+            ["g++", str(src), "-o", str(exe), f"-L{libdir}",
+             "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, text=True)
+
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run([str(exe), sym, params],
+                              capture_output=True, text=True, env=env,
+                              timeout=300)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr[-1500:])
+        assert "C_HOST_OK" in proc.stdout
+        assert "version=10900" in proc.stdout
+        assert "ndim=2 shape=2,3" in proc.stdout
+
+        # cross-check values against the python predictor
+        from mxnet_tpu.predictor import Predictor
+        pred = Predictor(sym, params, {"data": (2, 4)})
+        x = (onp.arange(8, dtype=onp.float32) * 0.125).reshape(2, 4)
+        pred.set_input("data", x)
+        pred.run()
+        ref = pred.get_output(0).asnumpy().reshape(-1)
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("out=")][0]
+        got = onp.asarray([float(v) for v in line[4:].split()],
+                          onp.float32)
+        onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
