@@ -396,3 +396,13 @@ def save(fname, data):
 
 
 import jax  # noqa: E402  (used by masked_softmax paths)
+
+
+# device helpers (reference npx surface)
+from ..context import cpu, gpu, num_gpus, current_context  # noqa: E402
+
+
+def rnn(data, parameters, state, *args, **kwargs):
+    """Fused RNN op under npx (delegates to the registered fused_rnn)."""
+    from ..ndarray import fused_rnn as _fused
+    return _fused(data, parameters, state, *args, **kwargs)
