@@ -15,7 +15,8 @@ from ..parameter import Parameter
 
 __all__ = [
     "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
-    "LayerNorm", "InstanceNorm", "GroupNorm", "Embedding", "Flatten",
+    "LayerNorm", "RMSNorm", "InstanceNorm", "GroupNorm", "Embedding",
+    "Flatten",
     "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU",
     "SELU", "GELU", "Swish", "SyncBatchNorm",
 ]
@@ -255,6 +256,30 @@ class LayerNorm(HybridBlock):
 
     def __repr__(self):
         return f"LayerNorm(axis={self._axis}, eps={self._eps})"
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square norm (Llama-family; TPU-native addition — the
+    reference has no RMSNorm layer)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._eps = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[self._axis],)
+
+    def hybrid_forward(self, F, x, gamma):
+        return F.RMSNorm(x, gamma, axis=self._axis, eps=self._eps)
+
+    def __repr__(self):
+        return f"RMSNorm(axis={self._axis}, eps={self._eps})"
 
 
 class InstanceNorm(HybridBlock):

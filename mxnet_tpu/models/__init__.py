@@ -14,6 +14,8 @@ from .decoding import kv_generate
 from .gpt import GPT, GPTConfig, gpt2_small, gpt2_medium, gpt2_large, \
     gpt2_774m, gpt_tp_rules
 from .bert import BERTModel, BERTConfig, bert_base, bert_large
+from .llama import (Llama, LlamaConfig, llama_tp_rules, llama_tiny,
+                    llama_7b)
 from .seq2seq import (CrossAttention, Seq2SeqEncoder, Seq2SeqDecoder,
                       Seq2SeqDecoderCell, TransformerSeq2Seq)
 
@@ -24,4 +26,5 @@ __all__ = [
     "BERTModel", "BERTConfig", "bert_base", "bert_large",
     "CrossAttention", "Seq2SeqEncoder", "Seq2SeqDecoder",
     "Seq2SeqDecoderCell", "TransformerSeq2Seq",
+    "Llama", "LlamaConfig", "llama_tp_rules", "llama_tiny", "llama_7b",
 ]

@@ -37,7 +37,7 @@ from jax import lax
 
 from .registry import op
 
-__all__ = ["flash_attention", "ring_attention"]
+__all__ = ["flash_attention", "ring_attention", "rope"]
 
 _NEG_INF = -1e30
 _BLOCK = 128  # MXU-native q/k tile
@@ -892,6 +892,36 @@ def flash_attention(q, k, v, bias=None, *, scale: Optional[float] = None,
         return out[:, :, :Lq] if out.shape[2] != Lq else out
     return _flash(q, k, v, bias, seed, float(scale), bool(causal), rate,
                   "xla")
+
+
+# ---------------------------------------------------------------------------
+# rotary position embeddings (RoPE) — Llama-family models
+# ---------------------------------------------------------------------------
+
+@op("rope")
+def rope(x, *, base=10000.0, position_offset=0):
+    """Apply rotary position embeddings to (B, H, L, D) q/k tensors
+    (TPU-native addition, no reference analog — the positional mechanism
+    of the Llama family, BASELINE config 5).
+
+    Rotates consecutive (even, odd) feature pairs by position-dependent
+    angles: theta_i = pos / base^(2i/D).  ``position_offset`` supports
+    KV-cache decode (queries at absolute positions offset..offset+L)."""
+    B, H, L, D = x.shape
+    half = D // 2
+    inv_freq = 1.0 / (base ** (
+        jnp.arange(0, half, dtype=jnp.float32) * 2.0 / D))
+    pos = jnp.arange(L, dtype=jnp.float32) + position_offset
+    angles = pos[:, None] * inv_freq[None, :]           # (L, half)
+    cos = jnp.cos(angles)[None, None]                   # (1,1,L,half)
+    sin = jnp.sin(angles)[None, None]
+    x32 = x.astype(jnp.float32)
+    x1 = x32[..., 0::2]
+    x2 = x32[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    out = jnp.stack([r1, r2], axis=-1).reshape(B, H, L, D)
+    return out.astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -399,9 +399,16 @@ def GroupNorm(data, gamma, beta, *, num_groups=1, eps=1e-5):
 @op("RMSNorm")
 def RMSNorm(data, gamma, *, axis=-1, eps=1e-6):
     """TPU-native addition (no reference analog; used by Llama-family
-    models)."""
-    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
-    return data * lax.rsqrt(ms + eps) * gamma
+    models).  f32 statistics + f32 gamma application for half-precision
+    inputs, single downcast at the end (same mixed-precision convention
+    as LayerNorm)."""
+    x = data.astype(jnp.float32) if data.dtype in (jnp.float16,
+                                                   jnp.bfloat16) else data
+    ms = jnp.mean(jnp.square(x), axis=axis, keepdims=True)
+    gshape = [1] * data.ndim
+    gshape[axis] = data.shape[axis]
+    return (x * lax.rsqrt(ms + eps)
+            * gamma.astype(x.dtype).reshape(gshape)).astype(data.dtype)
 
 
 # ----------------------------------------------------------------------- #
