@@ -16,10 +16,16 @@ inside those sublayers decodes correctly with no decoder change.  Only the
 cache-attention core (one-token query against the running K/V cache) is
 decoder-specific math.
 
-Decodable protocol: the model exposes ``wte``/``wpe`` embeddings,
-``blocks`` of ``_TransformerCell`` shape (``ln1``, ``attn`` with fused
-``qkv``+``proj`` and ``_heads``, ``ln2``, ``ffn``), a final ``ln_f``, and
-either a ``head`` Block or the tied-embedding head (``wte`` weight).
+Decodable protocol — two block families are recognized:
+- GPT/_TransformerCell: ``wte``+``wpe`` embeddings, blocks with ``ln1``,
+  ``attn`` (fused ``qkv``+``proj``), ``ln2``, ``ffn``;
+- Llama: ``wte`` only (RoPE applied per step via the ``rope`` op's
+  ``position_offset``), blocks with ``rms1``, ``attn`` (separate
+  ``q_proj``/``k_proj``/``v_proj``/``o_proj``, grouped-query kv heads),
+  ``rms2``, ``mlp``.
+Final norm is ``ln_f``; the head is a ``head``/``lm_head`` Block or the
+tied ``wte`` weight.  In all cases the norm/projection/FFN math comes
+from the model's OWN sublayers.
 
 Reference counterpart: none in-tree (GluonNLP-era beam/sampling ran the
 full-prefix path); this is a NEW capability like flash/ring attention.
@@ -61,6 +67,11 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     H = cfg.num_heads
     U = cfg.units
     D = U // H
+    # family detection (see module docstring): Llama cells carry separate
+    # projections + RoPE and may use fewer kv heads (GQA)
+    is_llama = hasattr(model.blocks[0], "rms1")
+    KV = getattr(cfg, "num_kv_heads", H) if is_llama else H
+    rope_base = float(getattr(cfg, "rope_base", 10000.0))
     prompt = onp.asarray(
         prompt_tokens.asnumpy() if hasattr(prompt_tokens, "asnumpy")
         else prompt_tokens, dtype=onp.int32)
@@ -87,25 +98,48 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
 
     def one_token(x_tok, pos, ck, cv):
         """x_tok (B,) int32 at position pos -> (logits (B,V), new caches).
-        ck/cv: (NL, B, H, maxT, D).  All layer math comes from the model's
-        own sublayers; only the cached-attention core is inlined."""
-        x = _call(model.wte, x_tok) + _call(
-            model.wpe, jnp.broadcast_to(pos, (B,)))           # (B, U)
+        ck/cv: (NL, B, KV, maxT, D).  All layer math comes from the
+        model's own sublayers; only the cached-attention core (and RoPE
+        application for Llama) is inlined."""
+        from ..ops.attention import rope as _rope
+
+        x = _call(model.wte, x_tok)
+        if not is_llama:
+            x = x + _call(model.wpe, jnp.broadcast_to(pos, (B,)))
         idx = lax.broadcasted_iota(jnp.int32, (1, 1, total), 2)
         for i, blk in enumerate(model.blocks):
-            h = _call(blk.ln1, x)
-            qkv = _call(blk.attn.qkv, h)                      # (B, 3U)
-            q, k, v = (qkv[:, j * U:(j + 1) * U].reshape(B, H, 1, D)
-                       for j in range(3))
+            if is_llama:
+                h = _call(blk.rms1, x)
+                q = _call(blk.attn.q_proj, h).reshape(B, H, 1, D)
+                k = _call(blk.attn.k_proj, h).reshape(B, KV, 1, D)
+                v = _call(blk.attn.v_proj, h).reshape(B, KV, 1, D)
+                q = _rope.__wrapped__(q, base=rope_base,
+                                      position_offset=pos)
+                k = _rope.__wrapped__(k, base=rope_base,
+                                      position_offset=pos)
+            else:
+                h = _call(blk.ln1, x)
+                qkv = _call(blk.attn.qkv, h)                  # (B, 3U)
+                q, k, v = (qkv[:, j * U:(j + 1) * U].reshape(B, H, 1, D)
+                           for j in range(3))
             ck = lax.dynamic_update_slice(ck, k[None], (i, 0, 0, pos, 0))
             cv = lax.dynamic_update_slice(cv, v[None], (i, 0, 0, pos, 0))
-            s = jnp.einsum("bhqd,bhtd->bhqt", q, ck[i],
+            kc, vc = ck[i], cv[i]                             # (B,KV,T,D)
+            # grouped einsums contract q's head groups directly against
+            # the KV-head cache — no materialized H-head repeat (the GQA
+            # memory-bandwidth benefit is the point of the small cache)
+            qg = q.reshape(B, KV, H // KV, D)
+            s = jnp.einsum("bkgd,bktd->bkgt", qg, kc,
                            preferred_element_type=jnp.float32) * scale
-            s = jnp.where(idx <= pos, s[:, :, 0], -1e30)      # (B,H,T)
+            s = jnp.where(idx[:, :, None] <= pos, s, -1e30)   # (B,KV,G,T)
             p = jax.nn.softmax(s, axis=-1).astype(cdtype)
-            o = jnp.einsum("bht,bhtd->bhd", p, cv[i]).reshape(B, U)
-            x = x + _call(blk.attn.proj, o)
-            x = x + _call(blk.ffn, _call(blk.ln2, x))
+            o = jnp.einsum("bkgt,bktd->bkgd", p, vc).reshape(B, U)
+            if is_llama:
+                x = x + _call(blk.attn.o_proj, o)
+                x = x + _call(blk.mlp, _call(blk.rms2, x))
+            else:
+                x = x + _call(blk.attn.proj, o)
+                x = x + _call(blk.ffn, _call(blk.ln2, x))
         x = _call(model.ln_f, x)
         if head is not None:
             logits = _call(head, x).astype(jnp.float32)
@@ -138,8 +172,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                             axis=-1).astype(jnp.int32)
                     return (nxt, ck, cv), nxt
 
-                ck = jnp.zeros((NL, B, H, total, D), cdtype)
-                cv = jnp.zeros((NL, B, H, total, D), cdtype)
+                ck = jnp.zeros((NL, B, KV, total, D), cdtype)
+                cv = jnp.zeros((NL, B, KV, total, D), cdtype)
                 tok0 = jnp.zeros((B,), jnp.int32)
                 (_, _, _), toks = lax.scan(scan_body, (tok0, ck, cv),
                                            jnp.arange(total - 1))

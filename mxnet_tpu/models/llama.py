@@ -156,8 +156,9 @@ class Llama(HybridBlock):
     def generate(self, prompt_tokens, max_new_tokens=32, temperature=1.0,
                  top_k=0, seed=None):
         """Full-recompute autoregressive sampling (same loop as
-        ``GPT.generate``; the KV-cache decoder requires RoPE-aware cache
-        update — a named follow-up)."""
+        ``GPT.generate``).  For O(L)-per-token decode use
+        ``models.kv_generate`` — it recognizes Llama blocks (RoPE via
+        ``position_offset``, grouped-query KV cache)."""
         from .gpt import GPT
         return GPT.generate(self, prompt_tokens, max_new_tokens,
                             temperature, top_k, seed)
