@@ -59,10 +59,13 @@ def _read_nd(f) -> NDArray:
         n *= d
     buf = f.read(n * onp.dtype(dtype).itemsize)
     arr = onp.frombuffer(buf, dtype=dtype).reshape(shape).copy()
-    if onp.dtype(dtype) in (onp.int64, onp.uint64, onp.float64):
+    import jax as _jax
+    if (onp.dtype(dtype) in (onp.int64, onp.uint64, onp.float64)
+            and not _jax.config.jax_enable_x64):
         # jax (x64 disabled) demotes 64-bit dtypes to 32-bit.  Demote only
         # when the values survive exactly; otherwise fail loudly instead
-        # of silently truncating (e.g. reference int64 large-tensor files)
+        # of silently truncating (e.g. reference int64 large-tensor files).
+        # With jax_enable_x64 on, the 64-bit array passes through unchanged.
         narrow = {onp.dtype(onp.int64): onp.int32,
                   onp.dtype(onp.uint64): onp.uint32,
                   onp.dtype(onp.float64): onp.float32}[onp.dtype(dtype)]

@@ -98,8 +98,13 @@ def image_flip_top_bottom(data):
     return jnp.flip(data, axis=-3)
 
 
-def _coin(seed_like):
+def _coin(data):
+    """Per-image bernoulli: shape (N, 1, 1, 1) for NHWC batches so every
+    image in a batch draws independently; scalar for a single HWC image."""
     from .. import random as mxrandom
+    if _is_batch(data):
+        return jax.random.bernoulli(
+            mxrandom.next_key(), shape=(data.shape[0], 1, 1, 1))
     return jax.random.bernoulli(mxrandom.next_key())
 
 
@@ -113,9 +118,11 @@ def image_random_flip_top_bottom(data):
     return jnp.where(_coin(data), jnp.flip(data, axis=-3), data)
 
 
-def _rand_factor(lo, hi):
+def _rand_factor(data, lo, hi):
+    """Per-image uniform factor, broadcastable over HWC (or NHWC batch)."""
     from .. import random as mxrandom
-    return jax.random.uniform(mxrandom.next_key(), (), jnp.float32,
+    shape = (data.shape[0], 1, 1, 1) if _is_batch(data) else ()
+    return jax.random.uniform(mxrandom.next_key(), shape, jnp.float32,
                               lo, hi)
 
 
@@ -128,13 +135,13 @@ def _photometric_dtype(data, x):
 
 @op("_image_random_brightness", differentiable=False)
 def image_random_brightness(data, *, min_factor=0.5, max_factor=1.5):
-    f = _rand_factor(min_factor, max_factor)
+    f = _rand_factor(data, min_factor, max_factor)
     return _photometric_dtype(data, data.astype(jnp.float32) * f)
 
 
 @op("_image_random_contrast", differentiable=False)
 def image_random_contrast(data, *, min_factor=0.5, max_factor=1.5):
-    f = _rand_factor(min_factor, max_factor)
+    f = _rand_factor(data, min_factor, max_factor)
     x = data.astype(jnp.float32)
     # PER-IMAGE luminance-mean contrast pivot (reference coefficients)
     coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
@@ -145,7 +152,7 @@ def image_random_contrast(data, *, min_factor=0.5, max_factor=1.5):
 
 @op("_image_random_saturation", differentiable=False)
 def image_random_saturation(data, *, min_factor=0.5, max_factor=1.5):
-    f = _rand_factor(min_factor, max_factor)
+    f = _rand_factor(data, min_factor, max_factor)
     x = data.astype(jnp.float32)
     coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
     gray = jnp.tensordot(x, coef, axes=([-1], [0]))[..., None]

@@ -130,15 +130,24 @@ def _output_head(name, fwd, dgrad):
     return op(name)(wrapper)
 
 
+# Reference "1/m" convention: the gradient is scaled by the number of
+# regression outputs PER EXAMPLE (d.size // d.shape[0]), not the batch size.
+def _num_outputs(d):
+    m = 1
+    for s in d.shape[1:]:
+        m *= s
+    return max(m, 1)
+
+
 LinearRegressionOutput = _output_head(
     "LinearRegressionOutput", lambda d: d,
-    lambda d, l: (d - l.reshape(d.shape)) / d.shape[0])
+    lambda d, l: (d - l.reshape(d.shape)) / _num_outputs(d))
 MAERegressionOutput = _output_head(
     "MAERegressionOutput", lambda d: d,
-    lambda d, l: jnp.sign(d - l.reshape(d.shape)) / d.shape[0])
+    lambda d, l: jnp.sign(d - l.reshape(d.shape)) / _num_outputs(d))
 LogisticRegressionOutput = _output_head(
     "LogisticRegressionOutput", jax.nn.sigmoid,
-    lambda d, l: (jax.nn.sigmoid(d) - l.reshape(d.shape)) / d.shape[0])
+    lambda d, l: (jax.nn.sigmoid(d) - l.reshape(d.shape)) / _num_outputs(d))
 
 
 @op("SVMOutput")

@@ -71,8 +71,9 @@ class TestOutputHeads:
             out = nd.LinearRegressionOutput(d, lab)
         out.backward()
         onp.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+        # reference "1/m": divide by outputs per example (3), not batch (4)
         onp.testing.assert_allclose(
-            d.grad.asnumpy(), (d.asnumpy() - lab.asnumpy()) / 4, rtol=1e-5)
+            d.grad.asnumpy(), (d.asnumpy() - lab.asnumpy()) / 3, rtol=1e-5)
 
     def test_logistic_regression_grad(self):
         d = nd.array(_rand(5, 2))
@@ -85,7 +86,7 @@ class TestOutputHeads:
         sig = 1 / (1 + onp.exp(-d.asnumpy()))
         onp.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
         onp.testing.assert_allclose(d.grad.asnumpy(),
-                                    (sig - lab.asnumpy()) / 5, rtol=1e-5)
+                                    (sig - lab.asnumpy()) / 2, rtol=1e-5)
 
     def test_mae_regression_grad(self):
         d = nd.array(_rand(3, 2))
@@ -95,7 +96,7 @@ class TestOutputHeads:
             out = nd.MAERegressionOutput(d, lab)
         out.backward()
         onp.testing.assert_allclose(d.grad.asnumpy(),
-                                    onp.sign(d.asnumpy()) / 3, rtol=1e-5)
+                                    onp.sign(d.asnumpy()) / 2, rtol=1e-5)
 
     def test_svm_output_grad_squared_hinge(self):
         d = nd.array(onp.asarray([[2.0, 1.5, -1.0]], "float32"))

@@ -88,6 +88,24 @@ class TestImageOps:
         assert 0.5 <= f <= 1.5
         onp.testing.assert_allclose(out, 100 * f, rtol=1e-5)
 
+    def test_random_ops_draw_per_image_on_batches(self):
+        mx.random.seed(0)
+        batch = onp.full((16, 4, 4, 3), 100.0, "float32")
+        out = nd.image.random_brightness(nd.array(batch), min_factor=0.5,
+                                         max_factor=1.5).asnumpy()
+        factors = out[:, 0, 0, 0] / 100.0
+        assert onp.all((factors >= 0.5) & (factors <= 1.5))
+        # 16 images sharing one draw is ~0 probability; require diversity
+        assert onp.unique(onp.round(factors, 5)).size > 1
+        for i in range(16):
+            onp.testing.assert_allclose(out[i], 100 * factors[i], rtol=1e-5)
+        # per-image flips: with 16 images, both outcomes should appear
+        img = onp.arange(16 * 4 * 4 * 3, dtype="float32").reshape(16, 4, 4, 3)
+        fl = nd.image.random_flip_left_right(nd.array(img)).asnumpy()
+        flipped = onp.array([not onp.allclose(fl[i], img[i])
+                             for i in range(16)])
+        assert flipped.any() and not flipped.all()
+
 
 class TestMultiTensorOps:
     def test_multi_adamw_matches_singles(self):
