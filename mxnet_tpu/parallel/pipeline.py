@@ -37,7 +37,8 @@ def stack_stage_params(stage_params_list):
 
 
 def gpipe_apply(stage_fn: Callable, stage_params, x, mesh: Mesh = None,
-                axis: str = "pp", microbatches: int = None):
+                axis: str = "pp", microbatches: int = None,
+                param_specs=None, batch_axis: str = None):
     """Run ``x`` through S pipeline stages with a GPipe schedule.
 
     - ``stage_fn(params_i, h) -> h`` — one stage (same structure every
@@ -46,8 +47,20 @@ def gpipe_apply(stage_fn: Callable, stage_params, x, mesh: Mesh = None,
       :func:`stack_stage_params`), sharded over ``axis``.
     - ``x`` — (batch, ...) input, split into ``microbatches`` chunks along
       axis 0 (default S, the minimum that fills the pipeline).
+    - ``param_specs`` — optional pytree of ``PartitionSpec`` for the
+      stacked stage params (leading axis must be ``axis``), enabling
+      pp×tp composition: shard stage weights over a tensor axis too and
+      do the tp collectives (``lax.psum``/``lax.all_gather``) inside
+      ``stage_fn`` itself.  With ``param_specs`` the stage must also
+      preserve the activation DTYPE (not just shape): in-shard
+      collectives cannot be eval_shape'd up front, so a dtype-changing
+      stage surfaces as a scan-carry mismatch instead of the pure-pp
+      path's ring-invariance error.
+    - ``batch_axis`` — optional mesh axis to shard the microbatch dim
+      over (dp×pp composition); the output stays sharded over it.
 
-    Returns the final stage's (batch, ...) output, replicated.
+    Returns the final stage's (batch, ...) output, replicated over
+    ``axis`` (and sharded over ``batch_axis`` if given).
     """
     from ..ndarray.ndarray import NDArray
 
@@ -64,28 +77,36 @@ def gpipe_apply(stage_fn: Callable, stage_params, x, mesh: Mesh = None,
     mb = B // M
     xs = xv.reshape((M, mb) + xv.shape[1:])
 
-    p0 = jax.tree.map(lambda a: a[0], params)
-    out_aval = jax.eval_shape(stage_fn, p0, jax.ShapeDtypeStruct(
-        (mb,) + xv.shape[1:], xv.dtype))
-    if tuple(out_aval.shape) != (mb,) + tuple(xv.shape[1:]):
-        raise ValueError(
-            "gpipe_apply requires ring-invariant activations: stage output "
-            f"{tuple(out_aval.shape)} != input {(mb,) + tuple(xv.shape[1:])};"
-            " keep embeddings/heads outside the pipelined trunk")
+    out_dtype = xv.dtype
+    if param_specs is None:
+        # pure-pp path: stage_fn sees global microbatch shapes, so the
+        # ring-invariance precondition is checkable up front.  (With
+        # param_specs the stage may use in-shard collectives, which
+        # cannot be eval_shape'd outside shard_map.)
+        p0 = jax.tree.map(lambda a: a[0], params)
+        out_aval = jax.eval_shape(stage_fn, p0, jax.ShapeDtypeStruct(
+            (mb,) + xv.shape[1:], xv.dtype))
+        if tuple(out_aval.shape) != (mb,) + tuple(xv.shape[1:]):
+            raise ValueError(
+                "gpipe_apply requires ring-invariant activations: stage "
+                f"output {tuple(out_aval.shape)} != input "
+                f"{(mb,) + tuple(xv.shape[1:])}; keep embeddings/heads "
+                "outside the pipelined trunk")
+        out_dtype = out_aval.dtype
 
-    def shard_fn(local_params, xs_full):
+    def shard_fn(local_params, xs_local):
         my = lax.axis_index(axis)
         lp = jax.tree.map(lambda a: a[0], local_params)  # drop local S=1
         fwd = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(state, t):
             prev = lax.ppermute(state, axis, fwd)
-            x_t = xs_full[jnp.minimum(t, M - 1)].astype(out_aval.dtype)
+            x_t = xs_local[jnp.minimum(t, M - 1)].astype(state.dtype)
             inp = jnp.where(my == 0, x_t, prev)
             out = stage_fn(lp, inp)
             return out, out
 
-        state0 = jnp.zeros(out_aval.shape, out_aval.dtype)
+        state0 = jnp.zeros(xs_local.shape[1:], out_dtype)
         # the carry varies per pp shard; mark the init accordingly
         state0 = lax.pcast(state0, (axis,), to="varying") \
             if hasattr(lax, "pcast") else lax.pvary(state0, (axis,))
@@ -95,11 +116,17 @@ def gpipe_apply(stage_fn: Callable, stage_params, x, mesh: Mesh = None,
         mine = jnp.where(my == S - 1, outs, jnp.zeros_like(outs))
         return lax.psum(mine, axis)  # replicate the true outputs
 
-    pspec = jax.tree.map(lambda a: P(axis), params)
+    pspec = (param_specs if param_specs is not None
+             else jax.tree.map(lambda a: P(axis), params))
     params = jax.device_put(params, jax.tree.map(
         lambda s: NamedSharding(mesh, s), pspec))
-    fn = shard_map(shard_fn, mesh=mesh, in_specs=(pspec, P()),
-                   out_specs=P())
+    x_spec = P(None, batch_axis) if batch_axis else P()
+    kwargs = {}
+    if param_specs is not None or batch_axis:
+        # in-stage collectives (tp) defeat the static replication checker
+        kwargs["check_vma"] = False
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=(pspec, x_spec),
+                   out_specs=x_spec, **kwargs)
     out = fn(params, xs)
     result = out.reshape((B,) + out.shape[2:])
     return NDArray(result) if isinstance(x, NDArray) else result
