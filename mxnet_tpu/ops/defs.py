@@ -390,10 +390,17 @@ def linalg_potrf(A):
 @op("linalg_trsm")
 def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True,
                 alpha=1.0):
-    x = jax.scipy.linalg.solve_triangular(
-        A, B * alpha, trans=1 if transpose else 0, lower=lower,
-        left=not rightside)
-    return x
+    """Triangular solve (reference ``linalg_trsm``).  jax's
+    solve_triangular is left-side only, so the right-side form
+    ``X·op(A) = alpha·B`` solves the transposed system
+    ``op(A)^T·X^T = alpha·B^T``."""
+    if not rightside:
+        return jax.scipy.linalg.solve_triangular(
+            A, B * alpha, trans=1 if transpose else 0, lower=lower)
+    xt = jax.scipy.linalg.solve_triangular(
+        A, jnp.swapaxes(B * alpha, -1, -2),
+        trans=0 if transpose else 1, lower=lower)
+    return jnp.swapaxes(xt, -1, -2)
 
 
 @op("L2Normalization")
@@ -833,7 +840,7 @@ def amp_cast(data, *, dtype="float16"):
 def amp_multicast(*arrays, num_outputs=0, cast_narrow=False):
     """Cast all inputs to the widest (or narrowest) common float dtype."""
     dtypes = [a.dtype for a in arrays]
-    pick = min if cast_narrow else max
+    pick = _min if cast_narrow else _max
     target = pick(dtypes, key=lambda d: jnp.finfo(d).bits
                   if jnp.issubdtype(d, jnp.floating) else 0)
     return tuple(a.astype(target) for a in arrays)
