@@ -52,8 +52,20 @@ def _call(layer, *vals):
     return out._data if isinstance(out, NDArray) else out
 
 
+def _quantize_rows(w):
+    """Per-output-channel symmetric int8 quantization: w (out, in) →
+    (int8 codes TRANSPOSED to (in, out) for the streaming kernel's
+    canonical matmul layout, f32 scales (out,)).  bf16 exactly represents
+    every int in [-127, 127], so the in-dot convert loses nothing;
+    accumulation runs f32 via ``preferred_element_type``."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(w32), axis=1) / 127.0, 1e-8)
+    wq = jnp.round(w32 / s[:, None]).astype(jnp.int8)
+    return wq.T.copy(), s
+
+
 def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
-                top_k=0, seed=0):
+                top_k=0, seed=0, prefill="batched", weights="native"):
     """Sample ``max_new_tokens`` continuations for a (B, P) prompt.
 
     Greedy when ``temperature == 0``; ``top_k > 0`` restricts the sample
@@ -62,6 +74,18 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     ``model.generate`` token-for-token in greedy mode (the KV-cached
     attention is mathematically identical to full recompute).  Returns
     the full (B, P + max_new_tokens) int32 array.
+
+    ``prefill``: ``"batched"`` (default) runs the whole prompt through
+    ONE causal forward that fills the K/V cache — P-1 sequential scan
+    steps collapse into one MXU-shaped pass; ``"scan"`` keeps the
+    token-at-a-time prefill (same token stream either way — the sampling
+    key at position t is ``fold_in(key, t)`` in both modes).
+
+    ``weights``: ``"int8"`` streams the decode-step matmul weights as
+    per-channel-quantized int8 (half the HBM traffic of bf16 — batch-1
+    decode is weight-streaming-bound), dequantizing inside the dot with
+    f32 accumulation.  GPT-family only; an approximate path — greedy
+    tokens can differ from the exact native path (~0.4% weight error).
     """
     cfg = model._cfg
     H = cfg.num_heads
@@ -72,10 +96,22 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     is_llama = hasattr(model.blocks[0], "rms1")
     KV = getattr(cfg, "num_kv_heads", H) if is_llama else H
     rope_base = float(getattr(cfg, "rope_base", 10000.0))
+    if prefill not in ("batched", "scan"):
+        raise ValueError(f"prefill must be 'batched' or 'scan', "
+                         f"got {prefill!r}")
+    if weights not in ("native", "int8"):
+        raise ValueError(f"weights must be 'native' or 'int8', "
+                         f"got {weights!r}")
+    use_int8 = weights == "int8"
+    if use_int8 and is_llama:
+        raise ValueError("weights='int8' supports the GPT family only "
+                         "(fused-QKV cells); use weights='native'")
     prompt = onp.asarray(
         prompt_tokens.asnumpy() if hasattr(prompt_tokens, "asnumpy")
         else prompt_tokens, dtype=onp.int32)
     B, P = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt.copy()
     total = P + max_new_tokens
     if total > cfg.max_length:
         raise ValueError(f"prompt+new = {total} exceeds max_length "
@@ -93,10 +129,68 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     head = getattr(model, "head", None) or getattr(model, "lm_head", None)
 
     cache_key = (B, P, max_new_tokens, float(temperature), int(top_k),
-                 str(cdtype))
+                 str(cdtype), prefill, weights)
     cache = model.__dict__.setdefault("_kv_decode_cache", {})
 
-    def one_token(x_tok, pos, ck, cv):
+    # -- int8 weight streaming: quantize the decode matmul weights ------ #
+    # codes/scales ride as traced args beside the params, so the compiled
+    # program is reusable after weight updates
+    from ..ops.registry import get_op
+    _act_fn = get_op("Activation").fn
+    q8v = None
+    fc1_act = None
+    if use_int8:
+        fc1_act = getattr(model.blocks[0].ffn.fc1.act, "_act_type", None) \
+            if model.blocks[0].ffn.fc1.act is not None else None
+        # cache the codes keyed on the weight buffer identities: a train
+        # step rebinds the arrays (new ids) and triggers requantization,
+        # but repeated generate calls reuse the codes
+        head_w = (head.weight if head is not None
+                  else model.wte.weight).data()._data
+        lyrs = [(blk.attn.qkv, blk.attn.proj, blk.ffn.fc1, blk.ffn.fc2)
+                for blk in model.blocks]
+        wkey = tuple(id(l.weight.data()._data)
+                     for grp in lyrs for l in grp) + (id(head_w),)
+        q8_cache = model.__dict__.setdefault("_q8_weight_cache", {})
+        if q8_cache.get("key") != wkey:
+            def _q(lyr):
+                wq, s = _quantize_rows(lyr.weight.data()._data)
+                b = None
+                if getattr(lyr, "bias", None) is not None:
+                    b = lyr.bias.data()._data
+                return (wq, s, b)
+
+            q8_cache["key"] = wkey
+            q8_cache["val"] = {
+                "blocks": [{"qkv": _q(q_), "proj": _q(p_),
+                            "fc1": _q(f1), "fc2": _q(f2)}
+                           for q_, p_, f1, f2 in lyrs],
+                "head": _quantize_rows(head_w),
+            }
+        q8v = q8_cache["val"]
+
+    def _dense_q8(x, ent, act_type=None):
+        """Weight-only int8 matvec via the Pallas streaming kernel: int8
+        codes convert to bf16 IN VMEM (exact for |code| ≤ 127), f32 MXU
+        accumulation, per-channel rescale."""
+        from ..ops.q8_matvec import q8_matvec
+        wq, s, b = ent
+        y = q8_matvec(x, wq, s, b).astype(cdtype)
+        if act_type:
+            y = _act_fn(y, act_type=act_type)
+        return y
+
+    def _sample(logits, t, key0):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits / max(float(temperature), 1e-6)
+        if top_k and top_k < lg.shape[-1]:
+            kth = jax.lax.top_k(lg, top_k)[0][:, -1]
+            lg = jnp.where(lg < kth[:, None], -jnp.inf, lg)
+        return jax.random.categorical(
+            jax.random.fold_in(key0, t), lg, axis=-1).astype(jnp.int32)
+
+    def one_token(x_tok, pos, ck, cv, q8=None):
         """x_tok (B,) int32 at position pos -> (logits (B,V), new caches).
         ck/cv: (NL, B, KV, maxT, D).  All layer math comes from the
         model's own sublayers; only the cached-attention core (and RoPE
@@ -119,7 +213,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                                       position_offset=pos)
             else:
                 h = _call(blk.ln1, x)
-                qkv = _call(blk.attn.qkv, h)                  # (B, 3U)
+                qkv = _dense_q8(h, q8["blocks"][i]["qkv"]) if q8 is not None \
+                    else _call(blk.attn.qkv, h)               # (B, 3U)
                 q, k, v = (qkv[:, j * U:(j + 1) * U].reshape(B, H, 1, D)
                            for j in range(3))
             ck = lax.dynamic_update_slice(ck, k[None], (i, 0, 0, pos, 0))
@@ -137,52 +232,130 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
             if is_llama:
                 x = x + _call(blk.attn.o_proj, o)
                 x = x + _call(blk.mlp, _call(blk.rms2, x))
+            elif q8 is not None:
+                x = x + _dense_q8(o, q8["blocks"][i]["proj"])
+                h2 = _call(blk.ln2, x)
+                x = x + _dense_q8(_dense_q8(h2, q8["blocks"][i]["fc1"],
+                                            fc1_act),
+                                  q8["blocks"][i]["fc2"])
             else:
                 x = x + _call(blk.attn.proj, o)
                 x = x + _call(blk.ffn, _call(blk.ln2, x))
         x = _call(model.ln_f, x)
-        if head is not None:
+        if q8 is not None:
+            from ..ops.q8_matvec import q8_matvec
+            hwq, hs = q8["head"]
+            logits = q8_matvec(x, hwq, hs)
+        elif head is not None:
             logits = _call(head, x).astype(jnp.float32)
         else:  # tied-embedding head
             w = model.wte.weight.data()._data                 # traced (swap)
             logits = (x @ w.T).astype(jnp.float32)
         return logits, ck, cv
 
+    def prefill_batch(prompt_dev, ck, cv):
+        """One causal forward over the whole (B, P) prompt: fills cache
+        positions [0, P) and returns the position-P-1 logits.  Exact same
+        math as the per-token path (einsum + f32 softmax), reshaped onto
+        MXU-friendly (B·P, ·) GEMMs."""
+        from ..ops.attention import rope as _rope
+
+        from ..ops.registry import get_op
+        _flash_fn = get_op("flash_attention").fn
+
+        x = _call(model.wte, prompt_dev)                      # (B, P, U)
+        if not is_llama:
+            pos = jnp.arange(P, dtype=jnp.int32)
+            x = x + _call(model.wpe, jnp.broadcast_to(pos[None], (B, P)))
+        for i, blk in enumerate(model.blocks):
+            if is_llama:
+                h = _call(blk.rms1, x)
+                q = _call(blk.attn.q_proj, h).reshape(
+                    B, P, H, D).transpose(0, 2, 1, 3)
+                k = _call(blk.attn.k_proj, h).reshape(
+                    B, P, KV, D).transpose(0, 2, 1, 3)
+                v = _call(blk.attn.v_proj, h).reshape(
+                    B, P, KV, D).transpose(0, 2, 1, 3)
+                q = _rope.__wrapped__(q, base=rope_base, position_offset=0)
+                k = _rope.__wrapped__(k, base=rope_base, position_offset=0)
+            else:
+                h = _call(blk.ln1, x)
+                qkv = _call(blk.attn.qkv, h)                  # (B, P, 3U)
+                q, k, v = (qkv[..., j * U:(j + 1) * U]
+                           .reshape(B, P, H, D).transpose(0, 2, 1, 3)
+                           for j in range(3))
+            ck = lax.dynamic_update_slice(
+                ck, k.astype(cdtype)[None], (i, 0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v.astype(cdtype)[None], (i, 0, 0, 0, 0))
+            # causal attention over the prompt via the flash kernel —
+            # O(P) memory (no (P, P) score tensor), so long prompts
+            # prefill without OOM; GQA repeats k/v across head groups
+            kf, vf = k, v
+            if KV != H:
+                kf = jnp.repeat(k, H // KV, axis=1)
+                vf = jnp.repeat(v, H // KV, axis=1)
+            o = _flash_fn(q, kf, vf, None, scale=scale, causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(B, P, U)
+            if is_llama:
+                x = x + _call(blk.attn.o_proj, o)
+                x = x + _call(blk.mlp, _call(blk.rms2, x))
+            else:
+                x = x + _call(blk.attn.proj, o)
+                x = x + _call(blk.ffn, _call(blk.ln2, x))
+        xl = _call(model.ln_f, x[:, -1])
+        if head is not None:
+            logits = _call(head, xl).astype(jnp.float32)
+        else:
+            w = model.wte.weight.data()._data
+            logits = (xl @ w.T).astype(jnp.float32)
+        return logits, ck, cv
+
     if cache_key not in cache:
-        def run(param_vals, prompt_dev, key0):
-            from ..gluon.parameter import params_swapped
-            with params_swapped(params, param_vals):
+        from ..gluon.parameter import params_swapped
 
-                def scan_body(carry, t):
-                    tok, ck, cv = carry
-                    # teacher-force while t is inside the prompt
-                    cur = jnp.where(t < P,
-                                    prompt_dev[:, jnp.minimum(t, P - 1)],
-                                    tok)
-                    logits, ck, cv = one_token(cur, t, ck, cv)
-                    if temperature == 0.0:
-                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    else:
-                        lg = logits / max(float(temperature), 1e-6)
-                        if top_k and top_k < lg.shape[-1]:
-                            kth = jax.lax.top_k(lg, top_k)[0][:, -1]
-                            lg = jnp.where(lg < kth[:, None], -jnp.inf, lg)
-                        nxt = jax.random.categorical(
-                            jax.random.fold_in(key0, t), lg,
-                            axis=-1).astype(jnp.int32)
-                    return (nxt, ck, cv), nxt
+        if prefill == "batched":
+            def run(param_vals, q8, prompt_dev, key0):
+                with params_swapped(params, param_vals):
+                    ck = jnp.zeros((NL, B, KV, total, D), cdtype)
+                    cv = jnp.zeros((NL, B, KV, total, D), cdtype)
+                    logits, ck, cv = prefill_batch(prompt_dev, ck, cv)
+                    first = _sample(logits, P - 1, key0)
 
-                ck = jnp.zeros((NL, B, KV, total, D), cdtype)
-                cv = jnp.zeros((NL, B, KV, total, D), cdtype)
-                tok0 = jnp.zeros((B,), jnp.int32)
-                (_, _, _), toks = lax.scan(scan_body, (tok0, ck, cv),
-                                           jnp.arange(total - 1))
-                return toks                                    # (T-1, B)
+                    def scan_body(carry, t):
+                        tok, ck, cv = carry
+                        logits, ck, cv = one_token(tok, t, ck, cv, q8)
+                        nxt = _sample(logits, t, key0)
+                        return (nxt, ck, cv), nxt
+
+                    (_, _, _), toks = lax.scan(
+                        scan_body, (first, ck, cv),
+                        jnp.arange(P, total - 1))
+                    return jnp.concatenate([first[None], toks])  # (N, B)
+        else:
+            def run(param_vals, q8, prompt_dev, key0):
+                with params_swapped(params, param_vals):
+
+                    def scan_body(carry, t):
+                        tok, ck, cv = carry
+                        # teacher-force while t is inside the prompt
+                        cur = jnp.where(t < P,
+                                        prompt_dev[:, jnp.minimum(t, P - 1)],
+                                        tok)
+                        logits, ck, cv = one_token(cur, t, ck, cv, q8)
+                        nxt = _sample(logits, t, key0)
+                        return (nxt, ck, cv), nxt
+
+                    ck = jnp.zeros((NL, B, KV, total, D), cdtype)
+                    cv = jnp.zeros((NL, B, KV, total, D), cdtype)
+                    tok0 = jnp.zeros((B,), jnp.int32)
+                    (_, _, _), toks = lax.scan(scan_body, (tok0, ck, cv),
+                                               jnp.arange(total - 1))
+                    # positions P-1 .. total-2 sampled the new tokens
+                    return toks[P - 1:]                        # (N, B)
 
         cache[cache_key] = jax.jit(run)
 
-    toks = onp.asarray(cache[cache_key](
-        param_vals, jnp.asarray(prompt), jax.random.PRNGKey(seed))).T
-    # positions P-1 .. total-2 sampled the new tokens
-    new = toks[:, P - 1:]
+    new = onp.asarray(cache[cache_key](
+        param_vals, q8v, jnp.asarray(prompt), jax.random.PRNGKey(seed))).T
     return onp.concatenate([prompt, new], axis=1)

@@ -1,0 +1,90 @@
+"""Weight-only int8 matvec Pallas kernel (weight-streaming decode).
+
+Batch-1 autoregressive decode is HBM-bound on the weight stream
+(BASELINE.md decode roofline): every emitted token reads every matmul
+weight once, so bytes-per-weight sets the latency floor.  Per-channel
+int8 halves the bytes vs bf16 — but the plain XLA lowering of
+``x @ wq.astype(bf16).T`` materializes the dequantized matrix in HBM
+every step (measured 8x SLOWER than bf16).  The convert must happen in
+VMEM: this kernel streams int8 weight tiles, converts in-register on the
+VPU, and runs the MXU dot with f32 accumulation.
+
+Used by ``kv_generate(weights='int8')`` (models/decoding.py).  Reference
+counterpart: the int8 inference path of the reference's quantization
+subsystem (SURVEY.md §3.2 quantization row) — redesigned TPU-side as a
+serving-decode kernel rather than a calibrated conv/FC graph pass
+(which lives in contrib/quantization.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# shared Pallas gating (one source of truth for the interpret/backend
+# convention — see ops/attention.py)
+from .attention import _interpret
+
+__all__ = ["q8_matvec"]
+
+
+def _on_tpu() -> bool:
+    if _interpret():
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(x_ref, w_ref, out_ref):
+    # int8 -> f32 conversion happens IN VMEM on the VPU (this Mosaic
+    # toolchain rejects bf16 matmul operands — same convention as the
+    # flash kernel); HBM only ever sees the int8 codes
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    out_ref[:] = jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+def _pick_bo(O: int, limit: int = 2048) -> int:
+    """Largest output-tile size that divides O, is a multiple of 128 (the
+    lane tile — O is the minor dim of the (K, O) codes), and keeps the
+    weight tile comfortably in VMEM."""
+    for bo in range(min(O, limit), 0, -128):
+        if O % bo == 0 and bo % 128 == 0:
+            return bo
+    return 0
+
+
+def q8_matvec(x, wt, s, bias=None):
+    """``(x @ wt) * s + bias`` with int8 weights streamed from HBM.
+
+    - ``x`` (B, K) float (bf16/f32) — B is the decode batch, small;
+    - ``wt`` (K, O) int8 codes, PRE-TRANSPOSED at quantization time so
+      the kernel runs the canonical (B,K)x(K,O) Mosaic matmul (a
+      transpose inside the kernel would relayout every tile);
+    - ``s`` (O,) f32 per-output-channel scales; ``bias`` (O,) optional.
+
+    Returns (B, O) float32.  Falls back to the XLA einsum off-TPU or for
+    shapes the kernel can't tile (K not sublane-aligned).
+    """
+    B, K = x.shape
+    O = wt.shape[1]
+    bo = _pick_bo(O)
+    if not _on_tpu() or K % 32 or not bo:
+        y = jnp.einsum("bi,io->bo", x, wt.astype(x.dtype),
+                       preferred_element_type=jnp.float32)
+    else:
+        y = pl.pallas_call(
+            _kernel,
+            grid=(O // bo,),
+            in_specs=[pl.BlockSpec((B, K), lambda o: (0, 0)),
+                      pl.BlockSpec((K, bo), lambda o: (0, o))],
+            out_specs=pl.BlockSpec((B, bo), lambda o: (0, o)),
+            out_shape=jax.ShapeDtypeStruct((B, O), jnp.float32),
+            interpret=_interpret(),
+        )(x, wt)
+    y = y * s
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y

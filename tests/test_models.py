@@ -240,6 +240,77 @@ class TestKVCacheDecoding:
             ref = onp.concatenate([ref, nxt[:, None]], axis=1)
         onp.testing.assert_array_equal(out, ref)
 
+    def test_batched_prefill_matches_scan_prefill(self):
+        """prefill='batched' (one causal forward fills the cache) must
+        emit the same token stream as the token-at-a-time scan prefill —
+        greedy AND sampled (the per-position fold_in keys are shared)."""
+        from mxnet_tpu.models import kv_generate
+        net = self._model()
+        prompt = onp.random.RandomState(3).randint(0, 97, (2, 6))
+        for kw in (dict(temperature=0.0),
+                   dict(temperature=0.8, top_k=5, seed=7)):
+            a = kv_generate(net, prompt, max_new_tokens=9,
+                            prefill="batched", **kw)
+            b = kv_generate(net, prompt, max_new_tokens=9,
+                            prefill="scan", **kw)
+            onp.testing.assert_array_equal(a, b)
+
+    def test_zero_new_tokens_is_identity(self):
+        from mxnet_tpu.models import kv_generate
+        net = self._model()
+        prompt = onp.random.RandomState(8).randint(0, 97, (2, 5))
+        for mode in ("batched", "scan"):
+            out = kv_generate(net, prompt, max_new_tokens=0, prefill=mode)
+            onp.testing.assert_array_equal(out, prompt)
+
+    def test_single_new_token_batched(self):
+        """N=1 means an empty decode scan — the prefill logits alone
+        produce the one new token."""
+        from mxnet_tpu.models import kv_generate
+        net = self._model()
+        prompt = onp.random.RandomState(4).randint(0, 97, (1, 5))
+        ref = net.generate(prompt, max_new_tokens=1, temperature=0.0)
+        out = kv_generate(net, prompt, max_new_tokens=1, temperature=0.0)
+        onp.testing.assert_array_equal(out, ref)
+
+    def test_int8_weight_streaming(self):
+        """weights='int8': per-channel weight-only quantization.  The
+        path is documented-approximate, so assert (a) runs/shape/
+        determinism, (b) the quantized logits stay close to native — via
+        the _quantize_rows error bound on a real layer weight."""
+        import jax.numpy as jnp
+        from mxnet_tpu.models import kv_generate
+        from mxnet_tpu.models.decoding import _quantize_rows
+        net = self._model()
+        prompt = onp.random.RandomState(6).randint(0, 97, (2, 5))
+        out = kv_generate(net, prompt, max_new_tokens=8, temperature=0.0,
+                          weights="int8")
+        assert out.shape == (2, 13)
+        assert (out[:, :5] == prompt).all()
+        out2 = kv_generate(net, prompt, max_new_tokens=8, temperature=0.0,
+                           weights="int8")
+        onp.testing.assert_array_equal(out, out2)
+        # quantization error bound: per-channel int8 reconstruction of a
+        # real weight is within half a quantization step of the original
+        # (codes come back transposed (in, out) for the streaming kernel)
+        w = net.blocks[0].attn.qkv.weight.data()._data
+        wt, s = _quantize_rows(w)
+        recon = onp.asarray(wt, onp.float32).T * onp.asarray(s)[:, None]
+        err = onp.abs(recon - onp.asarray(w, onp.float32)).max(axis=1)
+        bound = onp.asarray(s) * 0.5 + 1e-6
+        assert (err <= bound).all()
+
+    def test_int8_rejects_llama(self):
+        from mxnet_tpu.models import Llama, LlamaConfig, kv_generate
+        mx.random.seed(0)
+        net = Llama(LlamaConfig(vocab_size=64, max_length=32, num_layers=1,
+                                units=32, num_heads=4, num_kv_heads=2,
+                                hidden_size=64))
+        net.initialize(mx.init.Normal(0.02))
+        with pytest.raises(ValueError, match="int8"):
+            kv_generate(net, onp.zeros((1, 4), onp.int32),
+                        max_new_tokens=2, weights="int8")
+
     def test_second_model_config_relu_ffn(self):
         """The decoder derives layer math from the Block itself: a model
         variant with a RELU FFN (different activation inside ffn) must
