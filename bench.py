@@ -103,7 +103,11 @@ def _inner():
     # latency doesn't pollute the device-throughput measurement.
     for _ in range(2):
         float(onp.asarray(trainer.step(data, label).asnumpy()).reshape(()))
-    n_steps = 20 if on_tpu else 4
+    # 60 steps per dispatch: the remote-tunnel RTT (~0.1 s per call) is a
+    # fixed cost — at 20 steps it still cost ~5 ms/step of phantom wall
+    # time (measured r4: N=20 -> 50.8 ms/step, N=60 -> 45.2 ms/step, vs
+    # 43.6 ms device time from the per-op profile)
+    n_steps = 60 if on_tpu else 4
     steps_data = mx.nd.array(onp.broadcast_to(toks, (n_steps,) + toks.shape))
     steps_label = mx.nd.array(onp.broadcast_to(labels,
                                                (n_steps,) + labels.shape))

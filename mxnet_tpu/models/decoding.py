@@ -142,17 +142,25 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     if use_int8:
         fc1_act = getattr(model.blocks[0].ffn.fc1.act, "_act_type", None) \
             if model.blocks[0].ffn.fc1.act is not None else None
-        # cache the codes keyed on the weight buffer identities: a train
-        # step rebinds the arrays (new ids) and triggers requantization,
-        # but repeated generate calls reuse the codes
+        # cache the codes keyed on the SOURCE ARRAYS THEMSELVES (weights
+        # AND biases), compared by `is` against pinned strong refs — a
+        # train step rebinds the arrays and triggers requantization,
+        # while repeated generate calls reuse the codes.  Pinning the
+        # sources (not id() snapshots) is load-bearing: freed buffer
+        # addresses get recycled by CPython, so an id()-keyed cache can
+        # silently serve stale codes after an update.
         head_w = (head.weight if head is not None
                   else model.wte.weight).data()._data
         lyrs = [(blk.attn.qkv, blk.attn.proj, blk.ffn.fc1, blk.ffn.fc2)
                 for blk in model.blocks]
-        wkey = tuple(id(l.weight.data()._data)
-                     for grp in lyrs for l in grp) + (id(head_w),)
+        srcs = [l.weight.data()._data for grp in lyrs for l in grp]
+        srcs += [l.bias.data()._data for grp in lyrs for l in grp
+                 if getattr(l, "bias", None) is not None]
+        srcs.append(head_w)
         q8_cache = model.__dict__.setdefault("_q8_weight_cache", {})
-        if q8_cache.get("key") != wkey:
+        cached = q8_cache.get("srcs")
+        if cached is None or len(cached) != len(srcs) or \
+                not all(a is b for a, b in zip(cached, srcs)):
             def _q(lyr):
                 wq, s = _quantize_rows(lyr.weight.data()._data)
                 b = None
@@ -160,7 +168,7 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                     b = lyr.bias.data()._data
                 return (wq, s, b)
 
-            q8_cache["key"] = wkey
+            q8_cache["srcs"] = srcs
             q8_cache["val"] = {
                 "blocks": [{"qkv": _q(q_), "proj": _q(p_),
                             "fc1": _q(f1), "fc2": _q(f2)}

@@ -48,16 +48,22 @@ def _interpret() -> bool:
     return os.environ.get("MXNET_FLASH_INTERPRET", "") == "1"
 
 
-def _use_pallas() -> bool:
-    env = os.environ.get("MXNET_USE_FLASH_ATTENTION", "").lower()
-    if env in ("0", "false", "off"):
-        return False
+def _pallas_backend_ok() -> bool:
+    """Shared Pallas backend gate (flash, q8_matvec): interpret mode or a
+    real TPU backend."""
     if _interpret():
         return True
     try:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def _use_pallas() -> bool:
+    env = os.environ.get("MXNET_USE_FLASH_ATTENTION", "").lower()
+    if env in ("0", "false", "off"):
+        return False
+    return _pallas_backend_ok()
 
 
 def _is_kmask(bias) -> bool:

@@ -23,18 +23,9 @@ from jax.experimental import pallas as pl
 
 # shared Pallas gating (one source of truth for the interpret/backend
 # convention — see ops/attention.py)
-from .attention import _interpret
+from .attention import _interpret, _pallas_backend_ok as _on_tpu
 
 __all__ = ["q8_matvec"]
-
-
-def _on_tpu() -> bool:
-    if _interpret():
-        return True
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
 
 
 def _kernel(x_ref, w_ref, out_ref):
