@@ -51,9 +51,14 @@ def main():
     x = rng.rand(bs, 3, hw, hw).astype(
         "bfloat16" if on_tpu else "float32")
     y = rng.randint(0, 1000, bs).astype(onp.float32)
-    n_steps = 10 if on_tpu else 2
-    sd = mx.nd.array(onp.broadcast_to(x, (n_steps,) + x.shape))
-    sl = mx.nd.array(onp.broadcast_to(y, (n_steps,) + y.shape))
+    # ≥30 steps per dispatch: the fixed ~0.1 s tunnel RTT cost ~10 ms of
+    # phantom wall time per step at n=10 (see BASELINE.md r4 methodology)
+    n_steps = 30 if on_tpu else 2
+    # transfer ONE batch, broadcast device-side: 30 host copies would
+    # ship ~1 GB over the ~33 MB/s tunnel for identical data
+    import jax.numpy as jnp
+    sd = mx.nd.array(jnp.broadcast_to(jnp.asarray(x), (n_steps,) + x.shape))
+    sl = mx.nd.array(jnp.broadcast_to(jnp.asarray(y), (n_steps,) + y.shape))
     # compile + warmup, then best-of-3 fused multi-step scans
     float(onp.asarray(trainer.run_steps(sd, sl).asnumpy()).reshape(-1)[0])
     best = None
