@@ -110,9 +110,9 @@ def _inner():
     n_steps = 60 if on_tpu else 4
     # one h2d transfer + device-side broadcast (tunnel is ~33 MB/s)
     import jax.numpy as jnp
-    steps_data = mx.nd.array(jnp.broadcast_to(
+    steps_data = mx.nd.from_jax(jnp.broadcast_to(
         jnp.asarray(toks), (n_steps,) + toks.shape))
-    steps_label = mx.nd.array(jnp.broadcast_to(
+    steps_label = mx.nd.from_jax(jnp.broadcast_to(
         jnp.asarray(labels), (n_steps,) + labels.shape))
     # compile the multi-step program outside the timed region
     float(onp.asarray(trainer.run_steps(

@@ -57,8 +57,8 @@ def main():
     # transfer ONE batch, broadcast device-side: 30 host copies would
     # ship ~1 GB over the ~33 MB/s tunnel for identical data
     import jax.numpy as jnp
-    sd = mx.nd.array(jnp.broadcast_to(jnp.asarray(x), (n_steps,) + x.shape))
-    sl = mx.nd.array(jnp.broadcast_to(jnp.asarray(y), (n_steps,) + y.shape))
+    sd = mx.nd.from_jax(jnp.broadcast_to(jnp.asarray(x), (n_steps,) + x.shape))
+    sl = mx.nd.from_jax(jnp.broadcast_to(jnp.asarray(y), (n_steps,) + y.shape))
     # compile + warmup, then best-of-3 fused multi-step scans
     float(onp.asarray(trainer.run_steps(sd, sl).asnumpy()).reshape(-1)[0])
     best = None

@@ -27,9 +27,9 @@ PEAK_TFLOPS = 197.0
 def _bench_steps(trainer, mx, data, label, n_steps, reps=3):
     # one h2d transfer + device-side broadcast (tunnel is ~33 MB/s)
     import jax.numpy as jnp
-    sd = mx.nd.array(jnp.broadcast_to(jnp.asarray(data),
+    sd = mx.nd.from_jax(jnp.broadcast_to(jnp.asarray(data),
                                       (n_steps,) + data.shape))
-    sl = mx.nd.array(jnp.broadcast_to(jnp.asarray(label),
+    sl = mx.nd.from_jax(jnp.broadcast_to(jnp.asarray(label),
                                       (n_steps,) + label.shape))
     float(onp.asarray(trainer.run_steps(sd, sl).asnumpy()).reshape(-1)[0])
     best = None

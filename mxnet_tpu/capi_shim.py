@@ -73,3 +73,118 @@ def free(h: int) -> None:
 
 def version() -> int:
     return 10900  # parity: reports the MXNet 1.9 line
+
+
+# ======================================================================= #
+# training ABI (VERDICT r3 item 5): NDArray / Symbol / Executor handles.
+# Reference surface: src/c_api/c_api.cc MXNDArray* / MXSymbol* /
+# MXExecutor* (SURVEY.md §3.1 "C API" row).  float32 subset — the
+# training loop a C host needs: create arrays, copy in/out, bind an
+# executor, forward, backward, read grads, write updated weights.
+# ======================================================================= #
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}  # kNullOp..kAddTo
+
+
+def _put(obj) -> int:
+    with _lock:
+        h = _next_id[0]
+        _next_id[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def nd_create(shape) -> int:
+    from . import ndarray as nd
+    return _put({"nd": nd.zeros(tuple(int(d) for d in shape))})
+
+
+# NDArray handles release through the same table as predictors/symbols
+nd_free = free
+
+
+def nd_sync_copy_from(h: int, buf: bytes) -> None:
+    from . import ndarray as nd
+    entry = _handles[h]
+    arr = entry["nd"]
+    data = onp.frombuffer(buf, dtype=onp.float32)
+    if data.size != arr.size:
+        raise ValueError(
+            f"SyncCopyFromCPU: got {data.size} elements, ndarray has "
+            f"{arr.size}")
+    entry["nd"]._rebind(nd.array(data.reshape(arr.shape))._data)
+
+
+def nd_sync_copy_to(h: int) -> bytes:
+    return onp.ascontiguousarray(
+        onp.asarray(_handles[h]["nd"].asnumpy(), dtype=onp.float32)
+    ).tobytes()
+
+
+def nd_get_shape(h: int) -> tuple:
+    return tuple(int(d) for d in _handles[h]["nd"].shape)
+
+
+def sym_create_from_file(fname: str) -> int:
+    from .symbol import symbol as sym_mod
+    return _put({"sym": sym_mod.load(fname)})
+
+
+def sym_list_arguments(h: int) -> tuple:
+    return tuple(_handles[h]["sym"].list_arguments())
+
+
+def sym_infer_shape(h: int, keys, indptr, shape_data):
+    """Returns (in_shapes, out_shapes, aux_shapes) as tuples of tuples,
+    argument order = list_arguments().  Partial inputs are completed via
+    the InferShape pass (reference semantics: parameter shapes are
+    DEDUCED from the data shapes)."""
+    sym = _handles[h]["sym"]
+    shapes = {}
+    for i, key in enumerate(keys):
+        shapes[key] = tuple(
+            int(d) for d in shape_data[indptr[i]:indptr[i + 1]])
+    arg_names = sym.list_arguments()
+    if any(nm not in shapes for nm in arg_names):
+        from .symbol.symbol import infer_args
+        shapes = infer_args(sym, **shapes)
+    in_shapes, out_shapes, aux_shapes = sym.infer_shape(**shapes)
+    return (tuple(map(tuple, in_shapes)), tuple(map(tuple, out_shapes)),
+            tuple(map(tuple, aux_shapes)))
+
+
+def executor_bind(sym_h: int, arg_handles, grad_handles, grad_reqs) -> int:
+    sym = _handles[sym_h]["sym"]
+    arg_names = sym.list_arguments()
+    if len(arg_handles) != len(arg_names):
+        raise ValueError(
+            f"bind: {len(arg_names)} arguments expected "
+            f"({arg_names}), got {len(arg_handles)} handles")
+    args = {nm: _handles[ah]["nd"]
+            for nm, ah in zip(arg_names, arg_handles)}
+    req = {nm: _GRAD_REQ.get(int(r), "null")
+           for nm, r in zip(arg_names, grad_reqs)}
+    args_grad = {nm: _handles[gh]["nd"]
+                 for nm, gh, r in zip(arg_names, grad_handles, grad_reqs)
+                 if gh and _GRAD_REQ.get(int(r), "null") != "null"}
+    exe = sym.bind(args=args, args_grad=args_grad, grad_req=req)
+    return _put({"exec": exe, "outputs": []})
+
+
+def executor_forward(h: int, is_train: int) -> int:
+    entry = _handles[h]
+    entry["outputs"] = entry["exec"].forward(is_train=bool(is_train))
+    return len(entry["outputs"])
+
+
+def executor_backward(h: int) -> None:
+    _handles[h]["exec"].backward()
+
+
+def executor_num_outputs(h: int) -> int:
+    return len(_handles[h]["outputs"])
+
+
+def executor_output(h: int, index: int) -> int:
+    """Wrap output ``index`` as a NEW ndarray handle (caller frees)."""
+    return _put({"nd": _handles[h]["outputs"][index]})

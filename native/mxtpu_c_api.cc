@@ -252,3 +252,346 @@ int MXPredFree(PredictorHandle handle) {
 }
 
 }  // extern "C"
+
+// ========================================================================
+// Training ABI subset (reference src/c_api/c_api.cc: MXNDArray* /
+// MXSymbol* / MXExecutor*, SURVEY.md §3.1 "C API" row; VERDICT r3
+// item 5).  float32; enough for a C host to run a full train loop:
+// create arrays, copy in/out, bind, forward, backward, read grads.
+// ========================================================================
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+struct MXNDState {
+  long shim_handle;
+  std::vector<mx_uint> shape_buf;  // MXNDArrayGetShape backing store
+};
+
+struct MXSymState {
+  long shim_handle;
+  // MXSymbolInferShape backing stores (valid until next call, per
+  // reference semantics)
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<mx_uint> ndims[3];
+  std::vector<const mx_uint *> datas[3];
+};
+
+struct MXExecState {
+  long shim_handle;
+};
+
+// call a shim function returning a long handle; -1 on python error
+static long call_long(PyObject *r) {
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return v;
+}
+
+extern "C" {
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *shp = PyList_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  long h = call_long(PyObject_CallMethod(shim(), "nd_create", "O", shp));
+  Py_DECREF(shp);
+  if (h < 0) return -1;
+  auto *st = new MXNDState();
+  st->shim_handle = h;
+  *out = st;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  Gil gil;
+  auto *st = static_cast<MXNDState *>(handle);
+  PyObject *r =
+      PyObject_CallMethod(shim(), "nd_free", "l", st->shim_handle);
+  Py_XDECREF(r);
+  delete st;
+  return r ? 0 : (capture_py_error(), -1);
+}
+
+// `size` counts ELEMENTS (reference semantics), not bytes
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  Gil gil;
+  auto *st = static_cast<MXNDState *>(handle);
+  PyObject *buf = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject *r = PyObject_CallMethod(shim(), "nd_sync_copy_from", "lO",
+                                    st->shim_handle, buf);
+  Py_DECREF(buf);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  Gil gil;
+  auto *st = static_cast<MXNDState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "nd_sync_copy_to", "l",
+                                    st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    capture_py_error();
+    return -1;
+  }
+  if (static_cast<Py_ssize_t>(size * sizeof(float)) < len) {
+    Py_DECREF(r);
+    set_error("MXNDArraySyncCopyToCPU: buffer too small");
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(len));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  Gil gil;
+  auto *st = static_cast<MXNDState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "nd_get_shape", "l",
+                                    st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(r);
+  st->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    st->shape_buf[static_cast<size_t>(i)] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(r, i)));
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = st->shape_buf.data();
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  long h = call_long(
+      PyObject_CallMethod(shim(), "sym_create_from_file", "s", fname));
+  if (h < 0) return -1;
+  auto *st = new MXSymState();
+  st->shim_handle = h;
+  *out = st;
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  Gil gil;
+  auto *st = static_cast<MXSymState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "free", "l", st->shim_handle);
+  Py_XDECREF(r);
+  delete st;
+  return r ? 0 : (capture_py_error(), -1);
+}
+
+// list_arguments via a CSV into a caller buffer would diverge from the
+// reference; instead expose the count + per-index name (both shim-side
+// tuples are cheap) so hosts can build arg tables.
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_str_array) {
+  Gil gil;
+  auto *st = static_cast<MXSymState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "sym_list_arguments", "l",
+                                    st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  static thread_local std::vector<std::string> name_store;
+  static thread_local std::vector<const char *> ptr_store;
+  Py_ssize_t n = PyTuple_Size(r);
+  name_store.clear();
+  ptr_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    name_store.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(r, i)));
+  for (auto &s : name_store) ptr_store.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out_str_array = ptr_store.data();
+  return 0;
+}
+
+int MXSymbolInferShape(
+    SymbolHandle handle, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  Gil gil;
+  auto *st = static_cast<MXSymState *>(handle);
+  PyObject *k = PyList_New(num_args);
+  PyObject *ip = PyList_New(num_args + 1);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+  for (mx_uint i = 0; i <= num_args; ++i)
+    PyList_SetItem(ip, i, PyLong_FromUnsignedLong(arg_ind_ptr[i]));
+  mx_uint nd = arg_ind_ptr[num_args];
+  PyObject *sd = PyList_New(nd);
+  for (mx_uint i = 0; i < nd; ++i)
+    PyList_SetItem(sd, i, PyLong_FromUnsignedLong(arg_shape_data[i]));
+  PyObject *r = PyObject_CallMethod(shim(), "sym_infer_shape", "lOOO",
+                                    st->shim_handle, k, ip, sd);
+  Py_DECREF(k);
+  Py_DECREF(ip);
+  Py_DECREF(sd);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  st->shapes.clear();
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims_out[3] = {in_shape_ndim, out_shape_ndim,
+                                  aux_shape_ndim};
+  const mx_uint ***datas_out[3] = {in_shape_data, out_shape_data,
+                                   aux_shape_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *grp = PyTuple_GetItem(r, g);
+    Py_ssize_t n = PyTuple_Size(grp);
+    st->ndims[g].clear();
+    st->datas[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *shp = PyTuple_GetItem(grp, i);
+      Py_ssize_t m = PyTuple_Size(shp);
+      st->shapes.emplace_back();
+      auto &vec = st->shapes.back();
+      for (Py_ssize_t j = 0; j < m; ++j)
+        vec.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
+      st->ndims[g].push_back(static_cast<mx_uint>(m));
+    }
+  }
+  // second pass for data pointers: st->shapes no longer reallocates
+  size_t idx = 0;
+  for (int g = 0; g < 3; ++g) {
+    for (size_t i = 0; i < st->ndims[g].size(); ++i)
+      st->datas[g].push_back(st->shapes[idx++].data());
+    *sizes[g] = static_cast<mx_uint>(st->ndims[g].size());
+    *ndims_out[g] = st->ndims[g].data();
+    *datas_out[g] = st->datas[g].data();
+  }
+  Py_DECREF(r);
+  *complete = 1;
+  return 0;
+}
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  (void)dev_type; (void)dev_id; (void)aux_states_len; (void)aux_states;
+  Gil gil;
+  auto *sym = static_cast<MXSymState *>(symbol_handle);
+  PyObject *args = PyList_New(len);
+  PyObject *grads = PyList_New(len);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyList_SetItem(args, i, PyLong_FromLong(
+        static_cast<MXNDState *>(in_args[i])->shim_handle));
+    PyList_SetItem(grads, i, PyLong_FromLong(
+        arg_grad_store && arg_grad_store[i]
+            ? static_cast<MXNDState *>(arg_grad_store[i])->shim_handle
+            : 0));
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(
+        grad_req_type ? grad_req_type[i] : 0));
+  }
+  long h = call_long(PyObject_CallMethod(
+      shim(), "executor_bind", "lOOO", sym->shim_handle, args, grads,
+      reqs));
+  Py_DECREF(args);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  if (h < 0) return -1;
+  auto *st = new MXExecState();
+  st->shim_handle = h;
+  *out = st;
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  Gil gil;
+  auto *st = static_cast<MXExecState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "executor_forward", "li",
+                                    st->shim_handle, is_train);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  (void)len; (void)head_grads;  // mean-loss heads: default ones
+  Gil gil;
+  auto *st = static_cast<MXExecState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "executor_backward", "l",
+                                    st->shim_handle);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  Gil gil;
+  auto *st = static_cast<MXExecState *>(handle);
+  long n = call_long(PyObject_CallMethod(shim(), "executor_num_outputs",
+                                         "l", st->shim_handle));
+  if (n < 0) return -1;
+  static thread_local std::vector<NDArrayHandle> out_store;
+  out_store.clear();
+  for (long i = 0; i < n; ++i) {
+    long h = call_long(PyObject_CallMethod(
+        shim(), "executor_output", "ll", st->shim_handle, i));
+    if (h < 0) return -1;
+    auto *nd = new MXNDState();
+    nd->shim_handle = h;
+    out_store.push_back(nd);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out = out_store.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  Gil gil;
+  auto *st = static_cast<MXExecState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "free", "l", st->shim_handle);
+  Py_XDECREF(r);
+  delete st;
+  return r ? 0 : (capture_py_error(), -1);
+}
+
+}  // extern "C"

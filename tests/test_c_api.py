@@ -202,3 +202,239 @@ class TestStandaloneCHost:
         got = onp.asarray([float(v) for v in line[4:].split()],
                           onp.float32)
         onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# Training ABI (VERDICT r3 item 5): a REAL C host trains an MNIST-style
+# MLP through MXNDArray* / MXSymbol* / MXExecutor* — create arrays, infer
+# shapes from data shapes alone, bind, forward, backward, SGD in C.
+# --------------------------------------------------------------------- #
+
+C_TRAIN_HOST = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+typedef unsigned int mx_uint;
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern const char* MXGetLastError();
+extern int MXNDArrayCreate(const mx_uint*, mx_uint, int, int, int,
+                           NDArrayHandle*);
+extern int MXNDArrayFree(NDArrayHandle);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle, const void*,
+                                    unsigned long);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle, void*, unsigned long);
+extern int MXNDArrayGetShape(NDArrayHandle, mx_uint*, const mx_uint**);
+extern int MXSymbolCreateFromFile(const char*, SymbolHandle*);
+extern int MXSymbolFree(SymbolHandle);
+extern int MXSymbolListArguments(SymbolHandle, mx_uint*, const char***);
+extern int MXSymbolInferShape(SymbolHandle, mx_uint, const char**,
+    const mx_uint*, const mx_uint*,
+    mx_uint*, const mx_uint**, const mx_uint***,
+    mx_uint*, const mx_uint**, const mx_uint***,
+    mx_uint*, const mx_uint**, const mx_uint***, int*);
+extern int MXExecutorBind(SymbolHandle, int, int, mx_uint, NDArrayHandle*,
+                          NDArrayHandle*, mx_uint*, mx_uint,
+                          NDArrayHandle*, ExecutorHandle*);
+extern int MXExecutorForward(ExecutorHandle, int);
+extern int MXExecutorBackward(ExecutorHandle, mx_uint, NDArrayHandle*);
+extern int MXExecutorOutputs(ExecutorHandle, mx_uint*, NDArrayHandle**);
+extern int MXExecutorFree(ExecutorHandle);
+#ifdef __cplusplus
+}
+#endif
+
+#define CHECK(x) if ((x) != 0) { \
+    fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, \
+            MXGetLastError()); return 1; }
+
+#define B 64
+#define NF 16
+#define NC 3
+
+static unsigned lcg_state = 12345u;
+static float frand(void) {  /* deterministic U(-0.5, 0.5) */
+  lcg_state = lcg_state * 1664525u + 1013904223u;
+  return ((lcg_state >> 8) & 0xFFFFFF) / 16777216.0f - 0.5f;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) { fprintf(stderr, "usage: host symbol.json\n"); return 2; }
+  SymbolHandle sym;
+  CHECK(MXSymbolCreateFromFile(argv[1], &sym));
+
+  mx_uint n_args; const char** arg_names;
+  CHECK(MXSymbolListArguments(sym, &n_args, &arg_names));
+  printf("n_args=%u\n", n_args);
+
+  /* infer every argument shape from data+label alone */
+  const char* keys[] = {"data", "label"};
+  mx_uint indptr[] = {0, 2, 3};
+  mx_uint shape_data[] = {B, NF, B};
+  mx_uint in_n, out_n, aux_n; int complete;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  CHECK(MXSymbolInferShape(sym, 2, keys, indptr, shape_data,
+                           &in_n, &in_nd, &in_sh,
+                           &out_n, &out_nd, &out_sh,
+                           &aux_n, &aux_nd, &aux_sh, &complete));
+  printf("inferred in=%u out=%u complete=%d\n", in_n, out_n, complete);
+  if (in_n != n_args) { fprintf(stderr, "arg count mismatch\n"); return 1; }
+
+  /* create arg + grad arrays from the inferred shapes */
+  NDArrayHandle args[16], grads[16];
+  mx_uint reqs[16];
+  mx_uint sizes[16];
+  for (mx_uint i = 0; i < in_n; ++i) {
+    CHECK(MXNDArrayCreate(in_sh[i], in_nd[i], 1, 0, 0, &args[i]));
+    mx_uint sz = 1;
+    for (mx_uint d = 0; d < in_nd[i]; ++d) sz *= in_sh[i][d];
+    sizes[i] = sz;
+    int is_param = strcmp(arg_names[i], "data") != 0 &&
+                   strcmp(arg_names[i], "label") != 0;
+    reqs[i] = is_param ? 1 : 0;  /* kWriteTo : kNullOp */
+    if (is_param) {
+      CHECK(MXNDArrayCreate(in_sh[i], in_nd[i], 1, 0, 0, &grads[i]));
+      float* init = (float*)malloc(sz * sizeof(float));
+      for (mx_uint j = 0; j < sz; ++j) init[j] = 0.2f * frand();
+      CHECK(MXNDArraySyncCopyFromCPU(args[i], init, sz));
+      free(init);
+    } else {
+      grads[i] = NULL;
+    }
+  }
+
+  /* synthetic separable data: 3 clusters on the first 3 features */
+  float x[B * NF], y[B];
+  for (int i = 0; i < B; ++i) {
+    int c = i % NC;
+    y[i] = (float)c;
+    for (int f = 0; f < NF; ++f)
+      x[i * NF + f] = 0.3f * frand() + (f == c ? 2.0f : 0.0f);
+  }
+  for (mx_uint i = 0; i < in_n; ++i) {
+    if (strcmp(arg_names[i], "data") == 0)
+      CHECK(MXNDArraySyncCopyFromCPU(args[i], x, B * NF));
+    if (strcmp(arg_names[i], "label") == 0)
+      CHECK(MXNDArraySyncCopyFromCPU(args[i], y, B));
+  }
+
+  ExecutorHandle exec;
+  CHECK(MXExecutorBind(sym, 1, 0, in_n, args, grads, reqs, 0, NULL,
+                       &exec));
+
+  float first_loss = 0.0f, loss = 0.0f;
+  float lr = 0.5f;
+  for (int step = 0; step < 40; ++step) {
+    CHECK(MXExecutorForward(exec, 1));
+    mx_uint n_out; NDArrayHandle* outs;
+    CHECK(MXExecutorOutputs(exec, &n_out, &outs));
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], &loss, 1));
+    for (mx_uint i = 0; i < n_out; ++i) MXNDArrayFree(outs[i]);
+    if (step == 0) first_loss = loss;
+    CHECK(MXExecutorBackward(exec, 0, NULL));
+    /* SGD in C: read grad, update, write back */
+    for (mx_uint i = 0; i < in_n; ++i) {
+      if (reqs[i] == 0) continue;
+      float* w = (float*)malloc(sizes[i] * sizeof(float));
+      float* g = (float*)malloc(sizes[i] * sizeof(float));
+      CHECK(MXNDArraySyncCopyToCPU(args[i], w, sizes[i]));
+      CHECK(MXNDArraySyncCopyToCPU(grads[i], g, sizes[i]));
+      for (mx_uint j = 0; j < sizes[i]; ++j) w[j] -= lr * g[j];
+      CHECK(MXNDArraySyncCopyFromCPU(args[i], w, sizes[i]));
+      free(w); free(g);
+    }
+  }
+  printf("first_loss=%.6f last_loss=%.6f\n", first_loss, loss);
+
+  CHECK(MXExecutorFree(exec));
+  for (mx_uint i = 0; i < in_n; ++i) {
+    CHECK(MXNDArrayFree(args[i]));
+    if (grads[i]) CHECK(MXNDArrayFree(grads[i]));
+  }
+  CHECK(MXSymbolFree(sym));
+  if (!(loss < 0.5f * first_loss)) {
+    fprintf(stderr, "loss did not decrease enough\n");
+    return 1;
+  }
+  printf("C_TRAIN_OK\n");
+  return 0;
+}
+"""
+
+
+class TestCTrainingABI:
+    def _export_train_symbol(self, tmp_path):
+        B, F, H, C = 64, 16, 32, 3
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("label")
+        w1 = mx.sym.Variable("w1")
+        b1 = mx.sym.Variable("b1")
+        w2 = mx.sym.Variable("w2")
+        b2 = mx.sym.Variable("b2")
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(data, w1, b1, num_hidden=H),
+            act_type="relu")
+        out = mx.sym.FullyConnected(h, w2, b2, num_hidden=C)
+        loss = mx.sym.softmax_cross_entropy(out, label) / float(B)
+        path = str(tmp_path / "train-symbol.json")
+        loss.save(path)
+        return path
+
+    def test_c_host_trains_mlp(self, tmp_path):
+        """Compile a standalone C program that creates NDArrays, infers
+        shapes from the data shapes alone, binds an executor, and runs a
+        40-step SGD loop entirely through the flat C ABI — the loss must
+        drop below half its initial value."""
+        _build_lib()
+        symf = self._export_train_symbol(tmp_path)
+        src = tmp_path / "train_host.c"
+        src.write_text(C_TRAIN_HOST)
+        exe = tmp_path / "train_host"
+        libdir = os.path.dirname(LIB)
+        subprocess.run(
+            ["g++", str(src), "-o", str(exe), f"-L{libdir}",
+             "-lmxtpu_capi", f"-Wl,-rpath,{libdir}"],
+            check=True, capture_output=True, text=True)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run([str(exe), symf], capture_output=True,
+                              text=True, env=env, timeout=600)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+        assert "C_TRAIN_OK" in proc.stdout
+        assert "n_args=6" in proc.stdout
+        assert "inferred in=6 out=1 complete=1" in proc.stdout
+
+    def test_training_abi_via_ctypes(self, tmp_path):
+        """Same ABI from a ctypes host (reuses the in-process
+        interpreter): NDArray round-trip + shape query."""
+        _build_lib()
+        lib = ctypes.CDLL(LIB)
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_uint * 2)(3, 4)
+        assert lib.MXNDArrayCreate(shape, 2, 1, 0, 0,
+                                   ctypes.byref(h)) == 0, \
+            lib.MXGetLastError()
+        vals = onp.arange(12, dtype=onp.float32)
+        buf = (ctypes.c_float * 12)(*vals.tolist())
+        assert lib.MXNDArraySyncCopyFromCPU(h, buf, 12) == 0, \
+            lib.MXGetLastError()
+        out = (ctypes.c_float * 12)()
+        assert lib.MXNDArraySyncCopyToCPU(h, out, 12) == 0, \
+            lib.MXGetLastError()
+        onp.testing.assert_allclose(onp.asarray(out), vals)
+        ndim = ctypes.c_uint()
+        pdata = ctypes.POINTER(ctypes.c_uint)()
+        assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                     ctypes.byref(pdata)) == 0
+        assert ndim.value == 2 and pdata[0] == 3 and pdata[1] == 4
+        # undersized output buffer must fail with a clear error
+        small = (ctypes.c_float * 2)()
+        assert lib.MXNDArraySyncCopyToCPU(h, small, 2) == -1
+        assert b"too small" in lib.MXGetLastError()
+        assert lib.MXNDArrayFree(h) == 0
