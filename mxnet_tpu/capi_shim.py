@@ -19,15 +19,19 @@ _handles: dict = {}
 _next_id = [1]
 
 
+def _unpack_shapes(keys, indptr, shape_data) -> dict:
+    """CSR-packed (keys, indptr, dims) -> {name: shape} (the packing every
+    reference shape-taking C call uses)."""
+    return {key: tuple(int(d) for d in shape_data[indptr[i]:indptr[i + 1]])
+            for i, key in enumerate(keys)}
+
+
 def create(symbol_file: str, param_file: str, keys, indptr, shape_data,
            dev_type: int = 1, dev_id: int = 0) -> int:
     """MXPredCreate: keys + CSR-packed input shapes -> handle id."""
     from .predictor import Predictor
 
-    shapes = {}
-    for i, key in enumerate(keys):
-        dims = tuple(int(d) for d in shape_data[indptr[i]:indptr[i + 1]])
-        shapes[key] = dims
+    shapes = _unpack_shapes(keys, indptr, shape_data)
     pred = Predictor(symbol_file, param_file or None, shapes)
     with _lock:
         h = _next_id[0]
@@ -140,10 +144,7 @@ def sym_infer_shape(h: int, keys, indptr, shape_data):
     the InferShape pass (reference semantics: parameter shapes are
     DEDUCED from the data shapes)."""
     sym = _handles[h]["sym"]
-    shapes = {}
-    for i, key in enumerate(keys):
-        shapes[key] = tuple(
-            int(d) for d in shape_data[indptr[i]:indptr[i + 1]])
+    shapes = _unpack_shapes(keys, indptr, shape_data)
     arg_names = sym.list_arguments()
     if any(nm not in shapes for nm in arg_names):
         from .symbol.symbol import infer_args

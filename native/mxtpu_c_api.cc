@@ -357,9 +357,11 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
     capture_py_error();
     return -1;
   }
-  if (static_cast<Py_ssize_t>(size * sizeof(float)) < len) {
+  if (static_cast<Py_ssize_t>(size * sizeof(float)) != len) {
     Py_DECREF(r);
-    set_error("MXNDArraySyncCopyToCPU: buffer too small");
+    set_error("MXNDArraySyncCopyToCPU: size mismatch (" +
+              std::to_string(size) + " elements requested, array has " +
+              std::to_string(len / sizeof(float)) + ")");
     return -1;
   }
   std::memcpy(data, buf, static_cast<size_t>(len));
@@ -575,7 +577,18 @@ int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
   for (long i = 0; i < n; ++i) {
     long h = call_long(PyObject_CallMethod(
         shim(), "executor_output", "ll", st->shim_handle, i));
-    if (h < 0) return -1;
+    if (h < 0) {
+      // release the handles already wrapped: the caller never sees them
+      for (NDArrayHandle created : out_store) {
+        auto *nd = static_cast<MXNDState *>(created);
+        PyObject *fr = PyObject_CallMethod(shim(), "free", "l",
+                                           nd->shim_handle);
+        Py_XDECREF(fr);
+        delete nd;
+      }
+      out_store.clear();
+      return -1;
+    }
     auto *nd = new MXNDState();
     nd->shim_handle = h;
     out_store.push_back(nd);

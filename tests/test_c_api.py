@@ -433,8 +433,11 @@ class TestCTrainingABI:
         assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
                                      ctypes.byref(pdata)) == 0
         assert ndim.value == 2 and pdata[0] == 3 and pdata[1] == 4
-        # undersized output buffer must fail with a clear error
+        # size mismatch must fail in BOTH directions with a clear error
         small = (ctypes.c_float * 2)()
         assert lib.MXNDArraySyncCopyToCPU(h, small, 2) == -1
-        assert b"too small" in lib.MXGetLastError()
+        assert b"size mismatch" in lib.MXGetLastError()
+        big = (ctypes.c_float * 100)()
+        assert lib.MXNDArraySyncCopyToCPU(h, big, 100) == -1
+        assert b"size mismatch" in lib.MXGetLastError()
         assert lib.MXNDArrayFree(h) == 0
