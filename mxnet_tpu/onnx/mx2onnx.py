@@ -75,6 +75,50 @@ def _conv_act(name, ins, out, attrs):
     table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
              "softrelu": "Softplus", "softsign": "Softsign"}
     act = attrs.get("act_type", "relu")
+    if act == "erf_gelu":
+        # exact-erf gelu: 0.5·x·(1 + erf(x/√2)) — ONNX has no Gelu until
+        # opset 20
+        x = ins[0]
+        return [
+            _node("Div", [x, f"{name}_sqrt2"], [f"{name}_xs"], f"{name}_d",
+                  _const={f"{name}_sqrt2":
+                          onp.asarray(2.0 ** 0.5, onp.float32)}),
+            _node("Erf", [f"{name}_xs"], [f"{name}_erf"], f"{name}_e"),
+            _node("Add", [f"{name}_erf", f"{name}_one"], [f"{name}_1p"],
+                  f"{name}_a",
+                  _const={f"{name}_one": onp.asarray(1.0, onp.float32)}),
+            _node("Mul", [x, f"{name}_1p"], [f"{name}_x1p"], f"{name}_m"),
+            _node("Mul", [f"{name}_x1p", f"{name}_half"], [out], name,
+                  _const={f"{name}_half":
+                          onp.asarray(0.5, onp.float32)}),
+        ]
+    if act == "gelu":
+        # the runtime's Activation('gelu') is jax.nn.gelu's TANH
+        # approximation (ops/nn.py) — export the matching decomposition:
+        # 0.5·x·(1 + tanh(√(2/π)·(x + 0.044715·x³)))
+        x = ins[0]
+        return [
+            _node("Mul", [x, x], [f"{name}_x2"], f"{name}_sq"),
+            _node("Mul", [f"{name}_x2", x], [f"{name}_x3"], f"{name}_cu"),
+            _node("Mul", [f"{name}_x3", f"{name}_c0"], [f"{name}_cx3"],
+                  f"{name}_m0",
+                  _const={f"{name}_c0":
+                          onp.asarray(0.044715, onp.float32)}),
+            _node("Add", [x, f"{name}_cx3"], [f"{name}_in"], f"{name}_a0"),
+            _node("Mul", [f"{name}_in", f"{name}_c1"], [f"{name}_sc"],
+                  f"{name}_m1",
+                  _const={f"{name}_c1":
+                          onp.asarray((2.0 / onp.pi) ** 0.5,
+                                      onp.float32)}),
+            _node("Tanh", [f"{name}_sc"], [f"{name}_t"], f"{name}_th"),
+            _node("Add", [f"{name}_t", f"{name}_one"], [f"{name}_1p"],
+                  f"{name}_a1",
+                  _const={f"{name}_one": onp.asarray(1.0, onp.float32)}),
+            _node("Mul", [x, f"{name}_1p"], [f"{name}_x1p"], f"{name}_m2"),
+            _node("Mul", [f"{name}_x1p", f"{name}_half"], [out], name,
+                  _const={f"{name}_half":
+                          onp.asarray(0.5, onp.float32)}),
+        ]
     if act not in table:
         raise MXNetError(f"onnx: unsupported activation {act}")
     return [_node(table[act], ins, [out], name)]
@@ -168,12 +212,96 @@ def _conv_embedding(name, ins, out, attrs):
 
 @register_converter("dot")
 def _conv_dot(name, ins, out, attrs):
-    return [_node("MatMul", ins, [out], name)]
+    a, b = ins
+    nodes = []
+    # MXNet dot carries transpose flags; ONNX MatMul does not (2-D only —
+    # batched dot exports via the batch_dot/matmul path)
+    if attrs.get("transpose_a"):
+        nodes.append(_node("Transpose", [a], [f"{name}_aT"], f"{name}_ta",
+                           perm=[1, 0]))
+        a = f"{name}_aT"
+    if attrs.get("transpose_b"):
+        nodes.append(_node("Transpose", [b], [f"{name}_bT"], f"{name}_tb",
+                           perm=[1, 0]))
+        b = f"{name}_bT"
+    nodes.append(_node("MatMul", [a, b], [out], name))
+    return nodes
 
 
 @register_converter("matmul")
 def _conv_matmul(name, ins, out, attrs):
     return [_node("MatMul", ins, [out], name)]
+
+
+@register_converter("slice_axis")
+def _conv_slice_axis(name, ins, out, attrs):
+    axis = int(attrs.get("axis", 0))
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end")
+    end = onp.iinfo(onp.int64).max if end is None else int(end)
+    return [_node("Slice",
+                  ins + [f"{name}_starts", f"{name}_ends", f"{name}_axes"],
+                  [out], name,
+                  _const={f"{name}_starts": onp.asarray([begin], onp.int64),
+                          f"{name}_ends": onp.asarray([end], onp.int64),
+                          f"{name}_axes": onp.asarray([axis], onp.int64)})]
+
+
+@register_converter("broadcast_to")
+def _conv_broadcast_to(name, ins, out, attrs):
+    shape = list(attrs.get("shape", ()))
+    if any(int(d) == 0 for d in shape):
+        # MXNet's '0 keeps the input dim' has no ONNX Expand equivalent —
+        # resolve against the inferred input shape
+        in_shp = (attrs.get("_in_shapes") or [None])[0]
+        if in_shp is None or len(in_shp) != len(shape):
+            raise MXNetError(
+                "onnx: broadcast_to with 0-dims ('keep input dim') needs "
+                "input_shapes at export time to resolve them")
+        shape = [int(i) if int(d) == 0 else int(d)
+                 for d, i in zip(shape, in_shp)]
+    return [_node("Expand", ins + [f"{name}_shape"], [out], name,
+                  _const={f"{name}_shape": onp.asarray(shape, onp.int64)})]
+
+
+@register_converter("flash_attention")
+def _conv_flash(name, ins, out, attrs):
+    """Decompose the fused attention op into the canonical ONNX pattern:
+    MatMul(q, kᵀ)·scale [+ bias] → Softmax → MatMul(·, v).  The fused
+    kernel is a TPU-side optimization; exported models get the portable
+    graph every runtime understands."""
+    if attrs.get("causal"):
+        raise MXNetError(
+            "onnx: causal flash_attention export not supported yet — "
+            "encoder (BERT-style) attention only")
+    scale = attrs.get("scale")
+    if scale is None:
+        shp = (attrs.get("_in_shapes") or [None])[0]
+        if not shp:
+            raise MXNetError(
+                "onnx: flash_attention export needs input_shapes (to "
+                "derive scale = 1/sqrt(head_dim)) or an explicit scale")
+        scale = 1.0 / (float(shp[-1]) ** 0.5)
+    q, k, v = ins[:3]
+    bias = ins[3] if len(ins) > 3 else None
+    nodes = [
+        _node("Transpose", [k], [f"{name}_kT"], f"{name}_kt",
+              perm=[0, 1, 3, 2]),
+        _node("MatMul", [q, f"{name}_kT"], [f"{name}_qk"], f"{name}_qkm"),
+        _node("Mul", [f"{name}_qk", f"{name}_scale"], [f"{name}_s"],
+              f"{name}_sc",
+              _const={f"{name}_scale": onp.asarray(scale, onp.float32)}),
+    ]
+    scores = f"{name}_s"
+    if bias is not None:
+        nodes.append(_node("Add", [scores, bias], [f"{name}_sb"],
+                           f"{name}_ab"))
+        scores = f"{name}_sb"
+    nodes += [
+        _node("Softmax", [scores], [f"{name}_p"], f"{name}_sm", axis=-1),
+        _node("MatMul", [f"{name}_p", v], [out], name),
+    ]
+    return nodes
 
 
 for _mx, _onnx in [("broadcast_add", "Add"), ("broadcast_sub", "Sub"),
@@ -224,6 +352,60 @@ register_converter("mean")(_reduce_converter("ReduceMean",
 # export driver
 # --------------------------------------------------------------------- #
 
+def _infer_node_shapes(sym, params, input_shapes, input_types):
+    """Per-node output shapes via one eval_shape over the graph (the
+    InferShape pass) — lets shape-dependent converters (flash_attention's
+    1/sqrt(head_dim)) emit static constants.  Returns {} when inputs are
+    underspecified; converters then degrade with explicit errors."""
+    import jax
+
+    from ..symbol.symbol import _topo, _node_outputs_from_invoke
+
+    try:
+        ishp = dict(input_shapes) if input_shapes else {}
+        ityp = dict(input_types) if isinstance(input_types, (list, dict)) \
+            else {}
+        feed = {}
+        for node in _topo(sym._heads):
+            if node.op is not None:
+                continue
+            if node.name in params:
+                v = params[node.name]
+                arr = v.asnumpy() if hasattr(v, "asnumpy") \
+                    else onp.asarray(v)
+                feed[node.name] = jax.ShapeDtypeStruct(
+                    arr.shape, onp.float32 if arr.dtype == onp.float64
+                    else arr.dtype)
+            else:
+                if isinstance(input_types, (list, dict)):
+                    dt = onp.dtype(str(ityp.get(node.name, "float32")))
+                else:
+                    dt = onp.dtype(str(input_types) if input_types
+                                   else "float32")
+                feed[node.name] = jax.ShapeDtypeStruct(
+                    tuple(ishp[node.name]), dt)
+        shapes = {}
+
+        def run(*arrays):
+            f = dict(zip(list(feed), arrays))
+            memo = {}
+            for node in _topo(sym._heads):
+                if node.op is None:
+                    memo[id(node)] = [f[node.name]]
+                else:
+                    ins = [memo[id(i)][idx] for i, idx in node.inputs]
+                    memo[id(node)] = _node_outputs_from_invoke(
+                        node, ins, as_ndarray=False)
+                shapes[id(node)] = [tuple(o.shape)
+                                    for o in memo[id(node)]]
+            return [memo[id(n)][i] for n, i in sym._heads]
+
+        jax.eval_shape(run, *feed.values())
+        return shapes
+    except Exception:
+        return {}
+
+
 def export_model(sym, params, input_shapes=None, input_types=None,
                  onnx_file_path="model.onnx", verbose=False, **kwargs):
     """Export (Symbol or exported json path, params dict or .params path)
@@ -241,6 +423,8 @@ def export_model(sym, params, input_shapes=None, input_types=None,
         arg, aux = load_params_file(params)
         params = {**arg, **aux}
 
+    node_shapes = _infer_node_shapes(sym, params, input_shapes,
+                                     input_types)
     nodes_out = []
     initializers = {}
     inputs = []
@@ -277,7 +461,12 @@ def export_model(sym, params, input_shapes=None, input_types=None,
         out_names = [node.name if n_out == 1 else f"{node.name}_out{i}"
                      for i in range(n_out)]
         entry_name[id(node)] = out_names
-        produced = conv(node.name, in_names, out_names[0], node.attrs)
+        attrs = node.attrs
+        if node_shapes:
+            attrs = {**attrs,
+                     "_in_shapes": [node_shapes[id(i)][idx]
+                                    for i, idx in node.inputs]}
+        produced = conv(node.name, in_names, out_names[0], attrs)
         for p in produced:
             consts = p["attrs"].pop("_const", None)
             if consts:

@@ -220,6 +220,42 @@ for _onnx, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
     register_importer(_onnx)(_simple(_mx))
 
 
+@register_importer("Expand")
+def _imp_expand(sym, ins, attrs, consts, name):
+    shape = consts.get(ins[1].name)
+    if shape is None:
+        raise MXNetError("onnx import: Expand needs a constant shape")
+    return sym.broadcast_to(
+        ins[0], shape=tuple(int(d) for d in onp.asarray(shape).reshape(-1)),
+        name=name)
+
+
+@register_importer("Slice")
+def _imp_slice(sym, ins, attrs, consts, name):
+    starts = consts.get(ins[1].name) if len(ins) > 1 else attrs.get("starts")
+    ends = consts.get(ins[2].name) if len(ins) > 2 else attrs.get("ends")
+    if starts is None or ends is None:
+        raise MXNetError(
+            "onnx import: Slice needs constant starts/ends (computed "
+            "slice bounds are not supported)")
+    axes = consts.get(ins[3].name) if len(ins) > 3 else \
+        attrs.get("axes", list(range(len(onp.asarray(starts).reshape(-1)))))
+    steps = consts.get(ins[4].name) if len(ins) > 4 else attrs.get("steps")
+    if steps is not None and any(int(s) != 1
+                                 for s in onp.asarray(steps).reshape(-1)):
+        raise MXNetError(
+            "onnx import: Slice with steps != 1 (strided/reversed) is "
+            "not supported")
+    out = ins[0]
+    int64_max = onp.iinfo(onp.int64).max
+    for ax, b, e in zip(onp.asarray(axes).reshape(-1),
+                        onp.asarray(starts).reshape(-1),
+                        onp.asarray(ends).reshape(-1)):
+        out = sym.slice_axis(out, axis=int(ax), begin=int(b),
+                             end=None if int(e) >= int64_max else int(e))
+    return out
+
+
 @register_importer("ReduceSum")
 def _imp_reduce_sum(sym, ins, attrs, consts, name):
     axes = consts.get(ins[1].name) if len(ins) > 1 else attrs.get("axes")
