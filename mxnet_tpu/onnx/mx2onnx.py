@@ -215,7 +215,15 @@ def _conv_dot(name, ins, out, attrs):
     a, b = ins
     nodes = []
     # MXNet dot carries transpose flags; ONNX MatMul does not (2-D only —
-    # batched dot exports via the batch_dot/matmul path)
+    # batched dot exports via the batch_dot/matmul path).  dot on rank>2
+    # is tensordot, which MatMul does NOT express — refuse loudly rather
+    # than exporting silently wrong batched semantics.
+    in_shapes = attrs.get("_in_shapes")
+    if in_shapes and any(len(s) != 2 for s in in_shapes[:2]):
+        raise MXNetError(
+            f"onnx: dot export supports 2-D operands only, got shapes "
+            f"{in_shapes[:2]} (rank>2 dot is tensordot — restructure "
+            "with batch_dot/matmul)")
     if attrs.get("transpose_a"):
         nodes.append(_node("Transpose", [a], [f"{name}_aT"], f"{name}_ta",
                            perm=[1, 0]))
@@ -401,9 +409,12 @@ def _infer_node_shapes(sym, params, input_shapes, input_types):
             return [memo[id(n)][i] for n, i in sym._heads]
 
         jax.eval_shape(run, *feed.values())
-        return shapes
-    except Exception:
-        return {}
+        return shapes, None
+    except Exception as e:
+        # degrade (shape-dependent converters raise with this cause
+        # attached) rather than failing every export for underspecified
+        # inputs or a host-path op in the graph
+        return {}, f"{type(e).__name__}: {e}"
 
 
 def export_model(sym, params, input_shapes=None, input_types=None,
@@ -423,8 +434,8 @@ def export_model(sym, params, input_shapes=None, input_types=None,
         arg, aux = load_params_file(params)
         params = {**arg, **aux}
 
-    node_shapes = _infer_node_shapes(sym, params, input_shapes,
-                                     input_types)
+    node_shapes, shape_err = _infer_node_shapes(sym, params, input_shapes,
+                                                input_types)
     nodes_out = []
     initializers = {}
     inputs = []
@@ -466,7 +477,15 @@ def export_model(sym, params, input_shapes=None, input_types=None,
             attrs = {**attrs,
                      "_in_shapes": [node_shapes[id(i)][idx]
                                     for i, idx in node.inputs]}
-        produced = conv(node.name, in_names, out_names[0], attrs)
+        try:
+            produced = conv(node.name, in_names, out_names[0], attrs)
+        except MXNetError as e:
+            if shape_err and ("input_shapes" in str(e)
+                              or "_in_shapes" in str(e)):
+                raise MXNetError(
+                    f"{e}  (note: the InferShape pass failed with: "
+                    f"{shape_err})") from e
+            raise
         for p in produced:
             consts = p["attrs"].pop("_const", None)
             if consts:

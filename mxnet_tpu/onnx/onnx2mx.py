@@ -225,7 +225,10 @@ def _imp_expand(sym, ins, attrs, consts, name):
     shape = consts.get(ins[1].name)
     if shape is None:
         raise MXNetError("onnx import: Expand needs a constant shape")
-    return sym.broadcast_to(
+    # _onnx_expand implements ONNX's numpy-broadcast semantics (a 1 in
+    # the shape keeps the input dim) — plain broadcast_to would reject
+    # valid external models
+    return sym._onnx_expand(
         ins[0], shape=tuple(int(d) for d in onp.asarray(shape).reshape(-1)),
         name=name)
 
@@ -240,7 +243,18 @@ def _imp_slice(sym, ins, attrs, consts, name):
             "slice bounds are not supported)")
     axes = consts.get(ins[3].name) if len(ins) > 3 else \
         attrs.get("axes", list(range(len(onp.asarray(starts).reshape(-1)))))
-    steps = consts.get(ins[4].name) if len(ins) > 4 else attrs.get("steps")
+    if len(ins) > 3 and axes is None:
+        raise MXNetError(
+            "onnx import: Slice needs constant axes (computed axes are "
+            "not supported)")
+    if len(ins) > 4:
+        steps = consts.get(ins[4].name)
+        if steps is None:
+            raise MXNetError(
+                "onnx import: Slice needs constant steps (computed "
+                "steps are not supported)")
+    else:
+        steps = attrs.get("steps")
     if steps is not None and any(int(s) != 1
                                  for s in onp.asarray(steps).reshape(-1)):
         raise MXNetError(

@@ -424,6 +424,33 @@ def reshape(data, *, shape):
     return jnp.reshape(data, _mx_reshape(data.shape, shape))
 
 
+@op("reshape_like")
+def reshape_like(lhs, rhs):
+    """Reference ``reshape_like``: reshape lhs to rhs's shape (sizes must
+    match)."""
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@op("unique", differentiable=False)
+def unique(data):
+    """Sorted distinct values.  Dynamic output shape — host-path op like
+    ``boolean_mask`` (not jittable; inside jit use fixed-size masks)."""
+    return jnp.unique(data)
+
+
+@op("_onnx_expand")
+def _onnx_expand(data, *, shape):
+    """ONNX ``Expand`` semantics (the onnx2mx importer's target): the
+    output shape is the NUMPY BROADCAST of input shape and ``shape`` —
+    a 1 in ``shape`` keeps the input dim, unlike ``broadcast_to``."""
+    shape = tuple(int(s) for s in shape)
+    nd_, ns = len(data.shape), len(shape)
+    full = (1,) * _max(ns - nd_, 0) + tuple(data.shape)
+    tgt = (1,) * _max(nd_ - ns, 0) + shape
+    out = tuple(_max(a, b) for a, b in zip(full, tgt))
+    return jnp.broadcast_to(data.reshape(full), out)
+
+
 def _mx_reshape(ishape, shape):
     """Support MXNet special codes: 0 (keep dim), -1 (infer), -2 (copy rest),
     -3 (merge two dims), -4 (split dim)."""

@@ -581,6 +581,9 @@ def MakeLoss(data, *, grad_scale=1.0, valid_thresh=0.0,
     return data
 
 
+alias("make_loss", "MakeLoss")
+
+
 # ----------------------------------------------------------------------- #
 # attention (reference: contrib interleaved matmul selfatt ops, BERT path)
 # ----------------------------------------------------------------------- #

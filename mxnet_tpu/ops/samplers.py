@@ -143,6 +143,38 @@ def sample_poisson(lam, *, shape=(), dtype="float32"):
                               s).astype(jnp.dtype(dtype))
 
 
+def _nb_mixture(kk, pp, s, dtype):
+    """Shared gamma–Poisson NB mixture over broadcast (k, p) arrays."""
+    g = jax.random.gamma(_key(), kk, s) * \
+        ((1.0 - pp) / jnp.maximum(pp, 1e-12))
+    return jax.random.poisson(_key(), g, s).astype(jnp.dtype(dtype))
+
+
+@op("sample_negative_binomial", differentiable=False)
+def sample_negative_binomial(k, p, *, shape=(), dtype="float32"):
+    """Per-element NB(k, p) draws (reference ``sample_negative_binomial``):
+    Poisson–gamma mixture, matching ``_random_negative_binomial``."""
+    s = tuple(k.shape) + _shape(shape)
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    kk = jnp.broadcast_to(k[ex], s).astype(jnp.float32)
+    pp = jnp.broadcast_to(p[ex], s).astype(jnp.float32)
+    return _nb_mixture(kk, pp, s, dtype)
+
+
+@op("sample_generalized_negative_binomial", differentiable=False)
+def sample_generalized_negative_binomial(mu, alpha, *, shape=(),
+                                         dtype="float32"):
+    """Per-element GNB(mu, alpha) draws: k = 1/alpha, p = k/(k+mu) —
+    matching ``_random_generalized_negative_binomial``."""
+    s = tuple(mu.shape) + _shape(shape)
+    ex = (Ellipsis,) + (None,) * len(_shape(shape))
+    mm = jnp.broadcast_to(mu[ex], s).astype(jnp.float32)
+    aa = jnp.broadcast_to(alpha[ex], s).astype(jnp.float32)
+    kk = 1.0 / jnp.maximum(aa, 1e-12)
+    pp = kk / (kk + mm)
+    return _nb_mixture(kk, pp, s, dtype)
+
+
 @op("sample_multinomial", differentiable=False)
 def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32"):
     """Rows of ``data`` are probability vectors; draw ``shape`` samples
@@ -387,3 +419,5 @@ alias("random_negative_binomial", "_random_negative_binomial")
 alias("random_generalized_negative_binomial",
       "_random_generalized_negative_binomial")
 alias("random_randint", "_random_randint")
+alias("multinomial", "sample_multinomial")
+alias("interp", "interp_op")

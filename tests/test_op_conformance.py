@@ -282,6 +282,7 @@ SPECIALS = {
 
     # ---- shape manipulation ------------------------------------------ #
     "reshape": spec(F(4, 5), shape=(5, 4)),
+    "_onnx_expand": spec(F(4, 1), shape=(1, 5)),
     "broadcast_to": spec(F(1, 5), shape=(4, 5)),
     "broadcast_axis": spec(F(1, 5), axis=0, size=4),
     "slice": spec(F(4, 5), begin=(0, 1), end=(3, 4)),
@@ -351,6 +352,10 @@ SPECIALS = {
                                   num_rows=4),
     "_sparse_rowsparse_dot_t": spec(F(2, 5), I(2, hi=4), F(2, 3),
                                     num_cols=4),
+
+    # ---- distribution samplers with domain constraints ---------------- #
+    "sample_negative_binomial": spec(F(3), U(3)),       # k > 0, p in (0,1)
+    "sample_generalized_negative_binomial": spec(F(3), F(3)),
 
     # ---- variadic / multi-tensor ------------------------------------- #
     "concat": spec(F(4, 5), F(4, 5)),

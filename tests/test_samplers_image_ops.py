@@ -209,3 +209,54 @@ class TestContribStragglers:
         expect = real.mean()
         got = (float(q.asnumpy().reshape(-1)[0]) + 128) * scale - 1
         assert abs(got - expect) < scale  # within one quantization step
+
+
+class TestR4OpAdditions:
+    """Ops added from the r4 name-gap probe: reshape_like, unique,
+    make_loss, sample NB variants, and the multinomial/interp aliases."""
+
+    def test_reshape_like(self):
+        a = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+        b = nd.array(onp.zeros((2, 6), "float32"))
+        out = nd.reshape_like(a, b)
+        assert out.shape == (2, 6)
+        onp.testing.assert_allclose(out.asnumpy().reshape(-1),
+                                    onp.arange(12))
+
+    def test_unique(self):
+        out = nd.unique(nd.array(onp.asarray([3, 1, 2, 3, 1], "float32")))
+        onp.testing.assert_allclose(out.asnumpy(), [1, 2, 3])
+
+    def test_make_loss_identity_with_unit_grad(self):
+        x = nd.array(onp.asarray([1.5, -2.0], "float32"))
+        x.attach_grad()
+        with mx.autograd.record():
+            out = nd.make_loss(x)
+        out.backward()
+        onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+        onp.testing.assert_allclose(x.grad.asnumpy(), [1.0, 1.0])
+
+    def test_sample_negative_binomial_family(self):
+        mx.random.seed(0)
+        k = nd.array(onp.asarray([5.0, 20.0], "float32"))
+        p = nd.array(onp.asarray([0.5, 0.5], "float32"))
+        out = nd.sample_negative_binomial(k, p, shape=(500,))
+        assert out.shape == (2, 500)
+        m = out.asnumpy().mean(axis=1)
+        # E[NB(k, p)] = k (1-p)/p
+        onp.testing.assert_allclose(m, [5.0, 20.0], rtol=0.25)
+        mu = nd.array(onp.asarray([4.0], "float32"))
+        alpha = nd.array(onp.asarray([0.25], "float32"))
+        out2 = nd.sample_generalized_negative_binomial(mu, alpha,
+                                                       shape=(500,))
+        onp.testing.assert_allclose(out2.asnumpy().mean(), 4.0, rtol=0.25)
+
+    def test_multinomial_and_interp_aliases(self):
+        mx.random.seed(0)
+        probs = nd.array(onp.asarray([[0.0, 1.0, 0.0]], "float32"))
+        draws = nd.multinomial(probs, shape=(8,))
+        assert (draws.asnumpy() == 1).all()
+        y = nd.interp(nd.array(onp.asarray([0.5], "float32")),
+                      nd.array(onp.asarray([0.0, 1.0], "float32")),
+                      nd.array(onp.asarray([0.0, 2.0], "float32")))
+        onp.testing.assert_allclose(y.asnumpy(), [1.0])
