@@ -219,7 +219,14 @@ def _conv_dot(name, ins, out, attrs):
     # is tensordot, which MatMul does NOT express — refuse loudly rather
     # than exporting silently wrong batched semantics.
     in_shapes = attrs.get("_in_shapes")
-    if in_shapes and any(len(s) != 2 for s in in_shapes[:2]):
+    if not in_shapes:
+        # without shape info a rank>2 dot would export as MatMul with
+        # silently-wrong batched semantics — refuse instead of guessing
+        raise MXNetError(
+            "onnx: dot export needs input_shapes at export time to prove "
+            "the operands are 2-D (rank>2 dot is tensordot, which ONNX "
+            "MatMul cannot express)")
+    if any(len(s) != 2 for s in in_shapes[:2]):
         raise MXNetError(
             f"onnx: dot export supports 2-D operands only, got shapes "
             f"{in_shapes[:2]} (rank>2 dot is tensordot — restructure "

@@ -241,19 +241,23 @@ def _imp_slice(sym, ins, attrs, consts, name):
         raise MXNetError(
             "onnx import: Slice needs constant starts/ends (computed "
             "slice bounds are not supported)")
-    axes = consts.get(ins[3].name) if len(ins) > 3 else \
-        attrs.get("axes", list(range(len(onp.asarray(starts).reshape(-1)))))
-    if len(ins) > 3 and axes is None:
-        raise MXNetError(
-            "onnx import: Slice needs constant axes (computed axes are "
-            "not supported)")
-    if len(ins) > 4:
-        steps = consts.get(ins[4].name)
-        if steps is None:
+    def _opt_input(idx, what):
+        """Optional trailing input: '' means spec-legal omission."""
+        if len(ins) <= idx or not getattr(ins[idx], "name", ""):
+            return None, False
+        val = consts.get(ins[idx].name)
+        if val is None:
             raise MXNetError(
-                "onnx import: Slice needs constant steps (computed "
-                "steps are not supported)")
-    else:
+                f"onnx import: Slice needs constant {what} (computed "
+                f"{what} are not supported)")
+        return val, True
+
+    axes, have_axes = _opt_input(3, "axes")
+    if not have_axes:
+        axes = attrs.get("axes",
+                         list(range(len(onp.asarray(starts).reshape(-1)))))
+    steps, have_steps = _opt_input(4, "steps")
+    if not have_steps:
         steps = attrs.get("steps")
     if steps is not None and any(int(s) != 1
                                  for s in onp.asarray(steps).reshape(-1)):

@@ -443,11 +443,12 @@ def _onnx_expand(data, *, shape):
     """ONNX ``Expand`` semantics (the onnx2mx importer's target): the
     output shape is the NUMPY BROADCAST of input shape and ``shape`` —
     a 1 in ``shape`` keeps the input dim, unlike ``broadcast_to``."""
+    import numpy as onp
     shape = tuple(int(s) for s in shape)
-    nd_, ns = len(data.shape), len(shape)
-    full = (1,) * _max(ns - nd_, 0) + tuple(data.shape)
-    tgt = (1,) * _max(nd_ - ns, 0) + shape
-    out = tuple(_max(a, b) for a, b in zip(full, tgt))
+    # numpy broadcast rules — raises on incompatible dims, exactly as a
+    # conforming ONNX runtime must
+    out = onp.broadcast_shapes(tuple(data.shape), shape)
+    full = (1,) * (len(out) - data.ndim) + tuple(data.shape)
     return jnp.broadcast_to(data.reshape(full), out)
 
 

@@ -14,6 +14,7 @@ preferred on TPU.
 from __future__ import annotations
 
 import builtins
+import functools
 from typing import Optional
 
 import jax
@@ -289,13 +290,85 @@ def Pooling(data, *, kernel=(), pool_type="max", stride=(), pad=(),
 # mutation inside traced code)
 # ----------------------------------------------------------------------- #
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _bn_stats_core(data, gamma, beta, moving_mean, moving_var, eps,
+                   momentum, fix_gamma, use_global_stats, axis, training):
+    return _bn_stats_fwd_math(data, gamma, beta, moving_mean, moving_var,
+                              eps, momentum, fix_gamma, use_global_stats,
+                              axis, training)
+
+
+def _bn_stats_fwd(data, gamma, beta, moving_mean, moving_var, eps,
+                  momentum, fix_gamma, use_global_stats, axis, training):
+    outs = _bn_stats_fwd_math(data, gamma, beta, moving_mean, moving_var,
+                              eps, momentum, fix_gamma, use_global_stats,
+                              axis, training)
+    # residuals: x, gamma, and the (stop-gradient) batch stats
+    return outs, (data, gamma, outs[3], outs[4])
+
+
+def _bn_stats_bwd(eps, momentum, fix_gamma, use_global_stats, axis,
+                  training, res, cts):
+    """Hand-written BN backward (VERDICT r3 item 1 escalation): the
+    autodiff of the shifted-stats forward materializes extra reduce +
+    elementwise HBM passes; the closed form needs exactly TWO sibling
+    reductions (Σdy, Σdy·x̂ — one fused pass over dy, x) plus one
+    elementwise pass for dx:
+
+        dβ = Σ dy;  dγ = Σ dy·x̂
+        dx = (γ·inv)·(dy − (dβ + x̂·dγ)/n)      (batch stats)
+        dx = (γ·inv)·dy                          (global stats)
+    """
+    data, gamma, mean, var = res
+    g_out = cts[0]  # the other 4 outputs are stop_gradient'ed
+    nd_ = data.ndim
+    ax = axis % nd_
+    red = tuple(i for i in range(nd_) if i != ax)
+    bshape = [1] * nd_
+    bshape[ax] = data.shape[ax]
+    n = 1
+    for i in red:
+        n *= data.shape[i]
+    x32 = data.astype(jnp.float32)
+    g32 = g_out.astype(jnp.float32)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).reshape(bshape)
+    xhat = (x32 - mean.astype(jnp.float32).reshape(bshape)) * inv
+    dbeta = jnp.sum(g32, axis=red)
+    dgamma = jnp.sum(g32 * xhat, axis=red)
+    geff = 1.0 if fix_gamma else gamma.astype(jnp.float32).reshape(bshape)
+    if training and not use_global_stats:
+        dx = (geff * inv) * (
+            g32 - (dbeta.reshape(bshape)
+                   + xhat * dgamma.reshape(bshape)) / n)
+    else:
+        dx = (geff * inv) * g32
+    zero_g = jnp.zeros_like(gamma)
+    return (dx.astype(data.dtype),
+            zero_g if fix_gamma else dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype),
+            jnp.zeros_like(gamma), jnp.zeros_like(gamma))
+
+
+_bn_stats_core.defvjp(_bn_stats_fwd, _bn_stats_bwd)
+
+
 @op("_BatchNormStats")
 def _BatchNormStats(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
                     momentum=0.9, fix_gamma=True, use_global_stats=False,
                     axis=1, training=True):
     """Internal: returns ``(out, new_moving_mean, new_moving_var, batch_mean,
     batch_var)``.  The Gluon layer commits the new moving stats functionally
-    (no aux-state mutation inside traced code, SURVEY.md §7)."""
+    (no aux-state mutation inside traced code, SURVEY.md §7).  Backward is
+    the hand-written two-pass closed form (``_bn_stats_bwd``), not
+    autodiff of the shifted-stats forward."""
+    return _bn_stats_core(data, gamma, beta, moving_mean, moving_var,
+                          float(eps), float(momentum), bool(fix_gamma),
+                          bool(use_global_stats), int(axis), bool(training))
+
+
+def _bn_stats_fwd_math(data, gamma, beta, moving_mean, moving_var, eps,
+                       momentum, fix_gamma, use_global_stats, axis,
+                       training):
     red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]

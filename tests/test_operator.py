@@ -288,3 +288,37 @@ class TestCreation:
     def test_float64_input_becomes_f32(self):
         x = nd.array(onp.zeros((2,), onp.float64))
         assert x.dtype == onp.float32
+
+
+class TestBNHandWrittenBackward:
+    """r4: _BatchNormStats backward is the hand-written two-pass closed
+    form — it must match autodiff of the forward math exactly (both
+    training and global-stats modes, fix_gamma on/off)."""
+
+    @pytest.mark.parametrize("training,fix_gamma", [
+        (True, True), (True, False), (False, True), (False, False)])
+    def test_grad_matches_autodiff(self, training, fix_gamma):
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.nn import _bn_stats_core, _bn_stats_fwd_math
+        rng = onp.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 3, 5, 5), jnp.float32)
+        gamma = jnp.asarray(rng.rand(3) + 0.5, jnp.float32)
+        beta = jnp.asarray(rng.randn(3), jnp.float32)
+        mm = jnp.asarray(rng.randn(3) * 0.1, jnp.float32)
+        mv = jnp.asarray(rng.rand(3) + 0.5, jnp.float32)
+        args = (1e-5, 0.9, fix_gamma, False, 1, training)
+
+        def loss_custom(x, g, b):
+            out = _bn_stats_core(x, g, b, mm, mv, *args)[0]
+            return jnp.sum(out * out)
+
+        def loss_auto(x, g, b):
+            out = _bn_stats_fwd_math(x, g, b, mm, mv, *args)[0]
+            return jnp.sum(out * out)
+
+        gc = jax.grad(loss_custom, argnums=(0, 1, 2))(x, gamma, beta)
+        ga = jax.grad(loss_auto, argnums=(0, 1, 2))(x, gamma, beta)
+        for c, a, nm in zip(gc, ga, ("dx", "dgamma", "dbeta")):
+            onp.testing.assert_allclose(onp.asarray(c), onp.asarray(a),
+                                        rtol=2e-4, atol=2e-5, err_msg=nm)
