@@ -169,14 +169,45 @@ def current_context() -> Context:
     return Context.default_ctx()
 
 
+# HBM per chip by device-kind substring — the fallback gauge total when
+# the backend exposes no allocator stats (e.g. tunneled devices)
+_HBM_BYTES = (("v5 lite", 16 << 30), ("v5e", 16 << 30),
+              ("v5p", 95 << 30), ("v4", 32 << 30), ("v6", 32 << 30),
+              ("v3", 16 << 30), ("v2", 8 << 30))
+
+
 def gpu_memory_info(device_id: int = 0):
-    """(free, total) bytes for the accelerator (reference:
-    ``mx.context.gpu_memory_info``)."""
+    """(free, total) bytes of device HBM (reference:
+    ``mx.context.gpu_memory_info``).
+
+    Primary source: the backend allocator (``device.memory_stats``).
+    Fallback (backends that return no stats, e.g. tunneled devices):
+    live-buffer accounting over ``jax.live_arrays`` against the known
+    per-chip HBM size — an upper bound on free memory, still a real
+    gauge instead of the old silent ``(0, 0)``."""
     dev = Context("tpu", device_id).jax_device()
+    stats = None
     try:
         stats = dev.memory_stats()
+    except Exception:
+        pass
+    if stats:
         total = stats.get("bytes_limit", 0)
         used = stats.get("bytes_in_use", 0)
         return (total - used, total)
+    used = 0
+    try:
+        for a in jax.live_arrays():
+            try:
+                # per-device shard bytes — charging the full global
+                # nbytes would overcount sharded arrays mesh-wide
+                for s in a.addressable_shards:
+                    if s.device == dev and s.data is not None:
+                        used += s.data.nbytes
+            except Exception:
+                continue
     except Exception:
-        return (0, 0)
+        pass
+    kind = getattr(dev, "device_kind", "").lower()
+    total = next((b for k, b in _HBM_BYTES if k in kind), 0)
+    return (max(total - used, 0), total)

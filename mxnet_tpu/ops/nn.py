@@ -303,8 +303,11 @@ def _bn_stats_fwd(data, gamma, beta, moving_mean, moving_var, eps,
     outs = _bn_stats_fwd_math(data, gamma, beta, moving_mean, moving_var,
                               eps, momentum, fix_gamma, use_global_stats,
                               axis, training)
-    # residuals: x, gamma, and the (stop-gradient) batch stats
-    return outs, (data, gamma, outs[3], outs[4])
+    # residuals: x, the (stop-gradient) batch stats, and the small param
+    # vectors (their dtypes shape the cotangents — beta/moving stats may
+    # differ from gamma's dtype under AMP)
+    return outs, (data, gamma, beta, moving_mean, moving_var,
+                  outs[3], outs[4])
 
 
 def _bn_stats_bwd(eps, momentum, fix_gamma, use_global_stats, axis,
@@ -319,7 +322,7 @@ def _bn_stats_bwd(eps, momentum, fix_gamma, use_global_stats, axis,
         dx = (γ·inv)·(dy − (dβ + x̂·dγ)/n)      (batch stats)
         dx = (γ·inv)·dy                          (global stats)
     """
-    data, gamma, mean, var = res
+    data, gamma, beta, moving_mean, moving_var, mean, var = res
     g_out = cts[0]  # the other 4 outputs are stop_gradient'ed
     nd_ = data.ndim
     ax = axis % nd_
@@ -342,11 +345,11 @@ def _bn_stats_bwd(eps, momentum, fix_gamma, use_global_stats, axis,
                    + xhat * dgamma.reshape(bshape)) / n)
     else:
         dx = (geff * inv) * g32
-    zero_g = jnp.zeros_like(gamma)
     return (dx.astype(data.dtype),
-            zero_g if fix_gamma else dgamma.astype(gamma.dtype),
-            dbeta.astype(gamma.dtype),
-            jnp.zeros_like(gamma), jnp.zeros_like(gamma))
+            jnp.zeros_like(gamma) if fix_gamma
+            else dgamma.astype(gamma.dtype),
+            dbeta.astype(beta.dtype),
+            jnp.zeros_like(moving_mean), jnp.zeros_like(moving_var))
 
 
 _bn_stats_core.defvjp(_bn_stats_fwd, _bn_stats_bwd)

@@ -77,3 +77,14 @@ class TestErrorPaths:
         mx.random.seed(42)
         b = mx.nd.random_normal(shape=(4,)).asnumpy()
         onp.testing.assert_array_equal(a, b)
+
+
+def test_gpu_memory_info_gauge():
+    """HBM gauge (reference mx.context.gpu_memory_info): returns a
+    (free, total) pair; free <= total; on accelerator-less backends the
+    total degrades to 0 rather than raising (no HBM to gauge)."""
+    import mxnet_tpu as mx
+    free, total = mx.context.gpu_memory_info(0)
+    assert isinstance(free, int) and isinstance(total, int)
+    assert free >= 0 and total >= 0
+    assert free <= total or total == 0
