@@ -189,3 +189,39 @@ def executor_num_outputs(h: int) -> int:
 def executor_output(h: int, index: int) -> int:
     """Wrap output ``index`` as a NEW ndarray handle (caller frees)."""
     return _put({"nd": _handles[h]["outputs"][index]})
+
+
+def nd_get_dtype(h: int) -> int:
+    """MXNDArrayGetDType: the reference's dtype enum (shared table with
+    the .params serializer)."""
+    from .base import dtype_np_to_mx
+    return int(dtype_np_to_mx(_handles[h]["nd"].dtype))
+
+
+def nd_save(fname: str, handles, keys) -> None:
+    """MXNDArraySave: write a .params file (bit-format shared with
+    mx.nd.save) from C-held ndarray handles."""
+    from .ndarray import serialization as ser
+    arrays = [_handles[h]["nd"] for h in handles]
+    if keys:
+        ser.save(fname, dict(zip(keys, arrays)))
+    else:
+        ser.save(fname, list(arrays))
+
+
+def nd_load(fname: str):
+    """MXNDArrayLoad: returns (handles tuple, names tuple)."""
+    from .ndarray import serialization as ser
+    loaded = ser.load(fname)
+    if isinstance(loaded, dict):
+        names = tuple(loaded.keys())
+        hs = tuple(_put({"nd": v}) for v in loaded.values())
+    else:
+        names = ()
+        hs = tuple(_put({"nd": v}) for v in loaded)
+    return hs, names
+
+
+def sym_save_to_file(h: int, fname: str) -> None:
+    """MXSymbolSaveToFile: the exported-json format."""
+    _handles[h]["sym"].save(fname)

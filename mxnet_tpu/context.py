@@ -191,10 +191,10 @@ def gpu_memory_info(device_id: int = 0):
         stats = dev.memory_stats()
     except Exception:
         pass
-    if stats:
-        total = stats.get("bytes_limit", 0)
+    if stats and stats.get("bytes_limit"):
+        total = stats["bytes_limit"]
         used = stats.get("bytes_in_use", 0)
-        return (total - used, total)
+        return (max(total - used, 0), total)
     used = 0
     try:
         for a in jax.live_arrays():

@@ -390,6 +390,86 @@ int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
   return 0;
 }
 
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  Gil gil;
+  auto *st = static_cast<MXNDState *>(handle);
+  long v = call_long(PyObject_CallMethod(shim(), "nd_get_dtype", "l",
+                                         st->shim_handle));
+  if (v < 0) return -1;
+  *out_dtype = static_cast<int>(v);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys) {
+  Gil gil;
+  PyObject *hs = PyList_New(num_args);
+  PyObject *ks = keys ? PyList_New(num_args) : Py_None;
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(hs, i, PyLong_FromLong(
+        static_cast<MXNDState *>(args[i])->shim_handle));
+    if (keys)
+      PyList_SetItem(ks, i, PyUnicode_FromString(keys[i]));
+  }
+  PyObject *r = PyObject_CallMethod(shim(), "nd_save", "sOO", fname, hs,
+                                    ks);
+  Py_DECREF(hs);
+  if (keys) Py_DECREF(ks);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(shim(), "nd_load", "s", fname);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject *hs = PyTuple_GetItem(r, 0);
+  PyObject *ns = PyTuple_GetItem(r, 1);
+  static thread_local std::vector<NDArrayHandle> arr_store;
+  static thread_local std::vector<std::string> name_store;
+  static thread_local std::vector<const char *> name_ptrs;
+  arr_store.clear();
+  name_store.clear();
+  name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(hs); ++i) {
+    auto *nd = new MXNDState();
+    nd->shim_handle = PyLong_AsLong(PyTuple_GetItem(hs, i));
+    arr_store.push_back(nd);
+  }
+  for (Py_ssize_t i = 0; i < PyTuple_Size(ns); ++i)
+    name_store.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(ns, i)));
+  for (auto &s : name_store) name_ptrs.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(arr_store.size());
+  *out_arr = arr_store.data();
+  *out_name_size = static_cast<mx_uint>(name_store.size());
+  *out_names = name_ptrs.data();
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle handle, const char *fname) {
+  Gil gil;
+  auto *st = static_cast<MXSymState *>(handle);
+  PyObject *r = PyObject_CallMethod(shim(), "sym_save_to_file", "ls",
+                                    st->shim_handle, fname);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
   if (!ensure_python()) return -1;
   Gil gil;

@@ -441,3 +441,59 @@ class TestCTrainingABI:
         assert lib.MXNDArraySyncCopyToCPU(h, big, 100) == -1
         assert b"size mismatch" in lib.MXGetLastError()
         assert lib.MXNDArrayFree(h) == 0
+
+    def test_ndarray_save_load_dtype_via_ctypes(self, tmp_path):
+        """MXNDArraySave/Load round-trip the shared .params bit-format
+        from C-held handles; MXNDArrayGetDType returns the reference
+        dtype enum; MXSymbolSaveToFile writes loadable json."""
+        _build_lib()
+        lib = ctypes.CDLL(LIB)
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        h = ctypes.c_void_p()
+        shape = (ctypes.c_uint * 2)(2, 3)
+        assert lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)) == 0
+        vals = onp.arange(6, dtype=onp.float32) * 0.5
+        buf = (ctypes.c_float * 6)(*vals.tolist())
+        assert lib.MXNDArraySyncCopyFromCPU(h, buf, 6) == 0
+        dt = ctypes.c_int()
+        assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+        assert dt.value == 0  # kFloat32
+        fn = str(tmp_path / "w.params").encode()
+        keys = (ctypes.c_char_p * 1)(b"weight")
+        assert lib.MXNDArraySave(fn, 1, ctypes.byref(h), keys) == 0, \
+            lib.MXGetLastError()
+        # python side reads the same file (shared bit-format)
+        loaded = mx.nd.load(fn.decode())
+        onp.testing.assert_allclose(loaded["weight"].asnumpy(),
+                                    vals.reshape(2, 3))
+        # C side loads it back
+        n = ctypes.c_uint()
+        arrs = ctypes.POINTER(ctypes.c_void_p)()
+        nn = ctypes.c_uint()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        assert lib.MXNDArrayLoad(fn, ctypes.byref(n), ctypes.byref(arrs),
+                                 ctypes.byref(nn),
+                                 ctypes.byref(names)) == 0, \
+            lib.MXGetLastError()
+        assert n.value == 1 and nn.value == 1
+        assert names[0] == b"weight"
+        out = (ctypes.c_float * 6)()
+        assert lib.MXNDArraySyncCopyToCPU(
+            ctypes.c_void_p(arrs[0]), out, 6) == 0
+        onp.testing.assert_allclose(onp.asarray(out), vals)
+        lib.MXNDArrayFree(ctypes.c_void_p(arrs[0]))
+        lib.MXNDArrayFree(h)
+
+    def test_symbol_save_roundtrip_via_ctypes(self, tmp_path):
+        _build_lib()
+        symf = self._export_train_symbol(tmp_path)
+        lib = ctypes.CDLL(LIB)
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        sh = ctypes.c_void_p()
+        assert lib.MXSymbolCreateFromFile(symf.encode(),
+                                          ctypes.byref(sh)) == 0
+        out = str(tmp_path / "resaved.json").encode()
+        assert lib.MXSymbolSaveToFile(sh, out) == 0, lib.MXGetLastError()
+        sym2 = mx.sym.load(out.decode())
+        assert len(sym2.list_arguments()) == 6
+        lib.MXSymbolFree(sh)
