@@ -82,10 +82,13 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     key at position t is ``fold_in(key, t)`` in both modes).
 
     ``weights``: ``"int8"`` streams the decode-step matmul weights as
-    per-channel-quantized int8 (half the HBM traffic of bf16 — batch-1
-    decode is weight-streaming-bound), dequantizing inside the dot with
-    f32 accumulation.  GPT-family only; an approximate path — greedy
-    tokens can differ from the exact native path (~0.4% weight error).
+    per-channel-quantized int8 (half the HBM bytes of bf16),
+    dequantizing inside the dot with f32 accumulation.  Both families
+    (GPT fused-QKV and Llama split-projection/SwiGLU).  An approximate
+    path — greedy tokens can differ from the exact native path (~0.4%
+    weight error); measured r4: the decode step is sequencer-bound at
+    GPT-2-small size, so int8's byte savings pay off only on larger
+    models (BASELINE.md decode section).
     """
     cfg = model._cfg
     H = cfg.num_heads
@@ -103,9 +106,6 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
         raise ValueError(f"weights must be 'native' or 'int8', "
                          f"got {weights!r}")
     use_int8 = weights == "int8"
-    if use_int8 and is_llama:
-        raise ValueError("weights='int8' supports the GPT family only "
-                         "(fused-QKV cells); use weights='native'")
     prompt = onp.asarray(
         prompt_tokens.asnumpy() if hasattr(prompt_tokens, "asnumpy")
         else prompt_tokens, dtype=onp.int32)
@@ -140,8 +140,10 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     q8v = None
     fc1_act = None
     if use_int8:
-        fc1_act = getattr(model.blocks[0].ffn.fc1.act, "_act_type", None) \
-            if model.blocks[0].ffn.fc1.act is not None else None
+        if not is_llama:
+            fc1_act = getattr(model.blocks[0].ffn.fc1.act, "_act_type",
+                              None) \
+                if model.blocks[0].ffn.fc1.act is not None else None
         # cache the codes keyed on the SOURCE ARRAYS THEMSELVES (weights
         # AND biases), compared by `is` against pinned strong refs — a
         # train step rebinds the arrays and triggers requantization,
@@ -151,10 +153,19 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
         # silently serve stale codes after an update.
         head_w = (head.weight if head is not None
                   else model.wte.weight).data()._data
-        lyrs = [(blk.attn.qkv, blk.attn.proj, blk.ffn.fc1, blk.ffn.fc2)
-                for blk in model.blocks]
-        srcs = [l.weight.data()._data for grp in lyrs for l in grp]
-        srcs += [l.bias.data()._data for grp in lyrs for l in grp
+        if is_llama:
+            lyr_tabs = [{"q": blk.attn.q_proj, "k": blk.attn.k_proj,
+                         "v": blk.attn.v_proj, "o": blk.attn.o_proj,
+                         "gate": blk.mlp.gate, "up": blk.mlp.up,
+                         "down": blk.mlp.down} for blk in model.blocks]
+        else:
+            lyr_tabs = [{"qkv": blk.attn.qkv, "proj": blk.attn.proj,
+                         "fc1": blk.ffn.fc1, "fc2": blk.ffn.fc2}
+                        for blk in model.blocks]
+        srcs = [l.weight.data()._data for t in lyr_tabs
+                for l in t.values()]
+        srcs += [l.bias.data()._data for t in lyr_tabs
+                 for l in t.values()
                  if getattr(l, "bias", None) is not None]
         srcs.append(head_w)
         q8_cache = model.__dict__.setdefault("_q8_weight_cache", {})
@@ -170,9 +181,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
 
             q8_cache["srcs"] = srcs
             q8_cache["val"] = {
-                "blocks": [{"qkv": _q(q_), "proj": _q(p_),
-                            "fc1": _q(f1), "fc2": _q(f2)}
-                           for q_, p_, f1, f2 in lyrs],
+                "blocks": [{k: _q(l) for k, l in t.items()}
+                           for t in lyr_tabs],
                 "head": _quantize_rows(head_w),
             }
         q8v = q8_cache["val"]
@@ -210,11 +220,16 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
             x = x + _call(model.wpe, jnp.broadcast_to(pos, (B,)))
         idx = lax.broadcasted_iota(jnp.int32, (1, 1, total), 2)
         for i, blk in enumerate(model.blocks):
+            # one copy of the projection math for both weight modes
+            def _lin(layer, kind, h):
+                return _dense_q8(h, q8["blocks"][i][kind]) \
+                    if q8 is not None else _call(layer, h)
+
             if is_llama:
                 h = _call(blk.rms1, x)
-                q = _call(blk.attn.q_proj, h).reshape(B, H, 1, D)
-                k = _call(blk.attn.k_proj, h).reshape(B, KV, 1, D)
-                v = _call(blk.attn.v_proj, h).reshape(B, KV, 1, D)
+                q = _lin(blk.attn.q_proj, "q", h).reshape(B, H, 1, D)
+                k = _lin(blk.attn.k_proj, "k", h).reshape(B, KV, 1, D)
+                v = _lin(blk.attn.v_proj, "v", h).reshape(B, KV, 1, D)
                 q = _rope.__wrapped__(q, base=rope_base,
                                       position_offset=pos)
                 k = _rope.__wrapped__(k, base=rope_base,
@@ -238,8 +253,18 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
             p = jax.nn.softmax(s, axis=-1).astype(cdtype)
             o = jnp.einsum("bkgt,bktd->bkgd", p, vc).reshape(B, U)
             if is_llama:
-                x = x + _call(blk.attn.o_proj, o)
-                x = x + _call(blk.mlp, _call(blk.rms2, x))
+                x = x + _lin(blk.attn.o_proj, "o", o)
+                h2 = _call(blk.rms2, x)
+                if q8 is not None:
+                    # SwiGLU decomposed: down(silu(gate)·up), matching
+                    # models/llama.py (the native arm calls the whole
+                    # mlp Block so model variants keep working)
+                    g = _lin(blk.mlp.gate, "gate", h2)
+                    u = _lin(blk.mlp.up, "up", h2)
+                    x = x + _lin(blk.mlp.down, "down",
+                                 g * jax.nn.sigmoid(g) * u)
+                else:
+                    x = x + _call(blk.mlp, h2)
             elif q8 is not None:
                 x = x + _dense_q8(o, q8["blocks"][i]["proj"])
                 h2 = _call(blk.ln2, x)
