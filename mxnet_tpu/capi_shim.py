@@ -163,11 +163,14 @@ def executor_bind(sym_h: int, arg_handles, grad_handles, grad_reqs) -> int:
             f"({arg_names}), got {len(arg_handles)} handles")
     args = {nm: _handles[ah]["nd"]
             for nm, ah in zip(arg_names, arg_handles)}
-    req = {nm: _GRAD_REQ.get(int(r), "null")
-           for nm, r in zip(arg_names, grad_reqs)}
+    # a write/add req with a null grad handle has nowhere to store the
+    # gradient — downgrade to 'null' explicitly rather than leaving a
+    # dangling write request for Symbol.bind to interpret
+    req = {nm: (_GRAD_REQ.get(int(r), "null") if gh else "null")
+           for nm, gh, r in zip(arg_names, grad_handles, grad_reqs)}
     args_grad = {nm: _handles[gh]["nd"]
-                 for nm, gh, r in zip(arg_names, grad_handles, grad_reqs)
-                 if gh and _GRAD_REQ.get(int(r), "null") != "null"}
+                 for nm, gh in zip(arg_names, grad_handles)
+                 if gh and req[nm] != "null"}
     exe = sym.bind(args=args, args_grad=args_grad, grad_req=req)
     return _put({"exec": exe, "outputs": []})
 

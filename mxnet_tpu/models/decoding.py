@@ -64,6 +64,24 @@ def _quantize_rows(w):
     return wq.T.copy(), s
 
 
+def _quantize_head(w, bias=None):
+    """Head quantization with the vocab dim padded to the 128 lane tile:
+    GPT-2's 50257 is not a lane multiple, and an unpadded head silently
+    falls back to the dequantizing XLA einsum (measured 8x slower than
+    bf16) for the LARGEST matmul of every decode step.  Pads codes with
+    zeros and scales with 1.0 (padded logits come out 0 and are sliced
+    off by the caller, which tracks the true vocab statically); returns
+    (codes, scales, bias_or_None)."""
+    wq, s = _quantize_rows(w)
+    pad = (-wq.shape[1]) % 128
+    if pad:
+        wq = jnp.pad(wq, ((0, 0), (0, pad)))
+        s = jnp.pad(s, (0, pad), constant_values=1.0)
+        if bias is not None:
+            bias = jnp.pad(bias.astype(jnp.float32), (0, pad))
+    return wq, s, bias
+
+
 def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                 top_k=0, seed=0, prefill="batched", weights="native"):
     """Sample ``max_new_tokens`` continuations for a (B, P) prompt.
@@ -153,6 +171,10 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
         # silently serve stale codes after an update.
         head_w = (head.weight if head is not None
                   else model.wte.weight).data()._data
+        head_vocab = int(head_w.shape[0])
+        head_b = None
+        if head is not None and getattr(head, "bias", None) is not None:
+            head_b = head.bias.data()._data
         if is_llama:
             lyr_tabs = [{"q": blk.attn.q_proj, "k": blk.attn.k_proj,
                          "v": blk.attn.v_proj, "o": blk.attn.o_proj,
@@ -168,6 +190,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                  for l in t.values()
                  if getattr(l, "bias", None) is not None]
         srcs.append(head_w)
+        if head_b is not None:
+            srcs.append(head_b)
         q8_cache = model.__dict__.setdefault("_q8_weight_cache", {})
         cached = q8_cache.get("srcs")
         if cached is None or len(cached) != len(srcs) or \
@@ -183,7 +207,7 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
             q8_cache["val"] = {
                 "blocks": [{k: _q(l) for k, l in t.items()}
                            for t in lyr_tabs],
-                "head": _quantize_rows(head_w),
+                "head": _quantize_head(head_w, head_b),
             }
         q8v = q8_cache["val"]
 
@@ -277,8 +301,11 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
         x = _call(model.ln_f, x)
         if q8 is not None:
             from ..ops.q8_matvec import q8_matvec
-            hwq, hs = q8["head"]
-            logits = q8_matvec(x, hwq, hs)
+            hwq, hs, hb = q8["head"]
+            # slice the 128-padded vocab back down; the true vocab is a
+            # STATIC closure value (an int in the traced pytree would
+            # arrive as a tracer and break the slice)
+            logits = q8_matvec(x, hwq, hs, hb)[:, :head_vocab]
         elif head is not None:
             logits = _call(head, x).astype(jnp.float32)
         else:  # tied-embedding head

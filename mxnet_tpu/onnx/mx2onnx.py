@@ -15,6 +15,7 @@ picks the best available container:
 from __future__ import annotations
 
 import json
+import warnings
 
 import numpy as onp
 
@@ -220,12 +221,26 @@ def _conv_dot(name, ins, out, attrs):
     # than exporting silently wrong batched semantics.
     in_shapes = attrs.get("_in_shapes")
     if not in_shapes:
-        # without shape info a rank>2 dot would export as MatMul with
-        # silently-wrong batched semantics — refuse instead of guessing
-        raise MXNetError(
-            "onnx: dot export needs input_shapes at export time to prove "
-            "the operands are 2-D (rank>2 dot is tensordot, which ONNX "
-            "MatMul cannot express)")
+        # Without shape info the plain no-transpose dot exports as MatMul
+        # — identical semantics for the 2-D case, which is what a
+        # shape-free graph's dot overwhelmingly is, and what the
+        # reference exporter emits.  It is NOT identical for rank>2
+        # operands (dot is tensordot over the last/first axes; ONNX
+        # MatMul batches), so the assumption is surfaced as a warning
+        # rather than made silently.  The transpose flags lower to a
+        # rank-2 Transpose(perm=[1,0]) and would be structurally wrong
+        # without rank proof, so those still demand shapes.
+        if attrs.get("transpose_a") or attrs.get("transpose_b"):
+            raise MXNetError(
+                "onnx: dot with transpose_a/transpose_b needs "
+                "input_shapes at export time to prove the operands are "
+                "2-D (the flags lower to a rank-2 Transpose)")
+        warnings.warn(
+            f"onnx: exporting shape-free dot '{name}' as MatMul, which "
+            "assumes 2-D operands; rank>2 dot is tensordot and would "
+            "need input_shapes at export time to refuse correctly",
+            stacklevel=2)
+        return [_node("MatMul", [a, b], [out], name)]
     if any(len(s) != 2 for s in in_shapes[:2]):
         raise MXNetError(
             f"onnx: dot export supports 2-D operands only, got shapes "

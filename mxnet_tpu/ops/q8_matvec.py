@@ -31,20 +31,44 @@ __all__ = ["q8_matvec"]
 def _kernel(x_ref, w_ref, out_ref):
     # int8 -> f32 conversion happens IN VMEM on the VPU (this Mosaic
     # toolchain rejects bf16 matmul operands — same convention as the
-    # flash kernel); HBM only ever sees the int8 codes
+    # flash kernel); HBM only ever sees the int8 codes.  K is the inner
+    # (fastest-varying) grid dim, so the same out block is revisited
+    # consecutively and accumulates across K tiles in f32.
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
     x = x_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
-    out_ref[:] = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    out_ref[:] += jnp.dot(x, w, preferred_element_type=jnp.float32)
 
 
-def _pick_bo(O: int, limit: int = 2048) -> int:
-    """Largest output-tile size that divides O, is a multiple of 128 (the
-    lane tile — O is the minor dim of the (K, O) codes), and keeps the
-    weight tile comfortably in VMEM."""
+# VMEM working-set budget per grid step (v5e has 16 MiB more/core; leave
+# headroom for Mosaic's double-buffered pipeline copies)
+_VMEM_BUDGET = 6 * 1024 * 1024
+
+
+def _tile_bytes(B: int, bk: int, bo: int) -> int:
+    # int8 codes tile + its f32 in-register convert, x slice, out block
+    return bk * bo * (1 + 4) + B * bk * 4 + B * bo * 4
+
+
+def _pick_tiles(B: int, K: int, O: int, limit: int = 2048):
+    """(bk, bo) tile sizes: bo divides O and is a multiple of 128 (the
+    lane tile — O is the minor dim of the (K, O) codes); bk divides K and
+    is a multiple of 32 (the int8 sublane tile); together the working set
+    fits the VMEM budget.  Prefers the largest admissible bo (big lane
+    tiles keep the MXU fed), then the largest K tile that still fits —
+    K-tiled accumulation when the full K cannot.  Returns (0, 0) if no
+    admissible tiling exists (caller falls back to einsum)."""
+    k_divs = [d for d in range(32, K + 1, 32) if K % d == 0]
     for bo in range(min(O, limit), 0, -128):
-        if O % bo == 0 and bo % 128 == 0:
-            return bo
-    return 0
+        if O % bo or bo % 128:
+            continue
+        for bk in reversed(k_divs):
+            if _tile_bytes(B, bk, bo) <= _VMEM_BUDGET:
+                return bk, bo
+    return 0, 0
 
 
 def q8_matvec(x, wt, s, bias=None):
@@ -61,17 +85,17 @@ def q8_matvec(x, wt, s, bias=None):
     """
     B, K = x.shape
     O = wt.shape[1]
-    bo = _pick_bo(O)
-    if not _on_tpu() or K % 32 or not bo:
+    bk, bo = _pick_tiles(B, K, O) if K % 32 == 0 else (0, 0)
+    if not _on_tpu() or not bo:
         y = jnp.einsum("bi,io->bo", x, wt.astype(x.dtype),
                        preferred_element_type=jnp.float32)
     else:
         y = pl.pallas_call(
             _kernel,
-            grid=(O // bo,),
-            in_specs=[pl.BlockSpec((B, K), lambda o: (0, 0)),
-                      pl.BlockSpec((K, bo), lambda o: (0, o))],
-            out_specs=pl.BlockSpec((B, bo), lambda o: (0, o)),
+            grid=(O // bo, K // bk),
+            in_specs=[pl.BlockSpec((B, bk), lambda o, k: (0, k)),
+                      pl.BlockSpec((bk, bo), lambda o, k: (k, o))],
+            out_specs=pl.BlockSpec((B, bo), lambda o, k: (0, o)),
             out_shape=jax.ShapeDtypeStruct((B, O), jnp.float32),
             interpret=_interpret(),
         )(x, wt)

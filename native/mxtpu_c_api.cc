@@ -548,6 +548,7 @@ int MXSymbolInferShape(
     return -1;
   }
   st->shapes.clear();
+  bool all_known = true;  // hosts branch on *complete (reference ABI)
   mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
   const mx_uint **ndims_out[3] = {in_shape_ndim, out_shape_ndim,
                                   aux_shape_ndim};
@@ -563,9 +564,12 @@ int MXSymbolInferShape(
       Py_ssize_t m = PyTuple_Size(shp);
       st->shapes.emplace_back();
       auto &vec = st->shapes.back();
-      for (Py_ssize_t j = 0; j < m; ++j)
-        vec.push_back(static_cast<mx_uint>(
-            PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
+      for (Py_ssize_t j = 0; j < m; ++j) {
+        mx_uint dim = static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j)));
+        if (dim == 0) all_known = false;  // 0 = unknown after partial infer
+        vec.push_back(dim);
+      }
       st->ndims[g].push_back(static_cast<mx_uint>(m));
     }
   }
@@ -579,7 +583,7 @@ int MXSymbolInferShape(
     *datas_out[g] = st->datas[g].data();
   }
   Py_DECREF(r);
-  *complete = 1;
+  *complete = all_known ? 1 : 0;
   return 0;
 }
 

@@ -546,3 +546,49 @@ class TestPadding:
         ref = _naive(q, k, v, causal=False)
         onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
                                     rtol=3e-5, atol=3e-5)
+
+
+class TestQ8MatvecTiling:
+    """ADVICE r4 (medium): large-K layers must tile K within the VMEM
+    budget instead of streaming the whole (K, bo) block, and unaligned
+    vocabs must not silently fall off the kernel path."""
+
+    def test_pick_tiles_bounds_bytes(self):
+        from mxnet_tpu.ops import q8_matvec as q8
+        # Llama-7B down-proj: K=11008, O=4096 — must find a tiling whose
+        # working set fits the budget (pre-fix this streamed ~86 MB f32)
+        bk, bo = q8._pick_tiles(1, 11008, 4096)
+        assert bk and bo and bk % 32 == 0 and bo % 128 == 0
+        assert 11008 % bk == 0 and 4096 % bo == 0
+        assert q8._tile_bytes(1, bk, bo) <= q8._VMEM_BUDGET
+        # huge-K pathological shape still admits the minimum lane tile
+        bk2, bo2 = q8._pick_tiles(1, 32768, 128)
+        assert bk2 and bo2 == 128
+        assert q8._tile_bytes(1, bk2, bo2) <= q8._VMEM_BUDGET
+
+    def test_k_tiled_kernel_matches_einsum(self, monkeypatch):
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.q8_matvec import q8_matvec, _pick_tiles
+        monkeypatch.setenv("MXNET_FLASH_INTERPRET", "1")
+        # shrink the budget so K genuinely tiles even at this test size
+        monkeypatch.setattr("mxnet_tpu.ops.q8_matvec._VMEM_BUDGET",
+                            256 * 1024)
+        B, K, O = 2, 512, 384
+        bk, bo = _pick_tiles(B, K, O)
+        assert bk < K  # the accumulation path is actually exercised
+        x = jnp.asarray(onp.random.RandomState(0).randn(B, K), "float32")
+        wq = jnp.asarray(
+            onp.random.RandomState(1).randint(-127, 128, (K, O)), "int8")
+        s = jnp.asarray(onp.random.RandomState(2).rand(O) + 0.5, "float32")
+        b = jnp.asarray(onp.random.RandomState(3).randn(O), "float32")
+        got = q8_matvec(x, wq, s, b)
+        ref = (x @ wq.astype(jnp.float32)) * s + b
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                    rtol=2e-4, atol=2e-3)
+
+    def test_misaligned_O_falls_back(self):
+        """bo must stay a 128 lane multiple — O=1000 has no admissible
+        tile and must route to the einsum fallback (review regression)."""
+        from mxnet_tpu.ops import q8_matvec as q8
+        assert q8._pick_tiles(1, 256, 1000) == (0, 0)
+        assert q8._pick_tiles(1, 64, 192) == (0, 0)

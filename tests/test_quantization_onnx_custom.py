@@ -339,3 +339,22 @@ class TestQuantizedConv:
         out = qnet(x).asnumpy()
         agree = (out.argmax(1) == ref.argmax(1)).mean()
         assert agree >= 0.9, agree
+
+
+class TestONNXShapeFreeDot:
+    """ADVICE r4: a plain 2-D no-transpose dot must export without
+    input_shapes (MatMul is semantically identical for rank 2); the
+    transpose flags still demand shape proof."""
+
+    def test_plain_dot_exports_without_shapes(self, tmp_path):
+        s = mx.sym.dot(mx.sym.var("a"), mx.sym.var("b"))
+        out = mx.onnx.export_model(
+            s, {}, onnx_file_path=str(tmp_path / "d.onnx"))
+        g = json.load(open(out))
+        assert "MatMul" in [n["op_type"] for n in g["graph"]["nodes"]]
+
+    def test_transposed_dot_without_shapes_raises(self, tmp_path):
+        s = mx.sym.dot(mx.sym.var("a"), mx.sym.var("b"), transpose_b=True)
+        with pytest.raises(MXNetError):
+            mx.onnx.export_model(
+                s, {}, onnx_file_path=str(tmp_path / "dt.onnx"))
