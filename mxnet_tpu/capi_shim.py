@@ -228,3 +228,58 @@ def nd_load(fname: str):
 def sym_save_to_file(h: int, fname: str) -> None:
     """MXSymbolSaveToFile: the exported-json format."""
     _handles[h]["sym"].save(fname)
+
+
+def op_list_names() -> tuple:
+    """MXListAllOpNames: every registered op name + alias (the registry
+    IS the dispatch table, SURVEY.md §3.1 C API row)."""
+    from .ops.registry import OPS, _ALIASES
+    return tuple(sorted(set(OPS) | set(_ALIASES)))
+
+
+def op_exists(name: str) -> int:
+    from .ops.registry import get_op
+    try:
+        get_op(name)
+        return 1
+    except Exception:
+        return 0
+
+
+def imperative_invoke(name: str, in_handles, out_handles, keys, vals):
+    """MXImperativeInvoke: name-based eager op dispatch — THE per-op fast
+    path every reference binding sits on (SURVEY.md §3.1 C API row,
+    call stack §4.1).  Inputs are ndarray handles; attrs arrive as
+    strings and parse the way the reference's dmlc::Parameter does
+    (python-literal syntax — ints, floats, bools, tuples — else the raw
+    string).  With a caller-supplied out handle the result rebinds that
+    handle (reference in-place semantics: ``sgd_update(w, g, out=w)``
+    updates w through the caller's existing handle); otherwise fresh
+    handles are returned (caller frees via MXNDArrayFree)."""
+    import ast
+
+    from .ops.registry import get_op
+    get_op(name)  # raises on unknown -> clean MXGetLastError surface
+    import mxnet_tpu as mx
+    fn = getattr(mx.nd, name, None)
+    if fn is None or not callable(fn):
+        raise ValueError(
+            f"imperative invoke: op {name!r} is registered but has no "
+            f"mx.nd wrapper")
+    arrays = [_handles[h]["nd"] for h in in_handles]
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    outs = [_handles[h]["nd"] for h in out_handles]
+    if len(outs) > 1:
+        raise ValueError(
+            "imperative invoke: at most one caller-supplied out handle "
+            "(multi-output ops allocate their outputs)")
+    res = fn(*arrays, out=outs[0] if outs else None, **kwargs)
+    if outs:
+        return tuple(out_handles)
+    res = res if isinstance(res, (list, tuple)) else (res,)
+    return tuple(_put({"nd": r}) for r in res)

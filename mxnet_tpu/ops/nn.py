@@ -180,7 +180,10 @@ def Convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
         feat_axis = 1
     else:  # NHWC-style (TPU-preferred)
         dn_in = "N" + spatial + "C"
-        dn_ker = spatial + "IO"
+        # weights stay OIHW in EVERY layout so parameters (and .params
+        # checkpoints) are layout-invariant; XLA relayouts the small
+        # kernel tensor internally
+        dn_ker = "OI" + spatial
         dn_out = "N" + spatial + "C"
         feat_axis = data.ndim - 1
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,

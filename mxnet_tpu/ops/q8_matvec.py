@@ -85,7 +85,7 @@ def q8_matvec(x, wt, s, bias=None):
     """
     B, K = x.shape
     O = wt.shape[1]
-    bk, bo = _pick_tiles(B, K, O) if K % 32 == 0 else (0, 0)
+    bk, bo = _pick_tiles(B, K, O)
     if not _on_tpu() or not bo:
         y = jnp.einsum("bi,io->bo", x, wt.astype(x.dtype),
                        preferred_element_type=jnp.float32)

@@ -17,6 +17,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -689,6 +691,145 @@ int MXExecutorFree(ExecutorHandle handle) {
   Py_XDECREF(r);
   delete st;
   return r ? 0 : (capture_py_error(), -1);
+}
+
+}  // extern "C"\n
+// ========================================================================
+// Imperative op invocation (reference src/c_api/c_api_ndarray.cc:
+// MXImperativeInvoke[Ex] + op discovery, SURVEY.md §3.1 C API row and
+// call stack §4.1 — the per-op fast path every language binding sits
+// on).  Op handles are interned name strings; attrs cross as strings
+// and parse shim-side like dmlc::Parameter.
+// ========================================================================
+
+typedef void *OpHandle;
+typedef void *AtomicSymbolCreator;
+
+extern "C" {
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  // valid until the next call on THIS thread (the file-wide ret-store
+  // convention)
+  static thread_local std::vector<std::string> name_store;
+  static thread_local std::vector<const char *> ptr_store;
+  PyObject *r = PyObject_CallMethod(shim(), "op_list_names", nullptr);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(r);
+  name_store.clear();
+  ptr_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(r, i));
+    name_store.emplace_back(s ? s : "");
+  }
+  Py_DECREF(r);
+  for (auto &s : name_store) ptr_store.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = ptr_store.data();
+  return 0;
+}
+
+// Name -> op handle (nnvm ABI anchor NNGetOpHandle).  Validates against
+// the registry so hosts fail at lookup, not mid-invoke.
+int NNGetOpHandle(const char *name, OpHandle *out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(shim(), "op_exists", "s", name);
+  long ok = call_long(r);
+  if (ok < 0) return -1;
+  if (!ok) {
+    set_error(std::string("unknown operator: ") + name);
+    return -1;
+  }
+  static std::mutex mu;
+  static std::map<std::string, std::unique_ptr<std::string>> interned;
+  std::lock_guard<std::mutex> lk(mu);
+  auto it = interned.find(name);
+  if (it == interned.end())
+    it = interned.emplace(name,
+                          std::unique_ptr<std::string>(
+                              new std::string(name))).first;
+  *out = const_cast<char *>(it->second->c_str());
+  return 0;
+}
+
+// creator = an OpHandle from NNGetOpHandle.  On entry *num_outputs may
+// carry caller-supplied output handles (in-place update semantics, e.g.
+// sgd_update with out=weight); 0 means the op allocates.  Allocated
+// output handles are owned by the caller (MXNDArrayFree); the *outputs
+// pointer array itself stays valid until the next invoke on this thread
+// (reference thread-local ret-store semantics).
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  const char *name = static_cast<const char *>(creator);
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i)
+    PyList_SetItem(ins, i, PyLong_FromLong(
+        static_cast<MXNDState *>(inputs[i])->shim_handle));
+  int n_out_in = *num_outputs;
+  PyObject *outs_in = PyList_New(n_out_in);
+  for (int i = 0; i < n_out_in; ++i)
+    PyList_SetItem(outs_in, i, PyLong_FromLong(
+        static_cast<MXNDState *>((*outputs)[i])->shim_handle));
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *r = PyObject_CallMethod(shim(), "imperative_invoke", "sOOOO",
+                                    name, ins, outs_in, keys, vals);
+  Py_DECREF(ins);
+  Py_DECREF(outs_in);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!r) {
+    capture_py_error();
+    return -1;
+  }
+  if (n_out_in > 0) {
+    // caller-supplied handles were rebound in place; nothing to return
+    *num_outputs = n_out_in;
+    Py_DECREF(r);
+    return 0;
+  }
+  Py_ssize_t n = PyTuple_Size(r);
+  static thread_local std::vector<NDArrayHandle> out_store;
+  out_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    auto *nd = new MXNDState();
+    nd->shim_handle = PyLong_AsLong(PyTuple_GetItem(r, i));
+    out_store.push_back(nd);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(n);
+  *outputs = out_store.data();
+  return 0;
+}
+
+// Ex variant (reference MXImperativeInvokeEx): adds output storage-type
+// reporting — dense-only here (kDefaultStorage = 0), matching the
+// registry's dense ndarray handles.
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  int rc = MXImperativeInvoke(creator, num_inputs, inputs, num_outputs,
+                              outputs, num_params, param_keys, param_vals);
+  if (rc != 0) return rc;
+  static thread_local std::vector<int> stype_store;
+  stype_store.assign(static_cast<size_t>(*num_outputs), 0);
+  *out_stypes = stype_store.data();
+  return 0;
 }
 
 }  // extern "C"

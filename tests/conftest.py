@@ -32,15 +32,6 @@ import numpy as onp
 import pytest
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "fast: sub-5-minute gate covering every subsystem "
-        "(run with -m fast)")
-    config.addinivalue_line(
-        "markers", "slow: measured-slow tests; full suite only "
-        "(membership generated by tools/gen_slow_marks.py)")
-
-
 def _load_slow_ids():
     path = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
     try:

@@ -80,3 +80,42 @@ class TestForward:
             trainer.step(4)
             losses.append(float(L.mean().asnumpy()))
         assert losses[-1] < losses[0]
+
+
+class TestNHWCLayout:
+    """layout="NHWC" (the TPU-preferred channels-last execution mode)
+    must be numerically identical to NCHW — same NCHW input contract,
+    same OIHW parameters, one stem transpose inside."""
+
+    def test_resnet_nhwc_matches_nchw(self):
+        x = mx.nd.array(onp.random.RandomState(0)
+                        .rand(2, 3, 32, 32).astype("float32"))
+        outs = {}
+        for lay in ("NCHW", "NHWC"):
+            mx.random.seed(0)
+            net = get_resnet(1, 18, classes=10, layout=lay)
+            net.initialize(mx.init.Xavier())
+            net.hybridize()
+            outs[lay] = net(x).asnumpy()
+        onp.testing.assert_allclose(outs["NHWC"], outs["NCHW"],
+                                    rtol=2e-5, atol=2e-5)
+
+    def test_resnet_nhwc_trains(self):
+        from mxnet_tpu import gluon
+        mx.random.seed(0)
+        net = get_resnet(1, 18, classes=4, layout="NHWC")
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = mx.nd.array(onp.random.RandomState(1)
+                        .rand(8, 3, 32, 32).astype("float32"))
+        y = mx.nd.array(onp.arange(8, dtype=onp.float32) % 4)
+        losses = []
+        for _ in range(4):
+            with mx.autograd.record():
+                l = L(net(x), y).mean()
+            l.backward()
+            tr.step(8)
+            losses.append(float(onp.asarray(l.asnumpy())))
+        assert losses[-1] < losses[0]
