@@ -693,7 +693,8 @@ int MXExecutorFree(ExecutorHandle handle) {
   return r ? 0 : (capture_py_error(), -1);
 }
 
-}  // extern "C"\n
+}  // extern "C"
+
 // ========================================================================
 // Imperative op invocation (reference src/c_api/c_api_ndarray.cc:
 // MXImperativeInvoke[Ex] + op discovery, SURVEY.md §3.1 C API row and

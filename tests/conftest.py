@@ -50,14 +50,26 @@ def pytest_collection_modifyitems(config, items):
     new tests enter the gate until a regeneration measures them."""
     slow_ids = _load_slow_ids()
     seen = set()
+    # the op-conformance sweep is ~1900 nodes; the gate keeps a 1/8
+    # rotation (structural, so newly registered ops join automatically)
+    # while measured-slow nodes stay out of the gate regardless
+    conf_idx = 0
     for item in items:
         seen.add(item.nodeid)
-        if item.nodeid in slow_ids:
+        slow = item.nodeid in slow_ids
+        if "test_op_conformance" in item.nodeid and \
+                "::test_" in item.nodeid and "[" in item.nodeid:
+            slow = slow or (conf_idx % 8 != 0)
+            conf_idx += 1
+        if slow:
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.fast)
     # staleness guard: ids that no longer collect mean the list rotted
-    if len(items) > 1000:  # only meaningful on (near-)full collections
+    # (only meaningful when the whole suite was collected — single-file
+    # runs legitimately miss most listed ids)
+    n_files = len({i.nodeid.split("::")[0] for i in items})
+    if n_files >= 30:
         dead = slow_ids - seen
         if dead:
             import warnings

@@ -272,3 +272,53 @@ class TestJittableCSRUnion:
         mxsp.multiply(a, b)
         mxsp.dot(a, rhs)
         assert a._dense_cache is None and b._dense_cache is None
+
+    def test_rs_union_device_jittable(self):
+        """The row_sparse union kernel is a pure static-shape jax
+        function (VERDICT r4 item 5): jit it directly, check keys,
+        union semantics (multiply keeps the union pattern with zero
+        rows outside the intersection), and the packed layout."""
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ndarray.sparse import _rs_union_device
+        ka = jnp.asarray([1, 5], jnp.int32)
+        va = jnp.asarray([[1., 2.], [3., 4.]])
+        kb = jnp.asarray([5, 9], jnp.int32)
+        vb = jnp.asarray([[10., 10.], [7., 8.]])
+        f = jax.jit(lambda *a: _rs_union_device(*a, opname="add"))
+        keys, vals, valid = f(ka, va, kb, vb)
+        assert keys.shape == (4,) and vals.shape == (4, 2)
+        assert int(valid.sum()) == 3
+        onp.testing.assert_array_equal(onp.asarray(keys[:3]), [1, 5, 9])
+        onp.testing.assert_allclose(onp.asarray(vals[:3]),
+                                    [[1, 2], [13, 14], [7, 8]])
+        g = jax.jit(lambda *a: _rs_union_device(*a, opname="multiply"))
+        keys, vals, valid = g(ka, va, kb, vb)
+        assert int(valid.sum()) == 3  # union pattern, not intersection
+        onp.testing.assert_allclose(onp.asarray(vals[:3]),
+                                    [[0, 0], [30, 40], [0, 0]])
+
+    def test_rs_ops_never_touch_the_dense_mirror(self):
+        """row_sparse elemwise and sparse_retain must not materialize
+        the dense cache (r4 item 5 extends the csr-only regression)."""
+        from mxnet_tpu.ndarray import sparse as mxsp
+        rng = onp.random.RandomState(4)
+        da = onp.zeros((10, 3), "float32")
+        db = onp.zeros((10, 3), "float32")
+        da[[1, 4, 7]] = rng.rand(3, 3)
+        db[[4, 8]] = rng.rand(2, 3)
+        a = mx.nd.array(da).tostype("row_sparse")
+        b = mx.nd.array(db).tostype("row_sparse")
+        a._dense_cache = None
+        b._dense_cache = None
+        s = mxsp.add(a, b)
+        m = mxsp.multiply(a, b)
+        r = mxsp.sparse_retain(a, mx.nd.array(
+            onp.asarray([1, 7], "float32")))
+        assert a._dense_cache is None and b._dense_cache is None
+        onp.testing.assert_allclose(onp.asarray(s.asnumpy()), da + db,
+                                    rtol=1e-6)
+        onp.testing.assert_allclose(onp.asarray(m.asnumpy()), da * db,
+                                    rtol=1e-6)
+        onp.testing.assert_array_equal(onp.asarray(r.indices.asnumpy()),
+                                       [1, 7])
