@@ -37,6 +37,11 @@ class NDArray:
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_autograd_node",
                  "_autograd_idx", "_weakref", "__weakref__")
 
+    # class flag, overridden True by BaseSparseNDArray: lets the operator
+    # hot path reject sparse dispatch with one attribute load instead of
+    # an isinstance against a lazily-imported class
+    _sparse_kind = False
+
     def __init__(self, data, ctx: Optional[Context] = None):
         self._data = data
         self._ctx = ctx
@@ -462,10 +467,48 @@ class NDArray:
     # ------------------------------------------------------------------ #
     def _binop(self, other, name, reverse=False):
         from ..ops import defs as _ops
+        if self._sparse_kind or getattr(other, "_sparse_kind", False):
+            return self._binop_sparse(other, name, reverse)
         fn = getattr(_ops, name)
         if reverse:
             return fn(_coerce(other, self), self)
         return fn(self, _coerce(other, self))
+
+    def _binop_sparse(self, other, name, reverse=False):
+        """Storage-aware operator dispatch (reference: FComputeEx —
+        elemwise ops keep sparse storage when both operands share it).
+        Same-kind, same-shape sparse pairs route through the union
+        kernels OUTSIDE autograd recording (the union kernels build
+        results structurally and record no tape node); every other case
+        — mixed storage, scalars, broadcasts, or under ``record()`` —
+        runs the registered dense op on the operands' dense mirrors,
+        which records normally (sparse operands then receive DENSE
+        gradients, the reference's storage-fallback grad behavior)."""
+        from .. import autograd
+        from ..ops import defs as _ops
+        recording = autograd.is_recording()
+        # scalar scale of a sparse array keeps storage (reference
+        # _mul_scalar/_div_scalar FComputeEx on row_sparse/csr): only
+        # the stored values scale, the pattern — and the dense mirror's
+        # memory — is never materialized.  Scalar add/sub destroys
+        # sparsity, so those fall through to the dense path.
+        if self._sparse_kind and isinstance(other, numeric_types) \
+                and not recording:
+            from . import sparse as _sparse
+            if name == "broadcast_mul" or \
+                    (name == "broadcast_div" and not reverse):
+                v = float(other) if name == "broadcast_mul" \
+                    else 1.0 / float(other)
+                return _sparse._scale(self, v)
+        a, b = (other, self) if reverse else (self, other)
+        a, b = _coerce(a, self), _coerce(b, self)
+        spname = _SPARSE_BINOPS.get(name)
+        if spname is not None and type(a) is type(b) \
+                and a._sparse_kind and a.shape == b.shape \
+                and not recording:
+            from . import sparse as _sparse
+            return _sparse._elemwise(spname, a, b)
+        return getattr(_ops, name)(a, b)
 
     def __add__(self, o):
         return self._binop(o, "broadcast_add")
@@ -594,6 +637,12 @@ def _index_key(key):
     return key
 
 
+# python-operator name -> sparse union-kernel name (storage-preserving
+# subset; everything else takes the dense fallback in _binop_sparse)
+_SPARSE_BINOPS = {"broadcast_add": "add", "broadcast_sub": "subtract",
+                  "broadcast_mul": "multiply"}
+
+
 def _coerce(x, like: "NDArray"):
     if isinstance(x, NDArray):
         return x
@@ -605,8 +654,13 @@ def _coerce(x, like: "NDArray"):
 
 
 def _wrap_like(data, ref: Optional[NDArray]) -> NDArray:
-    # honor the ref's class so mx.np arrays propagate through every op
+    # honor the ref's class so mx.np arrays propagate through every op —
+    # EXCEPT sparse refs: a generic kernel's result is dense, and sparse
+    # containers need structural (data+indices) construction; ops that
+    # preserve sparse storage build their outputs explicitly
     cls = type(ref) if ref is not None else NDArray
+    if getattr(cls, "_sparse_kind", False):
+        cls = NDArray
     return cls(data, ref._ctx if ref is not None else None)
 
 

@@ -116,6 +116,8 @@ class BaseSparseNDArray(NDArray):
 
     __slots__ = ("_sp_shape", "_sp_dtype", "_dense_cache", "_stale")
 
+    _sparse_kind = True  # see NDArray._sparse_kind
+
     def _init_base(self, shape, dtype, ctx):
         self._sp_shape = tuple(int(s) for s in shape)
         self._sp_dtype = jnp.dtype(dtype)
@@ -335,6 +337,10 @@ def retain(rs: RowSparseNDArray, indices):
     membership test, stable packing of surviving rows, and the value
     gather all run as one static-shape device computation; only the
     final trim count reads back (same discipline as ``_rs_elemwise``)."""
+    if rs.shape[0] >= 2 ** 31 - 1:
+        raise MXNetError(
+            "sparse_retain: >= 2^31-1 rows — int32 row indices would "
+            "overflow (enable a chunked path if this arises)")
     idx = jnp.asarray(indices._data if isinstance(indices, NDArray)
                       else jnp.asarray(indices), jnp.int32)
     rs._components()
@@ -346,8 +352,7 @@ def retain(rs: RowSparseNDArray, indices):
     packed_rows = rows[order]
     packed_vals = rs._rs_data[order]
     cnt = int(keep.sum())                      # the one host scalar
-    return RowSparseNDArray(packed_vals[:cnt],
-                            onp.asarray(packed_rows[:cnt]), rs.shape)
+    return RowSparseNDArray(packed_vals[:cnt], packed_rows[:cnt], rs.shape)
 
 
 def sparse_retain(data, indices):
@@ -528,13 +533,42 @@ def _rs_elemwise(opname, a: RowSparseNDArray, b: RowSparseNDArray):
     if a.shape != b.shape:
         raise MXNetError(f"row_sparse elemwise {opname}: shape mismatch "
                          f"{a.shape} vs {b.shape}")
+    if a.shape[0] >= 2 ** 31 - 1:
+        # row ids run to shape[0]-1: beyond this the int32 narrowing
+        # wraps and a live row id would collide with _KEY_SENTINEL
+        # (same guard as _csr_elemwise's cell-count check)
+        raise MXNetError(
+            "row_sparse elemwise: >= 2^31-1 rows — int32 row keys would "
+            "overflow (enable a chunked path if this arises)")
     a._components()
     b._components()
     keys, vals, valid = _rs_union_device(
         jnp.asarray(a._rs_indices, jnp.int32), a._rs_data,
         jnp.asarray(b._rs_indices, jnp.int32), b._rs_data, opname)
     n = int(valid.sum())                       # the one host scalar
-    return RowSparseNDArray(vals[:n], onp.asarray(keys[:n]), a.shape)
+    return RowSparseNDArray(vals[:n], keys[:n], a.shape)
+
+
+def _scale(x, v: float):
+    """Storage-preserving scalar scale (reference ``_mul_scalar``
+    FComputeEx on sparse storage): scales only the stored values —
+    the pattern is untouched and the dense mirror is NEVER
+    materialized (the point of sparse storage for e.g. ``grad * lr``
+    on a (vocab, dim) row_sparse gradient)."""
+    if isinstance(x, RowSparseNDArray):
+        x._components()
+        return RowSparseNDArray(x._rs_data * x._rs_data.dtype.type(v),
+                                x._rs_indices, x.shape, x._ctx)
+    if isinstance(x, CSRNDArray):
+        x._components()
+        out = CSRNDArray.__new__(CSRNDArray)
+        out._init_base(x.shape, x._sp_dtype, x._ctx)
+        out._csr_data = x._csr_data * x._csr_data.dtype.type(v)
+        out._csr_indices = x._csr_indices
+        out._csr_indptr = x._csr_indptr
+        out._csr_rowids = x._csr_rowids
+        return out
+    raise MXNetError(f"_scale: unsupported storage {type(x).__name__}")
 
 
 def _elemwise(opname, a, b):

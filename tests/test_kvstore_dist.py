@@ -187,6 +187,99 @@ class TestLauncher:
                 re.finditer(r"SPMD_OK loss=([\d.]+)", out.stdout)}
         assert len(vals) == 1, f"ranks disagree: {vals}"
 
+    def test_real_three_process_nightly_shape(self, tmp_path):
+        """The reference's nightly harness shape (SURVEY.md §7,
+        ``tests/nightly/dist_sync_kvstore.py``): THREE workers in one run
+        asserting (a) sync semantics — every worker computes the identical
+        allreduced value and a second wave sees the first wave's state,
+        (b) 2-bit compression with error feedback ACROSS processes —
+        sub-threshold gradients are not lost, they drain through the
+        residual over repeated pushes on every rank, and (c) row_sparse
+        pulls of a server-updated weight return exactly the touched rows
+        on all ranks.  One harness, three workers, like the reference
+        (its 3 server processes collapse into the XLA collective — the
+        'server' is the compiled AllReduce; PARITY.md KVStore row)."""
+        script = tmp_path / "nightly_prog.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import mxnet_tpu as mx\n"
+            "from mxnet_tpu.parallel import init_distributed\n"
+            "init_distributed()\n"
+            "import jax, numpy as onp\n"
+            "rank = jax.process_index()\n"
+            "N = jax.process_count()\n"
+            "assert N == 3, N\n"
+            "kv = mx.kv.create('dist_sync')\n"
+            "assert kv.num_workers == 3\n"
+            "# --- (a) sync semantics: two dependent pushpull waves ----\n"
+            "kv.init('w', mx.nd.zeros((4, 3)))\n"
+            "kv.pushpull('w', mx.nd.full((4, 3), float(rank + 1)))\n"
+            "got = mx.nd.zeros((4, 3))\n"
+            "kv.pull('w', out=got)\n"
+            "onp.testing.assert_allclose(got.asnumpy(),\n"
+            "                            onp.full((4, 3), 6.0))\n"
+            "kv.barrier()\n"
+            "# second wave ACCUMULATES onto the stored key (push with no\n"
+            "# updater adds): 6.0 from wave 1 + allreduced ones = 9.0 —\n"
+            "# passes only if wave-1 store state is visible to wave 2\n"
+            "kv.push('w', mx.nd.ones((4, 3)))\n"
+            "kv.pull('w', out=got)\n"
+            "onp.testing.assert_allclose(got.asnumpy(),\n"
+            "                            onp.full((4, 3), 9.0))\n"
+            "# --- (b) 2-bit compression + error feedback x-process ----\n"
+            "kvc = mx.kv.create('dist_sync')\n"
+            "kvc.set_gradient_compression({'type': '2bit',\n"
+            "                              'threshold': 0.5})\n"
+            "kvc.init('g', mx.nd.zeros(6))\n"
+            "# rank-dependent sub-threshold grads: 0.2*(rank+1) each push.\n"
+            "# Per push each rank wires 0 or +-0.5 pulses; over 10 pushes\n"
+            "# the residual drains so every rank's total approaches\n"
+            "# 10*0.2*(rank+1), summed across ranks = 12.0 (+- one 0.5\n"
+            "# pulse per rank still stuck in residuals)\n"
+            "tot = onp.zeros(6, onp.float32)\n"
+            "o = mx.nd.zeros(6)\n"
+            "for _ in range(10):\n"
+            "    kvc.pushpull('g', mx.nd.full((6,), 0.2 * (rank + 1)),\n"
+            "                 out=o)\n"
+            "    tot += o.asnumpy()\n"
+            "onp.testing.assert_allclose(tot, onp.full(6, 12.0), atol=1.5)\n"
+            "kvc.barrier()\n"
+            "# --- (c) row_sparse pull of a server-updated weight ------\n"
+            "kvs = mx.kv.create('dist_sync')\n"
+            "kvs.init('emb', mx.nd.zeros((8, 4)))\n"
+            "upd = onp.zeros((8, 4), onp.float32)\n"
+            "upd[2] = rank + 1.0\n"
+            "upd[5] = 10.0 * (rank + 1)\n"
+            "kvs.pushpull('emb', mx.nd.array(upd))\n"
+            "rout = mx.nd.zeros((8, 4))\n"
+            "kvs.row_sparse_pull('emb', out=rout,\n"
+            "                    row_ids=mx.nd.array(\n"
+            "                        onp.array([2, 5], onp.int64)))\n"
+            "want = onp.zeros((8, 4), onp.float32)\n"
+            "want[2] = 6.0\n"
+            "want[5] = 60.0\n"
+            "onp.testing.assert_allclose(rout.asnumpy(), want)\n"
+            "# untouched rows must come back ZERO even though the dense\n"
+            "# store also holds them (touched-rows-only contract)\n"
+            "full = mx.nd.zeros((8, 4))\n"
+            "kvs.pull('emb', out=full)\n"
+            "assert float(abs(full.asnumpy()).sum()) == \\\n"
+            "    float(abs(want).sum())\n"
+            "kvs.barrier()\n"
+            "print('RANK%d_NIGHTLY_OK' % rank, flush=True)\n")
+        import os
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "3", "--launcher",
+             "local", sys.executable, str(script)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=300)
+        assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+        for r in range(3):
+            assert f"RANK{r}_NIGHTLY_OK" in out.stdout, out.stdout[-500:]
+
     def test_two_process_bucketed_pushpull(self, tmp_path):
         """A key-list pushpull on a dist store must coalesce into one
         AllReduce per dtype (bucketing) and still sum correctly across

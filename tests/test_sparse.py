@@ -116,6 +116,125 @@ class TestCSR:
         onp.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
 
 
+class TestOperatorDispatch:
+    """Python operators on sparse operands must route storage-aware
+    (reference FComputeEx dispatch): sparse op same-kind-sparse keeps
+    the storage type via the union kernels; mixed/scalar pairings
+    densify (the reference's storage fallback) instead of crashing."""
+
+    def test_rs_plus_rs_stays_row_sparse(self):
+        a = sparse.row_sparse_array(
+            (onp.arange(6, dtype=onp.float32).reshape(2, 3),
+             onp.array([1, 4])), shape=(6, 3))
+        b = sparse.row_sparse_array(
+            (onp.ones((2, 3), onp.float32), onp.array([4, 5])),
+            shape=(6, 3))
+        s = a + b
+        assert s.stype == "row_sparse"
+        want = onp.zeros((6, 3), onp.float32)
+        want[1] = [0, 1, 2]
+        want[4] = [4, 5, 6]
+        want[5] = 1
+        onp.testing.assert_allclose(s.asnumpy(), want)
+        m = a * b
+        assert m.stype == "row_sparse"
+        wm = onp.zeros((6, 3), onp.float32)
+        wm[4] = [3, 4, 5]
+        onp.testing.assert_allclose(m.asnumpy(), wm)
+
+    def test_csr_minus_csr_stays_csr(self):
+        a_s = _rand_csr(6, 6, seed=20)
+        b_s = _rand_csr(6, 6, seed=21)
+        a = sparse.csr_matrix((a_s.data, a_s.indices, a_s.indptr),
+                              shape=a_s.shape)
+        b = sparse.csr_matrix((b_s.data, b_s.indices, b_s.indptr),
+                              shape=b_s.shape)
+        out = a - b
+        assert out.stype == "csr"
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    (a_s - b_s).toarray(), rtol=1e-6)
+
+    def test_mixed_densifies_scalar_scale_keeps_storage(self):
+        a = sparse.row_sparse_array(
+            (onp.ones((1, 3), onp.float32), onp.array([2])), shape=(4, 3))
+        m = a + nd.ones((4, 3))
+        assert m.stype == "default"
+        onp.testing.assert_allclose(m.asnumpy()[2], [2, 2, 2])
+        # scalar mul/div preserve storage (reference _mul_scalar
+        # FComputeEx): no dense mirror materialization
+        for out, want in [(a * 2.0, 2.0), (2.0 * a, 2.0), (a / 2.0, 0.5)]:
+            assert out.stype == "row_sparse"
+            assert out._dense_cache is None  # mirror never built
+            onp.testing.assert_allclose(out.asnumpy()[2], [want] * 3)
+        sc = 2.0 / a  # reverse div is not a scale -> dense fallback
+        assert sc.stype == "default"
+        # scalar add destroys sparsity -> dense
+        assert (a + 1.0).stype == "default"
+        # csr scalar scale also keeps storage
+        c = sparse.csr_matrix(
+            (onp.array([3.0], onp.float32), onp.array([1]),
+             onp.array([0, 1, 1])), shape=(2, 3))
+        cs = c * 3.0
+        assert cs.stype == "csr" and cs._dense_cache is None
+        onp.testing.assert_allclose(cs.asnumpy()[0, 1], 9.0)
+
+    def test_broadcast_shapes_densify_not_crash(self):
+        a = sparse.row_sparse_array(
+            (onp.ones((2, 3), onp.float32), onp.array([0, 2])),
+            shape=(4, 3))
+        b = sparse.row_sparse_array(
+            (onp.full((1, 3), 2.0, onp.float32), onp.array([0])),
+            shape=(1, 3))
+        out = a * b  # (4,3)*(1,3): union kernels can't broadcast ->
+        assert out.stype == "default"  # dense fallback, correct values
+        want = onp.zeros((4, 3), onp.float32)
+        want[0] = want[2] = 2.0
+        onp.testing.assert_allclose(out.asnumpy(), want)
+
+    def test_operator_grads_flow_under_record(self):
+        """Under autograd.record() the operators must take the RECORDED
+        dense path (the union kernels build results structurally and
+        record nothing) — gradients land on the sparse operands as
+        dense grads, not silent zeros."""
+        from mxnet_tpu import autograd
+        a = sparse.row_sparse_array(
+            (onp.arange(6, dtype=onp.float32).reshape(2, 3),
+             onp.array([1, 4])), shape=(6, 3))
+        b = sparse.row_sparse_array(
+            (onp.ones((2, 3), onp.float32), onp.array([4, 5])),
+            shape=(6, 3))
+        a.attach_grad()
+        b.attach_grad()
+        with autograd.record():
+            s = a * b
+            loss = nd.sum(s)
+        loss.backward()
+        # d(sum(a*b))/da = dense(b); nonzero exactly on b's rows
+        want_da = onp.zeros((6, 3), onp.float32)
+        want_da[4] = want_da[5] = 1.0
+        onp.testing.assert_allclose(a.grad.asnumpy(), want_da)
+        # d(sum(a*b))/db = dense(a): rows 1 and 4
+        want_db = onp.zeros((6, 3), onp.float32)
+        want_db[1] = [0, 1, 2]
+        want_db[4] = [3, 4, 5]
+        onp.testing.assert_allclose(b.grad.asnumpy(), want_db)
+
+    def test_huge_row_count_guard(self):
+        class FakeRS(sparse.RowSparseNDArray):
+            def __init__(self):
+                pass
+
+            @property
+            def shape(self):
+                return (2 ** 31, 3)
+
+        from mxnet_tpu.base import MXNetError
+        with pytest.raises(MXNetError, match="int32 row keys"):
+            sparse._rs_elemwise("add", FakeRS(), FakeRS())
+        with pytest.raises(MXNetError, match="int32 row indices"):
+            sparse.retain(FakeRS(), nd.array(onp.array([1])))
+
+
 class TestRowSparse:
     def test_dot_golden(self):
         vals = onp.random.RandomState(0).randn(3, 6).astype(onp.float32)
