@@ -51,13 +51,13 @@ def resnet50_convs(bs):
     return out
 
 
-def conv_fn(k, stride):
+def conv_fn(k, stride, layout="NCHW"):
     pad = [(k // 2, k // 2)] * 2
+    dn = (layout, "OIHW", layout)
 
     def f(x, w):
         return lax.conv_general_dilated(
-            x, w, (stride, stride), pad,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            x, w, (stride, stride), pad, dimension_numbers=dn)
     return f
 
 
@@ -65,21 +65,46 @@ def chained(op):
     """One jitted harness per op with a DYNAMIC trip count: iteration i
     scales the varying arg by a runtime ``ones`` vector (a traced input,
     so XLA cannot constant-fold it to 1.0 and hoist the op out of the
-    loop — the failure mode of the first version of this probe) and
-    accumulates one output element."""
+    loop) and accumulates the SUM of the whole output — consuming only
+    one element lets XLA narrow the conv to computing that element
+    (measured: "26 million TF/s"), the failure mode of the second
+    version of this probe.  The sum fuses into the conv epilogue, so
+    the extra cost is far below the conv itself."""
     def run(n, ones, *args):
         def body(i, acc):
             a0 = args[0] * ones[i % ones.shape[0]]
             y = op(a0, *args[1:])
-            return acc + y.reshape(-1)[0].astype(jnp.float32)
+            return acc + jnp.sum(y.astype(jnp.float32))
         return lax.fori_loop(0, n, body, jnp.float32(0))
     return jax.jit(run)
 
 
 def slope_time(f, args, n1, n2, reps=3):
-    """T(n2)-T(n1) over (n2-n1): cancels dispatch/readback RTT."""
+    """T(n2)-T(n1) over (n2-n1): cancels dispatch/readback RTT.
+
+    The tunnel's RTT jitter is ~50-100 ms, so the iteration-count DELTA
+    must put >= ~0.5 s of device work between the two measurements or
+    the slope is noise (the r5 first-probe failure mode: 30 ms of
+    signal under 100 ms of jitter produced 0.000-ms ops and "26
+    million TF/s").  A pilot run sizes n2 adaptively.  Retries the
+    compile on transient tunnel drops."""
     ones = jnp.ones((8,), args[0].dtype)
-    float(f(n1, ones, *args))  # one compile serves both trip counts
+    for attempt in range(3):
+        try:
+            float(f(n1, ones, *args))  # one compile serves all counts
+            break
+        except Exception:
+            if attempt == 2:
+                raise
+            time.sleep(5.0)
+    # pilot with an RTT-cancelling delta: a plain T(n1)/n1 estimate is
+    # RTT-dominated for sub-ms ops and under-sizes n2 (the "0.000 ms
+    # op" failure mode)
+    t1 = time.time(); float(f(n1, ones, *args)); t1 = time.time() - t1
+    t5 = time.time(); float(f(5 * n1, ones, *args)); t5 = time.time() - t5
+    per_it = max((t5 - t1) / (4 * n1), 2e-5)
+    n2 = max(n2, n1 + max(500, int(0.8 / per_it)))
+    n2 = min(n2, n1 + 20000)
     ts = []
     for n in (n1, n2):
         best = None
@@ -98,7 +123,11 @@ def main():
     ap.add_argument("--n1", type=int, default=10)
     ap.add_argument("--n2", type=int, default=40)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--only", default="",
+                    help="comma-separated substring filter on shape names")
+    ap.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
     args = ap.parse_args()
+    only = [s for s in args.only.split(",") if s]
     dt_ = jnp.dtype(args.dtype)
     bs = args.bs
 
@@ -110,11 +139,19 @@ def main():
           f"{'dgrad ms':>8s} {'TF/s':>6s} | {'wgrad ms':>8s} {'TF/s':>6s} | "
           f"{'GB(min)':>7s} {'AI':>5s}")
     for name, k, s, cin, cout, hw, cnt in resnet50_convs(bs):
-        f = conv_fn(k, s)
+        if only and not any(p in name for p in only):
+            continue
+        f = conv_fn(k, s, args.layout)
         hw_out = hw // s
-        x = jnp.asarray(rng.rand(bs, cin, hw, hw) - 0.5, dt_)
+        if args.layout == "NHWC":
+            x = jnp.asarray(rng.rand(bs, hw, hw, cin) - 0.5, dt_)
+            y = jnp.asarray(rng.rand(bs, hw_out, hw_out, cout) - 0.5,
+                            dt_)
+        else:
+            x = jnp.asarray(rng.rand(bs, cin, hw, hw) - 0.5, dt_)
+            y = jnp.asarray(rng.rand(bs, cout, hw_out, hw_out) - 0.5,
+                            dt_)
         w = jnp.asarray(rng.rand(cout, cin, k, k) - 0.5, dt_)
-        y = jnp.asarray(rng.rand(bs, cout, hw_out, hw_out) - 0.5, dt_)
         flops = 2 * bs * hw_out * hw_out * cin * cout * k * k
 
         def dgrad(dy, ww):

@@ -47,31 +47,27 @@ def main():
     # batch-1 latency (interactive serving).  prefill='batched' runs the
     # prompt as ONE causal forward, then N-1 scan decode steps; the timed
     # wall covers prefill + decode, so ms_per_token = wall / N is the
-    # honest serving latency per emitted token.
+    # honest serving latency per emitted token.  Four variants: the
+    # per-op scan step vs the fused one-kernel-per-token Pallas step
+    # (ops/decode_fused.py, VERDICT r4 item 2), each bf16 and int8.
     p1 = prompt[:1]
-    kv_generate(net, p1, max_new_tokens=N, temperature=0.0)  # compile
-    t0 = time.perf_counter()
-    kv_generate(net, p1, max_new_tokens=N, temperature=0.0)
-    dt = time.perf_counter() - t0
-    print(json.dumps({"bench": "decode", "mode": "kv_cache_batch1",
-                      "new_tokens_per_sec": round(N / dt, 1),
-                      "ms_per_token": round(dt / N * 1e3, 3),
-                      "batch": 1, "new_tokens": N, "prompt": P,
-                      "platform": platform}))
-    sys.stdout.flush()
-
-    # int8 weight streaming (batch-1 is weight-bound: half the HBM bytes)
-    kv_generate(net, p1, max_new_tokens=N, temperature=0.0,
-                weights="int8")  # compile
-    t0 = time.perf_counter()
-    kv_generate(net, p1, max_new_tokens=N, temperature=0.0, weights="int8")
-    dt = time.perf_counter() - t0
-    print(json.dumps({"bench": "decode", "mode": "kv_cache_batch1_int8",
-                      "new_tokens_per_sec": round(N / dt, 1),
-                      "ms_per_token": round(dt / N * 1e3, 3),
-                      "batch": 1, "new_tokens": N, "prompt": P,
-                      "platform": platform}))
-    sys.stdout.flush()
+    for wmode in ("native", "int8"):
+        for fmode in ("off", "auto"):
+            kw = dict(max_new_tokens=N, temperature=0.0, weights=wmode,
+                      fused=fmode)
+            kv_generate(net, p1, **kw)  # compile
+            t0 = time.perf_counter()
+            kv_generate(net, p1, **kw)
+            dt = time.perf_counter() - t0
+            tag = "kv_cache_batch1" + \
+                ("_int8" if wmode == "int8" else "") + \
+                ("_fused" if fmode == "auto" else "")
+            print(json.dumps({"bench": "decode", "mode": tag,
+                              "new_tokens_per_sec": round(N / dt, 1),
+                              "ms_per_token": round(dt / N * 1e3, 3),
+                              "batch": 1, "new_tokens": N, "prompt": P,
+                              "platform": platform}))
+            sys.stdout.flush()
 
     # full-recompute path (the reference-style loop); fewer tokens — it
     # retraces per length and does O(L^2) work

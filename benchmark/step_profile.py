@@ -82,9 +82,79 @@ def build_bert(bs):
     return trainer, x, y
 
 
+def build_gpt(bs):
+    """BASELINE config 5: GPT-2 774M (36L/1280U/20H/5120FF, seq 512) —
+    same geometry as benchmark/transformer_bench.py."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models import GPT, GPTConfig
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    mx.random.seed(0)
+    cfg = GPTConfig(vocab_size=50304, max_length=512, num_layers=36,
+                    units=1280, num_heads=20, hidden_size=5120,
+                    dtype="bfloat16") if on_tpu else \
+        GPTConfig(vocab_size=512, max_length=64, num_layers=2, units=64,
+                  num_heads=4, hidden_size=128)
+    gpt = GPT(cfg)
+    gpt.initialize(mx.init.Normal(0.02))
+    trainer = parallel.SPMDTrainer(
+        gpt, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-4},
+        mesh=parallel.make_mesh({"dp": len(jax.devices())}))
+    rng = onp.random.RandomState(0)
+    L = 512 if on_tpu else 16
+    toks = rng.randint(0, cfg.vocab_size, (bs, L + 1))
+    return trainer, mx.nd.array(toks[:, :-1]), mx.nd.array(toks[:, 1:])
+
+
+def build_transformer(bs):
+    """BASELINE config 4: Transformer-big seq2seq (1024U/4096FF/16H,
+    6+6 layers, seq 256)."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models import TransformerSeq2Seq as Transformer
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    V, L = (32768, 256) if on_tpu else (512, 16)
+    mx.random.seed(0)
+    net = Transformer(V, units=1024 if on_tpu else 64,
+                      hidden_size=4096 if on_tpu else 128,
+                      num_heads=16 if on_tpu else 4,
+                      num_enc_layers=6 if on_tpu else 2,
+                      num_dec_layers=6 if on_tpu else 2,
+                      max_length=L, dropout=0.0,
+                      dtype="bfloat16" if on_tpu else "float32")
+    net.initialize(mx.init.Xavier())
+
+    class _Wrap(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.net = net
+
+        def forward(self, both):
+            return self.net(both[:, 0], both[:, 1])
+
+    wrap = _Wrap()
+    rng = onp.random.RandomState(0)
+    src = rng.randint(0, V, (bs, L))
+    tgt = rng.randint(0, V, (bs, L))
+    both = onp.stack([src, tgt], axis=1)
+    trainer = parallel.SPMDTrainer(
+        wrap, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-4},
+        mesh=parallel.make_mesh({"dp": len(jax.devices())}))
+    return trainer, mx.nd.array(both), mx.nd.array(tgt)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("model", choices=["resnet", "bert"])
+    ap.add_argument("model", choices=["resnet", "bert", "gpt",
+                                      "transformer"])
     ap.add_argument("--bs", type=int, default=0)
     ap.add_argument("--by", default="tf_op",
                     choices=["tf_op", "name", "category", "source"])
@@ -96,9 +166,11 @@ def main():
 
     from mxnet_tpu import profiler_xla
 
-    bs = args.bs or (256 if args.model == "resnet" else 64)
-    trainer, x, y = (build_resnet if args.model == "resnet" else
-                     build_bert)(bs)
+    bs = args.bs or {"resnet": 256, "bert": 64, "gpt": 4,
+                     "transformer": 32}[args.model]
+    trainer, x, y = {"resnet": build_resnet, "bert": build_bert,
+                     "gpt": build_gpt,
+                     "transformer": build_transformer}[args.model](bs)
 
     def run():
         return trainer.step(x, y)

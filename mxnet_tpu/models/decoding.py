@@ -83,7 +83,8 @@ def _quantize_head(w, bias=None):
 
 
 def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
-                top_k=0, seed=0, prefill="batched", weights="native"):
+                top_k=0, seed=0, prefill="batched", weights="native",
+                fused="auto"):
     """Sample ``max_new_tokens`` continuations for a (B, P) prompt.
 
     Greedy when ``temperature == 0``; ``top_k > 0`` restricts the sample
@@ -107,6 +108,16 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     weight error); measured r4: the decode step is sequencer-bound at
     GPT-2-small size, so int8's byte savings pay off only on larger
     models (BASELINE.md decode section).
+
+    ``fused``: ``"auto"`` (default) runs the decode scan step through
+    the ONE-kernel-per-token Pallas path (ops/decode_fused.py — the
+    r4-measured ~230-op sequencer overhead collapses to ~10 ops) when
+    the model qualifies (GPT family, bf16, batch <= 4, tileable dims,
+    native weights, TPU backend); ``"on"`` requires it (raises if
+    unsupported); ``"off"`` keeps the per-op XLA scan step.  Hidden
+    states can differ from the unfused path by ~1 bf16 ulp (chunked
+    f32 accumulation order in fc2) — greedy token parity is asserted
+    in tests on the covered model sizes.
     """
     cfg = model._cfg
     H = cfg.num_heads
@@ -146,8 +157,71 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     scale = 1.0 / (D ** 0.5)
     head = getattr(model, "head", None) or getattr(model, "lm_head", None)
 
+    # -- fused one-kernel-per-token path (ops/decode_fused.py) --------- #
+    use_fused = False
+    act_t = None
+    ln_eps = 1e-5
+    if fused not in ("auto", "on", "off"):
+        raise ValueError(f"fused must be 'auto', 'on' or 'off', "
+                         f"got {fused!r}")
+    if fused != "off":
+        from ..ops.decode_fused import fused_decode_supported
+        if is_llama:
+            ln_eps = float(getattr(model.blocks[0].rms1, "_eps", 1e-6))
+            use_fused = fused_decode_supported(cfg, B, total, cdtype)
+        else:
+            act_t = getattr(model.blocks[0].ffn.fc1.act, "_act_type",
+                            None) \
+                if model.blocks[0].ffn.fc1.act is not None else None
+            ln_eps = float(getattr(model.blocks[0].ln1, "_eps", 1e-5))
+            use_fused = (act_t in (None, "gelu", "relu")
+                         and fused_decode_supported(cfg, B, total,
+                                                    cdtype))
+    if fused == "on" and not use_fused:
+        from ..base import MXNetError
+        raise MXNetError(
+            "fused='on' but the fused decode kernel does not support "
+            "this model/batch/dtype (see ops/decode_fused.py "
+            "fused_decode_supported)")
+    packed = None
+    if use_fused:
+        from ..ops.decode_fused import (pack_gpt_weights,
+                                        pack_llama_weights)
+        fcache = model.__dict__.setdefault("_fused_decode_cache", {})
+        srcs = [use_int8]
+        for blk in model.blocks:
+            if is_llama:
+                lyrs = (blk.attn.q_proj, blk.attn.k_proj,
+                        blk.attn.v_proj, blk.attn.o_proj,
+                        blk.mlp.gate, blk.mlp.up, blk.mlp.down)
+                lnls = (blk.rms1, blk.rms2)
+            else:
+                lyrs = (blk.attn.qkv, blk.attn.proj, blk.ffn.fc1,
+                        blk.ffn.fc2)
+                lnls = (blk.ln1, blk.ln2)
+            for lyr in lyrs:
+                srcs.append(lyr.weight.data()._data)
+                if getattr(lyr, "bias", None) is not None:
+                    srcs.append(lyr.bias.data()._data)
+            for lnl in lnls:
+                srcs.append(lnl.gamma.data()._data)
+                if getattr(lnl, "beta", None) is not None:
+                    srcs.append(lnl.beta.data()._data)
+        cached = fcache.get("srcs")
+        if cached is None or len(cached) != len(srcs) or \
+                not all(a is b for a, b in zip(cached, srcs)):
+            # pinned-source invalidation discipline shared with the q8
+            # cache above: train steps rebind arrays -> repack
+            fcache["srcs"] = srcs
+            fcache["val"] = (
+                pack_llama_weights(model.blocks, cfg, cdtype,
+                                   quant=use_int8) if is_llama
+                else pack_gpt_weights(model.blocks, cdtype,
+                                      quant=use_int8))
+        packed = fcache["val"]
+
     cache_key = (B, P, max_new_tokens, float(temperature), int(top_k),
-                 str(cdtype), prefill, weights)
+                 str(cdtype), prefill, weights, use_fused)
     cache = model.__dict__.setdefault("_kv_decode_cache", {})
 
     # -- int8 weight streaming: quantize the decode matmul weights ------ #
@@ -313,6 +387,31 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
             logits = (x @ w.T).astype(jnp.float32)
         return logits, ck, cv
 
+    def fused_token(x_tok, pos, ck, cv, packed_t, q8=None):
+        """one_token's fused twin: embeddings and head stay XLA ops;
+        every transformer layer runs inside ONE Pallas kernel
+        (ops/decode_fused.py decode_step).  In int8 mode the layer
+        stream is int8 codes and the head goes through q8_matvec, same
+        as the unfused q8 path."""
+        from ..ops.decode_fused import decode_step
+
+        x = _call(model.wte, x_tok)
+        if not is_llama:
+            x = x + _call(model.wpe, jnp.broadcast_to(pos, (B,)))
+        x, ck, cv = decode_step(pos, x, packed_t, ck, cv, cfg,
+                                act_t, ln_eps)
+        xl = _call(model.ln_f, x)
+        if q8 is not None:
+            from ..ops.q8_matvec import q8_matvec
+            hwq, hs, hb = q8["head"]
+            logits = q8_matvec(xl, hwq, hs, hb)[:, :head_vocab]
+        elif head is not None:
+            logits = _call(head, xl).astype(jnp.float32)
+        else:
+            w = model.wte.weight.data()._data
+            logits = (xl @ w.T).astype(jnp.float32)
+        return logits, ck, cv
+
     def prefill_batch(prompt_dev, ck, cv):
         """One causal forward over the whole (B, P) prompt: fills cache
         positions [0, P) and returns the position-P-1 logits.  Exact same
@@ -375,7 +474,7 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
         from ..gluon.parameter import params_swapped
 
         if prefill == "batched":
-            def run(param_vals, q8, prompt_dev, key0):
+            def run(param_vals, q8, packed_t, prompt_dev, key0):
                 with params_swapped(params, param_vals):
                     ck = jnp.zeros((NL, B, KV, total, D), cdtype)
                     cv = jnp.zeros((NL, B, KV, total, D), cdtype)
@@ -384,7 +483,10 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
 
                     def scan_body(carry, t):
                         tok, ck, cv = carry
-                        logits, ck, cv = one_token(tok, t, ck, cv, q8)
+                        logits, ck, cv = (
+                            fused_token(tok, t, ck, cv, packed_t, q8)
+                            if use_fused
+                            else one_token(tok, t, ck, cv, q8))
                         nxt = _sample(logits, t, key0)
                         return (nxt, ck, cv), nxt
 
@@ -393,7 +495,7 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                         jnp.arange(P, total - 1))
                     return jnp.concatenate([first[None], toks])  # (N, B)
         else:
-            def run(param_vals, q8, prompt_dev, key0):
+            def run(param_vals, q8, packed_t, prompt_dev, key0):
                 with params_swapped(params, param_vals):
 
                     def scan_body(carry, t):
@@ -402,7 +504,10 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                         cur = jnp.where(t < P,
                                         prompt_dev[:, jnp.minimum(t, P - 1)],
                                         tok)
-                        logits, ck, cv = one_token(cur, t, ck, cv, q8)
+                        logits, ck, cv = (
+                            fused_token(cur, t, ck, cv, packed_t, q8)
+                            if use_fused
+                            else one_token(cur, t, ck, cv, q8))
                         nxt = _sample(logits, t, key0)
                         return (nxt, ck, cv), nxt
 
@@ -417,5 +522,6 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
         cache[cache_key] = jax.jit(run)
 
     new = onp.asarray(cache[cache_key](
-        param_vals, q8v, jnp.asarray(prompt), jax.random.PRNGKey(seed))).T
+        param_vals, q8v, packed, jnp.asarray(prompt),
+        jax.random.PRNGKey(seed))).T
     return onp.concatenate([prompt, new], axis=1)

@@ -1,0 +1,154 @@
+"""Fused decode step (ops/decode_fused.py): the one-kernel-per-token
+path must reproduce the per-op scan step — greedy token parity on the
+default batched-prefill path, exact K/V cache writes, gating rules.
+Interpret mode on CPU; the perf claims live in benchmark/decode_bench.py
+and BASELINE.md (VERDICT r4 item 2)."""
+import os
+
+import numpy as onp
+import pytest
+
+os.environ.setdefault("MXNET_FLASH_INTERPRET", "1")
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    # per-test (not module-level): other modules delete this env var in
+    # their teardown, and _interpret() reads it at call time
+    monkeypatch.setenv("MXNET_FLASH_INTERPRET", "1")
+
+
+import jax.numpy as jnp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _model(units=128, heads=4, hidden=512, layers=2, init=0.15):
+    from mxnet_tpu.models import GPT, GPTConfig
+    mx.random.seed(0)
+    net = GPT(GPTConfig(vocab_size=97, max_length=64, num_layers=layers,
+                        units=units, num_heads=heads, hidden_size=hidden))
+    # sharper-than-default init: an untrained near-flat logit field makes
+    # greedy argmax a coin flip at 1-ulp hidden-state noise, which is
+    # rounding-order sensitivity, not decoder behavior
+    net.initialize(mx.init.Normal(init))
+    net.cast("bfloat16")
+    return net
+
+
+class TestFusedDecode:
+    def test_greedy_parity_batched_prefill(self):
+        from mxnet_tpu.models import kv_generate
+        net = _model()
+        for seed, (b, p) in [(0, (1, 5)), (1, (2, 7))]:
+            prompt = onp.random.RandomState(seed).randint(0, 97, (b, p))
+            ref = kv_generate(net, prompt, max_new_tokens=10,
+                              temperature=0.0, fused="off")
+            out = kv_generate(net, prompt, max_new_tokens=10,
+                              temperature=0.0, fused="on")
+            onp.testing.assert_array_equal(out, ref)
+
+    def test_scan_prefill_single_step_parity(self):
+        """Per-step parity through a fused teacher-forced history (the
+        scan-prefill mode): the next sampled token must match across
+        many prompts.  Long scan streams may legitimately flip rare
+        near-ties (1-ulp chunked-accumulation noise, same class as an
+        XLA tiling change) — that is asserted NOT to happen in a single
+        step."""
+        from mxnet_tpu.models import kv_generate
+        net = _model()
+        for s in range(6):
+            prompt = onp.random.RandomState(s).randint(0, 97, (1, 6))
+            ref = kv_generate(net, prompt, max_new_tokens=1,
+                              temperature=0.0, prefill="scan",
+                              fused="off")
+            out = kv_generate(net, prompt, max_new_tokens=1,
+                              temperature=0.0, prefill="scan",
+                              fused="on")
+            onp.testing.assert_array_equal(out, ref)
+
+    def test_int8_fused_matches_int8_unfused(self):
+        """int8 fused stream vs the per-op q8_matvec path: identical
+        quantized weights, so greedy tokens must match (VERDICT r4
+        item 2: int8 re-measured through the fused kernel)."""
+        from mxnet_tpu.models import kv_generate
+        net = _model()
+        prompt = onp.random.RandomState(4).randint(0, 97, (1, 5))
+        ref = kv_generate(net, prompt, max_new_tokens=8,
+                          temperature=0.0, weights="int8", fused="off")
+        out = kv_generate(net, prompt, max_new_tokens=8,
+                          temperature=0.0, weights="int8", fused="on")
+        onp.testing.assert_array_equal(out, ref)
+
+    def test_llama_gqa_parity_native_and_int8(self):
+        """Llama family through the fused kernel: RMSNorm, lane-rolled
+        RoPE, grouped-query attention (KV < H), SwiGLU — greedy tokens
+        must match the per-op path in both weight modes."""
+        from mxnet_tpu.models import Llama, LlamaConfig, kv_generate
+        mx.random.seed(0)
+        cfg = LlamaConfig(vocab_size=97, max_length=64, num_layers=2,
+                          units=128, num_heads=4, num_kv_heads=2,
+                          hidden_size=256)
+        net = Llama(cfg)
+        net.initialize(mx.init.Normal(0.15))
+        net.cast("bfloat16")
+        prompt = onp.random.RandomState(0).randint(0, 97, (1, 5))
+        ref = kv_generate(net, prompt, max_new_tokens=10,
+                          temperature=0.0, fused="off")
+        out = kv_generate(net, prompt, max_new_tokens=10,
+                          temperature=0.0, fused="on")
+        onp.testing.assert_array_equal(out, ref)
+        r8 = kv_generate(net, prompt, max_new_tokens=8, temperature=0.0,
+                         weights="int8", fused="off")
+        o8 = kv_generate(net, prompt, max_new_tokens=8, temperature=0.0,
+                         weights="int8", fused="on")
+        onp.testing.assert_array_equal(o8, r8)
+
+    def test_sampled_mode_deterministic(self):
+        from mxnet_tpu.models import kv_generate
+        net = _model()
+        prompt = onp.random.RandomState(2).randint(0, 97, (1, 4))
+        a = kv_generate(net, prompt, max_new_tokens=6, temperature=0.9,
+                        top_k=8, seed=5, fused="on")
+        b = kv_generate(net, prompt, max_new_tokens=6, temperature=0.9,
+                        top_k=8, seed=5, fused="on")
+        onp.testing.assert_array_equal(a, b)
+        assert ((0 <= a) & (a < 97)).all()
+
+    def test_fused_on_raises_when_unsupported(self):
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.models import kv_generate
+        net = _model()
+        net.cast("float32")  # kernel is bf16-only
+        prompt = onp.zeros((1, 4), onp.int32)
+        with pytest.raises(MXNetError, match="fused"):
+            kv_generate(net, prompt, max_new_tokens=2, temperature=0.0,
+                        fused="on")
+
+    def test_weight_update_invalidates_pack(self):
+        """The packed stream must repack after a weight rebind (the
+        pinned-source discipline shared with the q8 cache)."""
+        from mxnet_tpu.models import kv_generate
+        net = _model()
+        prompt = onp.random.RandomState(3).randint(0, 97, (1, 4))
+        out1 = kv_generate(net, prompt, max_new_tokens=4,
+                           temperature=0.0, fused="on")
+        # rebind one weight: decodes must change and match the unfused
+        # path run after the same edit
+        w = net.blocks[0].attn.qkv.weight
+        w.set_data(mx.nd.from_jax(-w.data()._data))
+        out2 = kv_generate(net, prompt, max_new_tokens=4,
+                           temperature=0.0, fused="on")
+        ref2 = kv_generate(net, prompt, max_new_tokens=4,
+                           temperature=0.0, fused="off")
+        onp.testing.assert_array_equal(out2, ref2)
+        assert (out1 != out2).any()
+
+    def test_supported_gate(self):
+        from mxnet_tpu.models import GPTConfig
+        from mxnet_tpu.ops.decode_fused import fused_decode_supported
+        cfg = GPTConfig(vocab_size=97, max_length=64, num_layers=2,
+                        units=128, num_heads=4, hidden_size=512)
+        assert fused_decode_supported(cfg, 1, 32, jnp.bfloat16)
+        assert not fused_decode_supported(cfg, 8, 32, jnp.bfloat16)
+        assert not fused_decode_supported(cfg, 1, 32, jnp.float32)
