@@ -224,6 +224,23 @@ def main():
                       "component": "throughput",
                       "tokens_per_sec": round(tokens / best, 1),
                       "platform": platform}))
+
+    # 7. optimizer-apply phase on the IMPERATIVE Trainer path: the fused
+    # multi-tensor apply issues O(#groups) jitted dispatches per step vs
+    # the legacy O(#params) loop — both timed on the same BERT param set
+    # with synthetic grads (the phase under test is the apply itself;
+    # measurement methodology shared with step_profile)
+    from benchmark.step_profile import measure_optimizer_apply
+    n, rows = measure_optimizer_apply(net.collect_params(), "adamw")
+    for mode, disp, dt in rows:
+        print(json.dumps({
+            "bench": "step_breakdown",
+            "component": f"optimizer_apply_{mode}",
+            "ms": round(dt, 3),
+            "params": n,
+            "apply_dispatches_per_step": round(disp),
+            "platform": platform}))
+        sys.stdout.flush()
     return 0
 
 

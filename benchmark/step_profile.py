@@ -151,6 +151,67 @@ def build_transformer(bs):
     return trainer, mx.nd.array(both), mx.nd.array(tgt)
 
 
+def measure_optimizer_apply(params, opt_name, reps=10):
+    """Fused-vs-legacy optimizer-apply phase over a ParameterDict (the
+    imperative ``gluon.Trainer`` path): synthesizes grads, times ``reps``
+    steady-state steps per mode, and counts optimizer-apply dispatches.
+    Returns ``(n_params, [(mode, dispatches_per_step, ms_per_step)])``.
+    One implementation shared by step_profile and step_breakdown so the
+    two benchmarks can't drift on methodology."""
+    import time
+
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.ndarray.ndarray import waitall
+    from mxnet_tpu.optimizer import optimizer as opt_impl
+
+    live = [p for p in params.values() if p.grad_req != "null"]
+    rng = onp.random.RandomState(0)
+    for p in live:
+        p.grad()._rebind(jnp.asarray(rng.randn(*p.shape) * 1e-3,
+                                     p.data()._data.dtype))
+    prev = os.environ.get("MXNET_FUSED_OPTIMIZER")
+    rows = []
+    try:
+        for mode, env in (("fused", "1"), ("legacy", "0")):
+            os.environ["MXNET_FUSED_OPTIMIZER"] = env
+            tr = gluon.Trainer(params, opt_name,
+                               {"learning_rate": 1e-4}, kvstore=None)
+            tr.step(1)          # compile + state creation
+            waitall()
+            opt_impl.reset_apply_counters()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                tr.step(1)
+            waitall()
+            dt = (time.perf_counter() - t0) / reps * 1e3
+            c = opt_impl.apply_counters
+            disp = (c["fused_calls"] + c["fallback_params"]) / reps
+            rows.append((mode, disp, dt))
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_FUSED_OPTIMIZER", None)
+        else:
+            os.environ["MXNET_FUSED_OPTIMIZER"] = prev
+    return len(live), rows
+
+
+def profile_optimizer_apply(trainer, iters=10):
+    """Optimizer-apply phase row for the IMPERATIVE Trainer path (the
+    API-parity path the SPMD profile above doesn't cover): the fused
+    multi-tensor apply collapses the per-step host->device dispatch count
+    from O(#params) to O(#groups) — this prints both counts and ms/step
+    so the collapse is measurable per model."""
+    n, rows = measure_optimizer_apply(
+        trainer._block.collect_params(),
+        type(trainer.optimizer).__name__.lower(), reps=iters)
+    print(f"\noptimizer-apply phase (imperative Trainer, {n} params):")
+    for mode, disp, dt in rows:
+        print(f"  {mode:7s}: {disp:6.0f} optimizer-apply dispatches/step   "
+              f"{dt:8.2f} ms/step")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("model", choices=["resnet", "bert", "gpt",
@@ -160,6 +221,8 @@ def main():
                     choices=["tf_op", "name", "category", "source"])
     ap.add_argument("--limit", type=int, default=40)
     ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--no-opt-phase", action="store_true",
+                    help="skip the imperative optimizer-apply phase row")
     args = ap.parse_args()
 
     import jax
@@ -203,6 +266,8 @@ def main():
           f"({100 * tot_fl / tot_us / 1e6 / PEAK_TFLOPS:.1f}% MFU)\n")
     print(profiler_xla.format_table(rows, peak_tflops=PEAK_TFLOPS,
                                     limit=args.limit))
+    if not args.no_opt_phase:
+        profile_optimizer_apply(trainer)
     return 0
 
 

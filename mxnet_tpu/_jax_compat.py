@@ -1,0 +1,42 @@
+"""Shims over jax API renames so one codebase spans jax 0.4.x ↔ 0.6+.
+
+Every rename is detected ONCE here; callers import the resolved symbol
+instead of re-probing (the next jax rename is a one-file fix):
+
+- ``shard_map``: top-level ``jax.shard_map`` (>= 0.6) vs
+  ``jax.experimental.shard_map.shard_map`` (0.4.x) — same signature.
+- ``NO_CHECK``: kwargs disabling shard_map's static replication checker
+  (``check_vma=False`` >= 0.6, ``check_rep=False`` 0.4.x).  On 0.4.x the
+  checker also predates the ``pvary``/``pcast`` varying marks, so code
+  relying on those must pass NO_CHECK unconditionally there.
+- ``typeof``: ``jax.typeof`` (>= 0.6) vs ``jax.core.get_aval`` — the
+  abstract value (shape/dtype) of an array.
+- ``compiler_params``: ``pltpu.CompilerParams`` (>= 0.6) vs
+  ``pltpu.TPUCompilerParams`` — same fields, renamed class.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+NO_CHECK = {"check_vma": False} \
+    if "check_vma" in inspect.signature(shard_map).parameters \
+    else {"check_rep": False}
+
+
+def typeof(x):
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    return jax.core.get_aval(x)
+
+
+def compiler_params(pltpu, **kw):
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)

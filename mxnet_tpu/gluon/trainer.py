@@ -151,14 +151,20 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            if self._update_on_kvstore:
-                # push grad; server-side optimizer updates weight; pull it
-                self._kvstore.push(i, p.list_grad())
-                self._kvstore.pull(i, p.list_data())
-            else:
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not live:
+            return
+        if self._update_on_kvstore:
+            # push ALL grads in one wave: the store's server-side
+            # optimizer applies them as one fused multi_update (one
+            # jitted call per group instead of one per parameter), then
+            # pull the updated weights back
+            keys = [i for i, _ in live]
+            self._kvstore.push(keys, [p.list_grad() for _, p in live])
+            self._kvstore.pull(keys, [p.list_data() for _, p in live])
+        else:
+            for i, p in live:
                 self._kvstore.pushpull(i, p.list_grad(), out=p.list_grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
@@ -173,6 +179,11 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore:
             return  # the push already applied the optimizer server-side
+        # one fused multi-tensor apply over all live params: O(#groups)
+        # jitted dispatches per step instead of O(#params) — the
+        # reference's multi_sgd_update/aggregation path (the legacy
+        # per-param loop is reachable via MXNET_FUSED_OPTIMIZER=0)
+        idxs, ws, gs, ss = [], [], [], []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -184,11 +195,18 @@ class Trainer:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, p.data())
                 self._states_created[i] = True
-            self._states[i] = self._optimizer.update_multi_precision(
-                i, p.data(), p.grad(), self._states[i])
+            idxs.append(i)
+            ws.append(p.data())
+            gs.append(p.grad())
+            ss.append(self._states[i])
+        if not idxs:
+            return
+        new_states = self._optimizer.multi_update(idxs, ws, gs, ss)
+        for i, ns in zip(idxs, new_states):
+            self._states[i] = ns
             # broadcast updated weights to the other replicas (the
             # reference's kvstore weight pull after the server update)
-            p._sync_replicas()
+            self._params[i]._sync_replicas()
 
     # -- state checkpointing (SURVEY.md §5.4 d) --------------------------- #
     def save_states(self, fname):

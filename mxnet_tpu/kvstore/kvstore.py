@@ -190,6 +190,27 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
+            if self._optimizer is not None:
+                # optimizer-on-server, whole push wave at once: merge
+                # every key's grads, then ONE fused multi-tensor apply
+                # (O(#groups) jitted dispatches — the server-side analog
+                # of the reference's aggregated multi_sgd_update)
+                keys = [str(k) for k in key]
+                for k in keys:
+                    if k not in self._store:
+                        raise MXNetError(f"kvstore key {k} not initialized")
+                # local merge per key, then ONE flat cross-process
+                # AllReduce per dtype for the whole wave (bucketing —
+                # same wire coalescing the pure-allreduce pushpull uses)
+                merged = [NDArray(m) for m in self._reduce_bucketed(
+                    keys, [self._merge_local(v, k)
+                           for k, v in zip(keys, value)])]
+                new_states = self._optimizer.multi_update(
+                    keys, [self._store[k] for k in keys], merged,
+                    [self._opt_states[k] for k in keys])
+                for k, ns in zip(keys, new_states):
+                    self._opt_states[k] = ns
+                return
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
@@ -200,8 +221,8 @@ class KVStore:
         if self._optimizer is not None:
             # optimizer-on-server semantics (KVStoreDistServer)
             w = self._store[key]
-            self._opt_states[key] = self._optimizer.update_multi_precision(
-                key, w, NDArray(merged), self._opt_states[key])
+            self._opt_states[key] = self._optimizer.multi_update(
+                [key], [w], [NDArray(merged)], [self._opt_states[key]])[0]
         elif self._updater is not None:
             self._updater(key, NDArray(merged), self._store[key])
         else:

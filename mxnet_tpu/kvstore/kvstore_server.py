@@ -8,6 +8,12 @@ so the reference's worker/server/scheduler roles map onto the single
 ``jax.distributed`` process group.  This module keeps the reference's import
 surface and launch protocol working:
 
+The optimizer-on-server update itself lives in ``KVStore.push``
+(``kvstore.py``): a whole push wave applies as ONE fused
+``Optimizer.multi_update`` per parameter group — the TPU analog of the
+reference server's aggregated ``multi_sgd_update`` batching
+(``MXNET_FUSED_OPTIMIZER=0`` restores the per-key loop).
+
 - ``DMLC_ROLE=worker`` (or unset): no-op, training proceeds.
 - ``DMLC_ROLE=server`` / ``scheduler``: the process joins the
   ``jax.distributed`` group (so barriers and coordination work for code

@@ -170,6 +170,9 @@ class Module(BaseModule):
     def update(self):
         if self._optimizer is None:
             raise MXNetError("update: init_optimizer first")
+        # fused multi-tensor apply: every parameter in one (or a few,
+        # grouped) jitted dispatches — see Optimizer.multi_update
+        idxs, ws, gs, ss = [], [], [], []
         for i, n in enumerate(self._param_names):
             w = self._exec.arg_dict[n]
             g = w.grad
@@ -177,7 +180,15 @@ class Module(BaseModule):
                 continue
             if i not in self._opt_states:
                 self._opt_states[i] = self._optimizer.create_state(i, w)
-            self._optimizer.update(i, w, g, self._opt_states[i])
+            idxs.append(i)
+            ws.append(w)
+            gs.append(g)
+            ss.append(self._opt_states[i])
+        if not idxs:
+            return
+        new_states = self._optimizer.multi_update(idxs, ws, gs, ss)
+        for i, ns in zip(idxs, new_states):
+            self._opt_states[i] = ns
 
     def get_outputs(self, merge_multi_context=True):
         return list(self._exec.outputs)

@@ -16,6 +16,7 @@ the reference NDArray's ``autograd_entry_``.
 """
 from __future__ import annotations
 
+import math
 import weakref
 from typing import Optional
 
@@ -491,15 +492,24 @@ class NDArray:
         # _mul_scalar/_div_scalar FComputeEx on row_sparse/csr): only
         # the stored values scale, the pattern — and the dense mirror's
         # memory — is never materialized.  Scalar add/sub destroys
-        # sparsity, so those fall through to the dense path.
+        # sparsity, so those fall through to the dense path.  Restricted
+        # to floating dtypes (an int ``a / 2`` or ``a * 0.5`` must
+        # promote like the dense op, not truncate the scale factor to 0)
+        # and nonzero divisors (0/0 = nan on unstored zeros — only the
+        # dense path can represent that).
         if self._sparse_kind and isinstance(other, numeric_types) \
-                and not recording:
+                and not recording \
+                and jnp.issubdtype(jnp.dtype(self.dtype), jnp.floating) \
+                and math.isfinite(float(other)):
+            # non-finite scalars (and zero divisors below) must hit the
+            # dense op: x * inf / x / nan poison the UNSTORED zeros too
+            # (0 * inf = nan), which value-only scaling can't represent
             from . import sparse as _sparse
-            if name == "broadcast_mul" or \
-                    (name == "broadcast_div" and not reverse):
-                v = float(other) if name == "broadcast_mul" \
-                    else 1.0 / float(other)
-                return _sparse._scale(self, v)
+            if name == "broadcast_mul":
+                return _sparse._scale(self, float(other))
+            if name == "broadcast_div" and not reverse \
+                    and float(other) != 0.0:
+                return _sparse._scale(self, 1.0 / float(other))
         a, b = (other, self) if reverse else (self, other)
         a, b = _coerce(a, self), _coerce(b, self)
         spname = _SPARSE_BINOPS.get(name)

@@ -48,6 +48,9 @@ def _interpret() -> bool:
     return os.environ.get("MXNET_FLASH_INTERPRET", "") == "1"
 
 
+from .._jax_compat import compiler_params as _compiler_params
+
+
 def _pallas_backend_ok() -> bool:
     """Shared Pallas backend gate (flash, q8_matvec): interpret mode or a
     real TPU backend."""
@@ -342,7 +345,7 @@ def _pallas_fwd(q, k, v, scale, causal, kmask=None, seed=None, dropout=0.0,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -459,7 +462,7 @@ def _pallas_bwd_dq(q, k, v, g, lse_rep, dlt_rep, scale, causal, kmask=None,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B * H, L, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -611,7 +614,7 @@ def _pallas_bwd_dkv(q, k, v, g, lse_rep, dlt_rep, scale, causal, kmask=None,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -981,7 +984,7 @@ def ring_attention(q, k, v, *, scale: Optional[float] = None,
     """Sequence-parallel attention: inputs sharded over ``axis`` on the seq
     dim; communication is ``ppermute`` around the ring (ICI-neighbor
     traffic only, the canonical long-context pattern)."""
-    from jax import shard_map
+    from .._jax_compat import NO_CHECK, shard_map
     from ..parallel.mesh import default_mesh, local_mesh_axes, P
     from jax.sharding import NamedSharding
 
@@ -999,5 +1002,5 @@ def ring_attention(q, k, v, *, scale: Optional[float] = None,
         mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),
-        check_vma=False)
+        **NO_CHECK)
     return fn(q, k, v)

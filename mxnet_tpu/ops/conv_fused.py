@@ -46,22 +46,25 @@ __all__ = ["conv1x1_nhwc", "fused_bwd_supported"]
 _VMEM_BUDGET = 12 * 1024 * 1024
 
 
-def _pick_tile(p: int, ci: int, co: int) -> int:
+def _pick_tile(p: int, ci: int, co: int, itemsize: int = 2) -> int:
     """Largest P-tile that divides ``p`` and fits the VMEM budget:
     dy tile (Tp, Co) + x/dx tiles (Tp, Ci) double-buffered, plus the
-    resident W (Co, Ci) bf16 and f32 dW accumulator."""
-    fixed = co * ci * (2 + 4)
+    resident W (Co, Ci) and f32 dW accumulator.  ``itemsize`` is the
+    operand dtype's byte width — f32 shapes cost twice the bf16 budget,
+    so the same geometry may need a smaller tile (or none at all)."""
+    fixed = co * ci * (itemsize + 4)
     for tp in (1024, 896, 784, 768, 640, 512, 448, 392, 256, 196, 128,
                112, 64, 56, 32, 16):
         if p % tp:
             continue
-        tiled = 2 * (tp * co * 2) + 4 * (tp * ci * 2)
+        tiled = 2 * (tp * co * itemsize) + 4 * (tp * ci * itemsize)
         if fixed + tiled <= _VMEM_BUDGET:
             return tp
     return 0
 
 
-def fused_bwd_supported(shape_in, w_shape, stride, dilate, groups) -> bool:
+def fused_bwd_supported(shape_in, w_shape, stride, dilate, groups,
+                        itemsize: int = 2) -> bool:
     """True when the fused Pallas backward serves this conv: NHWC 2-D,
     1x1 kernel, unit stride/dilation, ungrouped, and a tile exists."""
     import os
@@ -97,7 +100,7 @@ def fused_bwd_supported(shape_in, w_shape, stride, dilate, groups) -> bool:
     if c != ci:
         return False
     p = n * h * w_
-    return _pick_tile(p, ci, co) > 0
+    return _pick_tile(p, ci, co, itemsize) > 0
 
 
 def _bwd_pair_kernel(dy_ref, x_ref, w_ref, dx_ref, dw_ref):
@@ -188,7 +191,7 @@ def _conv1x1_bwd(res, dy):
     n, h, w_sp, ci = x.shape
     co = w.shape[0]
     p = n * h * w_sp
-    tp = _pick_tile(p, ci, co)
+    tp = _pick_tile(p, ci, co, jnp.dtype(x.dtype).itemsize)
     if tp == 0:  # shape drifted past the gate: XLA fallback
         _, pullback = jax.vjp(_conv1x1_fwd_math, x, w)
         return pullback(dy)

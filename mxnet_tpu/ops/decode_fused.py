@@ -56,7 +56,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import _interpret, _pallas_backend_ok as _on_tpu
+from .attention import (_compiler_params, _interpret,
+                        _pallas_backend_ok as _on_tpu)
 
 __all__ = ["fused_decode_supported", "pack_gpt_weights",
            "pack_llama_weights", "decode_step"]
@@ -67,7 +68,8 @@ _VMEM_BUDGET = 12 * 1024 * 1024
 def _pick_cw(u: int, f: int, kvd: int | None = None) -> int:
     """Chunk width: must tile U (CW | U covers the 3U qkv span too), F,
     and — for GQA — the KV-projection width; bounded so the
-    double-buffered (U, CW) stream block stays within ~4 MB of VMEM."""
+    double-buffered (U, CW) stream block stays within 8 MB of VMEM
+    (the ``2 * u * cw * 2 <= 8 MiB`` check below)."""
     for cw in (1536, 1280, 1024, 896, 768, 640, 512, 384, 256, 128, 64,
                32):
         if u % cw or f % cw:
@@ -563,14 +565,14 @@ def _decode_layers(pos, x, wstream, bstream, sstream, norms, bias2, s2,
             pl.BlockSpec(memory_space=pltpu.VMEM),   # bias2 (NL,U)
             pl.BlockSpec(memory_space=pltpu.VMEM),   # s2 (NL,U)
             pl.BlockSpec(memory_space=pltpu.VMEM),   # rope inv (1,D)
-            pl.BlockSpec(memory_space=pltpu.ANY),    # k cache
-            pl.BlockSpec(memory_space=pltpu.ANY),    # v cache
+            pl.BlockSpec(memory_space=pl.ANY),       # k cache
+            pl.BlockSpec(memory_space=pl.ANY),       # v cache
         ],
         out_specs=[
             pl.BlockSpec((B, U), lambda j, pos: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((B, U), dtype),               # xres
@@ -599,7 +601,7 @@ def _decode_layers(pos, x, wstream, bstream, sstream, norms, bias2, s2,
         # NOTE: no cost_estimate — the axon remote-compile AOT path
         # fails with "Bad lhs type" when one is attached (bisected in
         # ops/conv_fused.py; same toolchain)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu,
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
     )(pos, x, wstream, bstream, sstream, norms, bias2, s2, rope_inv,

@@ -195,7 +195,8 @@ def Convolution(data, weight, bias=None, *, kernel=(), stride=(), dilate=(),
         # the gate re-checks shape/stride/groups and falls back here)
         from .conv_fused import conv1x1_nhwc, fused_bwd_supported
         if fused_bwd_supported(data.shape, weight.shape, stride, dilate,
-                               num_group):
+                               num_group,
+                               itemsize=jnp.dtype(data.dtype).itemsize):
             out = conv1x1_nhwc(data, weight)
             if not no_bias and bias is not None:
                 out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))

@@ -15,6 +15,7 @@ kernels, supplied by XLA fusion instead of hand-written CUDA.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -27,8 +28,27 @@ from ..ndarray.ndarray import NDArray
 __all__ = [
     "Optimizer", "SGD", "NAG", "Adam", "AdamW", "Nadam", "LAMB", "LARS",
     "RMSProp", "AdaGrad", "AdaDelta", "Ftrl", "FTML", "Signum", "SGLD",
-    "register", "create",
+    "register", "create", "apply_counters", "reset_apply_counters",
+    "fused_enabled",
 ]
+
+# Dispatch accounting for the fused multi-tensor apply (read by the
+# dispatch-count regression test and benchmark/step_breakdown.py):
+#   fused_calls      — jitted group-apply invocations (one per group/step)
+#   fused_params     — parameters served by those calls
+#   fallback_params  — parameters that took the legacy per-param path
+apply_counters = {"fused_calls": 0, "fused_params": 0, "fallback_params": 0}
+
+
+def reset_apply_counters():
+    for k in apply_counters:
+        apply_counters[k] = 0
+
+
+def fused_enabled() -> bool:
+    """Escape hatch: ``MXNET_FUSED_OPTIMIZER=0`` restores the legacy
+    per-parameter update loop (read per call so tests can toggle it)."""
+    return os.environ.get("MXNET_FUSED_OPTIMIZER", "1") != "0"
 
 _REGISTRY: dict = {}
 
@@ -50,8 +70,23 @@ def _as_jax(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+def _cast_like(ref, new):
+    """Cast every array leaf of ``new`` back to the dtype of the matching
+    leaf in ``ref`` — keeps the optimizer-state dtype signature stable
+    across steps so one compiled executable (with donated state buffers)
+    serves every step (the same bf16 dtype-preservation discipline
+    ``SPMDTrainer._make_step_fn`` applies)."""
+    return jax.tree.map(
+        lambda a, b: b.astype(a.dtype)
+        if hasattr(a, "dtype") and hasattr(b, "astype") else b, ref, new)
+
+
 class Optimizer:
     """Base optimizer (reference anchor ``class Optimizer``)."""
+
+    # SGLD draws host-side RNG inside its rule; a traced-once executable
+    # would replay the same noise every step, so it opts out of fusion.
+    _fusable = True
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
@@ -152,8 +187,7 @@ class Optimizer:
         ``Optimizer.update``).  Accepts lists for the fused multi-tensor
         surface."""
         if isinstance(index, (list, tuple)):
-            return [self.update(i, w_, g_, s_)
-                    for i, w_, g_, s_ in zip(index, weight, grad, state)]
+            return self.multi_update(index, weight, grad, state)
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -164,17 +198,22 @@ class Optimizer:
         weight._rebind(new_w)
         return new_state
 
+    def _use_mp(self, w, state):
+        """True when the multi-precision (fp32-master) path is active for
+        this (weight, state) pair — the single definition shared by the
+        per-param and fused apply paths."""
+        return (self.multi_precision
+                and w.dtype in (jnp.float16, jnp.bfloat16)
+                and isinstance(state, tuple) and len(state) == 2
+                and getattr(state[0], "dtype", None) == jnp.float32)
+
     def update_multi_precision(self, index, weight, grad, state):
         """fp16/bf16 weights with fp32 master copy (reference anchor
         ``update_multi_precision`` / ``mp_*`` ops)."""
         if isinstance(index, (list, tuple)):
-            return [self.update_multi_precision(i, w_, g_, s_)
-                    for i, w_, g_, s_ in zip(index, weight, grad, state)]
+            return self.multi_update(index, weight, grad, state)
         w = _as_jax(weight)
-        use_mp = self.multi_precision and \
-            w.dtype in (jnp.float16, jnp.bfloat16) and \
-            isinstance(state, tuple) and len(state) == 2 and \
-            getattr(state[0], "dtype", None) == jnp.float32
+        use_mp = self._use_mp(w, state)
         if not use_mp:
             return self.update(index, weight, grad, state)
         master, inner = state
@@ -186,6 +225,134 @@ class Optimizer:
         new_master, new_inner = self._update_rule(master, g, inner, lr, wd, t)
         weight._rebind(new_master.astype(w.dtype))
         return (new_master, new_inner)
+
+    # -- fused multi-tensor apply ------------------------------------------ #
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_fused_cache", None)  # jitted executables don't pickle
+        return state
+
+    def _hyper_key(self):
+        """Scalar hyperparameters the update rules close over (momentum,
+        betas, epsilons, ...) — part of the executable cache key so
+        mutating one retraces instead of replaying a stale closure.
+        Per-step quantities (lr, wd, rescale, clip, step counts) are
+        traced operands and excluded."""
+        skip = {"rescale_grad", "num_update", "begin_num_update", "lr",
+                "wd", "clip_gradient", "aggregate_num"}
+        return tuple(sorted(
+            (k, v) for k, v in self.__dict__.items()
+            if k not in skip and isinstance(v, (bool, int, float, str))))
+
+    def _build_fused_apply(self, use_mp, has_clip):
+        """One pure pytree-level apply for a parameter group, jitted with
+        weight/state buffer donation so the update is in-place at the XLA
+        level.  ``lrs``/``wds``/``ts`` are stacked per-param scalars and
+        ``rescale``/``clip`` traced scalars, so ONE compiled executable
+        serves every step of training."""
+
+        def apply_fn(ws, gs, ss, lrs, wds, ts, rescale, clip):
+            new_ws, new_ss = [], []
+            for i, (w, g, s) in enumerate(zip(ws, gs, ss)):
+                lr, wd, t = lrs[i], wds[i], ts[i]
+                if use_mp:
+                    master, inner = s
+                    g2 = g.astype(jnp.float32) * rescale
+                    if has_clip:
+                        g2 = jnp.clip(g2, -clip, clip)
+                    nm, ni = self._update_rule(master, g2, inner, lr, wd, t)
+                    new_ws.append(nm.astype(w.dtype))
+                    new_ss.append((nm, _cast_like(inner, ni)))
+                else:
+                    # match the legacy per-param dtype discipline: grad is
+                    # cast to the weight dtype BEFORE rescale/clip, and the
+                    # new weight is rounded back (the traced f32 lr/wd
+                    # scalars promote low-precision math to f32 — more
+                    # accurate than the legacy loop, within 1 ulp of it)
+                    g2 = g.astype(w.dtype) * rescale.astype(w.dtype)
+                    if has_clip:
+                        cl = clip.astype(w.dtype)
+                        g2 = jnp.clip(g2, -cl, cl)
+                    nw, ns = self._update_rule(w, g2, s, lr, wd, t)
+                    new_ws.append(nw.astype(w.dtype))
+                    new_ss.append(_cast_like(s, ns))
+            return new_ws, new_ss
+
+        return jax.jit(apply_fn, donate_argnums=(0, 2))
+
+    def multi_update(self, indices, weights, grads, states):
+        """Fused multi-tensor apply (the reference's ``multi_sgd_update``
+        / ``MXNET_OPTIMIZER_AGGREGATION_SIZE`` aggregation): groups the
+        parameters by (multi-precision flag, dtype, sharding) and applies
+        each group in ONE jitted XLA call with donated weight/state
+        buffers, so a ``Trainer.step`` issues O(#groups) dispatches
+        instead of O(#params).
+
+        Weights are updated in place (rebound); returns the new states
+        aligned with ``indices``.  Sparse grads, non-fusable optimizers
+        (SGLD), and ``MXNET_FUSED_OPTIMIZER=0`` fall back to the legacy
+        per-param path — numerics there are bit-identical to before.
+        """
+        n = len(indices)
+        new_states: list = [None] * n
+        fuse = fused_enabled() and self._fusable
+        groups: dict = {}
+        fallback = []
+        for pos in range(n):
+            w, g = weights[pos], grads[pos]
+            if not fuse or getattr(g, "_sparse_kind", False) \
+                    or getattr(w, "_sparse_kind", False):
+                fallback.append(pos)
+                continue
+            wj = _as_jax(w)
+            use_mp = self._use_mp(wj, states[pos])
+            try:
+                shard = str(wj.sharding)
+            except Exception:  # non-jax leaves (plain numpy in tests)
+                shard = None
+            groups.setdefault((use_mp, str(wj.dtype), shard),
+                              []).append(pos)
+        for pos in fallback:
+            new_states[pos] = self.update_multi_precision(
+                indices[pos], weights[pos], grads[pos], states[pos])
+            apply_counters["fallback_params"] += 1
+        if not groups:
+            return new_states
+        has_clip = self.clip_gradient is not None
+        clip = jnp.float32(self.clip_gradient if has_clip else 0.0)
+        rescale = jnp.float32(self.rescale_grad)
+        cache = self.__dict__.setdefault("_fused_cache", {})
+        agg = self.aggregate_num if self.aggregate_num else None
+        for (use_mp, _dt, _sh), poss in groups.items():
+            key = (use_mp, has_clip, self._hyper_key())
+            fn = cache.get(key)
+            if fn is None:
+                fn = self._build_fused_apply(use_mp, has_clip)
+                cache[key] = fn
+            chunks = [poss[i:i + agg] for i in range(0, len(poss), agg)] \
+                if agg else [poss]
+            for chunk in chunks:
+                lrs, wds, ts = [], [], []
+                for pos in chunk:
+                    idx = indices[pos]
+                    self._update_count(idx)
+                    lrs.append(self._get_lr(idx))
+                    wds.append(self._get_wd(idx))
+                    ts.append(self._index_update_count[idx])
+                new_ws, new_ss = fn(
+                    [_as_jax(weights[pos]) for pos in chunk],
+                    [_as_jax(grads[pos]) for pos in chunk],
+                    [states[pos] for pos in chunk],
+                    jnp.asarray(lrs, jnp.float32),
+                    jnp.asarray(wds, jnp.float32),
+                    jnp.asarray(ts, jnp.int32),
+                    rescale, clip)
+                apply_counters["fused_calls"] += 1
+                apply_counters["fused_params"] += len(chunk)
+                for pos, nw, ns in zip(chunk, new_ws, new_ss):
+                    weights[pos]._rebind(nw)
+                    new_states[pos] = ns
+        return new_states
 
 
 @register
@@ -520,7 +687,10 @@ class DCASGD(Optimizer):
     def create_state(self, index, weight):
         w = _as_jax(weight)
         mom = None if self.momentum == 0.0 else jnp.zeros_like(w)
-        return (mom, jnp.asarray(w))  # (momentum, previous weight)
+        # previous weight must be a COPY: asarray would alias the live
+        # weight buffer, and the fused apply donates both the weight and
+        # state operands (double-donating one buffer is an XLA error)
+        return (mom, jnp.array(w))  # (momentum, previous weight)
 
     def _update_rule(self, w, g, state, lr, wd, t):
         mom, prev_w = state
@@ -536,6 +706,10 @@ class DCASGD(Optimizer):
 @register
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (noise-injected SGD)."""
+
+    # the rule draws a fresh host RNG key per call — tracing it once into
+    # a cached executable would replay identical noise every step
+    _fusable = False
 
     def create_state(self, index, weight):
         return None

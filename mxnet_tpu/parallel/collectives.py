@@ -20,9 +20,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding
 
+from .._jax_compat import NO_CHECK as _NO_CHECK, shard_map
 from .mesh import Mesh, P, default_mesh, local_mesh_axes
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
@@ -76,7 +76,7 @@ def all_gather(x, mesh: Optional[Mesh] = None, axis: str = "dp",
     data = jax.device_put(_unwrap(x), NamedSharding(mesh, P(axis)))
     fn = shard_map(
         lambda v: jax.lax.all_gather(v, axis, tiled=tiled),
-        mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
+        mesh=mesh, in_specs=P(axis), out_specs=P(), **_NO_CHECK)
     return _wrap_like(fn(data), x)
 
 

@@ -1,0 +1,297 @@
+"""Fused multi-tensor optimizer apply (Optimizer.multi_update).
+
+Parity: the fused per-group jitted apply must match the legacy per-param
+loop (reachable via MXNET_FUSED_OPTIMIZER=0) across the whole optimizer
+registry — including multi-precision bf16+fp32-master, per-param
+lr_mult/wd_mult asymmetry, clip_gradient, and the sparse-grad fallback.
+f32 math is identical up to the f32-vs-f64 rounding of scalar
+precomputations (e.g. beta**t), so comparisons use tight allclose rather
+than bit equality; raw bf16 params additionally see the traced-f32
+lr promotion (documented in Optimizer._build_fused_apply) and get a
+bf16-scale tolerance.
+
+Dispatch-count regression: a >=50-parameter Trainer.step must issue
+O(#groups) jitted apply calls, not O(#params).
+"""
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import optimizer as opt_mod
+from mxnet_tpu.gluon import Parameter
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.ndarray import sparse as sp
+from mxnet_tpu.optimizer.optimizer import (_REGISTRY, apply_counters,
+                                           reset_apply_counters)
+
+SHAPES = [(4, 5), (7,), (2, 3, 4)]
+
+MOMENTUM_OPTS = {"sgd", "nag", "signum", "dcasgd", "lars"}
+
+
+def _mk(name, **extra):
+    kw = {"learning_rate": 0.05, "wd": 0.01, "rescale_grad": 0.5}
+    if name in MOMENTUM_OPTS:
+        kw["momentum"] = 0.9
+    kw.update(extra)
+    return opt_mod.create(name, **kw)
+
+
+def _mk_tensors(dtype=onp.float32, seed=0, shapes=SHAPES):
+    rng = onp.random.RandomState(seed)
+    wnp = [rng.randn(*s).astype(dtype) for s in shapes]
+    gnp = [rng.randn(*s).astype(dtype) for s in shapes]
+    return wnp, gnp
+
+
+def _run_steps(opt, wnp, gnp, steps=3, mp=False, grads=None):
+    ws = [NDArray(jnp.array(w)) for w in wnp]
+    gs = grads if grads is not None \
+        else [NDArray(jnp.array(g)) for g in gnp]
+    idxs = list(range(len(ws)))
+    mk_state = opt.create_state_multi_precision if mp else opt.create_state
+    ss = [mk_state(i, w) for i, w in zip(idxs, ws)]
+    for _ in range(steps):
+        ss = opt.multi_update(idxs, ws, gs, ss)
+    return ws, ss
+
+
+def _assert_close(ws_f, ws_l, name, rtol=2e-5, atol=1e-5):
+    # atol floor: traced-int step counts make beta**t f32 where the
+    # legacy loop precomputes it in python f64 — near-zero weight
+    # elements see the difference amplified to a few 1e-6 absolute
+    for i, (a, b) in enumerate(zip(ws_f, ws_l)):
+        onp.testing.assert_allclose(
+            onp.asarray(a._data), onp.asarray(b._data),
+            rtol=rtol, atol=atol,
+            err_msg=f"{name} param {i}: fused != legacy")
+
+
+FUSABLE = sorted(k for k, v in _REGISTRY.items() if v._fusable)
+
+
+@pytest.mark.parametrize("name", FUSABLE)
+def test_fused_matches_legacy_all_optimizers(name, monkeypatch):
+    wnp, gnp = _mk_tensors()
+    ws_f, _ = _run_steps(_mk(name), wnp, gnp)
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    ws_l, _ = _run_steps(_mk(name), wnp, gnp)
+    _assert_close(ws_f, ws_l, name)
+
+
+@pytest.mark.parametrize("name", FUSABLE)
+def test_fused_lr_wd_mult_asymmetry(name, monkeypatch):
+    """Per-param lr_mult/wd_mult become stacked scalar operands — the
+    group stays fused and each param still sees ITS multiplier."""
+    def build():
+        o = _mk(name)
+        o.set_lr_mult({0: 0.5, 2: 2.0})
+        o.set_wd_mult({1: 0.0, 2: 3.0})
+        return o
+    wnp, gnp = _mk_tensors(seed=1)
+    reset_apply_counters()
+    ws_f, _ = _run_steps(build(), wnp, gnp)
+    assert apply_counters["fused_calls"] == 3  # one per step, not per param
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    ws_l, _ = _run_steps(build(), wnp, gnp)
+    _assert_close(ws_f, ws_l, name)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "lamb"])
+def test_fused_clip_gradient(name, monkeypatch):
+    wnp, gnp = _mk_tensors(seed=2)
+    ws_f, _ = _run_steps(_mk(name, clip_gradient=0.1), wnp, gnp)
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    ws_l, _ = _run_steps(_mk(name, clip_gradient=0.1), wnp, gnp)
+    _assert_close(ws_f, ws_l, name)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam"])
+def test_fused_multi_precision_master(name, monkeypatch):
+    """bf16 weights + fp32 master: fused keeps the weight bf16, carries
+    the f32 master/state, and matches the legacy mp loop."""
+    wnp, gnp = _mk_tensors(dtype=onp.float32, seed=3)
+    wnp = [w.astype(jnp.bfloat16) for w in wnp]
+    gnp = [g.astype(jnp.bfloat16) for g in gnp]
+    ws_f, ss_f = _run_steps(_mk(name, multi_precision=True), wnp, gnp,
+                            mp=True)
+    for w, s in zip(ws_f, ss_f):
+        assert w._data.dtype == jnp.bfloat16
+        assert isinstance(s, tuple) and s[0].dtype == jnp.float32
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    ws_l, ss_l = _run_steps(_mk(name, multi_precision=True), wnp, gnp,
+                            mp=True)
+    # master copies advance in f32 on both paths — tight tolerance there
+    for i, (sf, sl) in enumerate(zip(ss_f, ss_l)):
+        onp.testing.assert_allclose(
+            onp.asarray(sf[0]), onp.asarray(sl[0]), rtol=2e-5, atol=1e-6,
+            err_msg=f"{name} master {i}")
+    _assert_close(ws_f, ws_l, name, rtol=1e-2, atol=1e-2)  # bf16 rounding
+
+
+def test_fused_bf16_non_mp_close(monkeypatch):
+    """Raw bf16 (no master): fused promotes lr math to f32 — documented
+    ulp-close, not bit-identical."""
+    wnp, gnp = _mk_tensors(seed=4)
+    wnp = [w.astype(jnp.bfloat16) for w in wnp]
+    gnp = [g.astype(jnp.bfloat16) for g in gnp]
+    ws_f, _ = _run_steps(_mk("sgd"), wnp, gnp)
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    ws_l, _ = _run_steps(_mk("sgd"), wnp, gnp)
+    _assert_close(ws_f, ws_l, "sgd-bf16", rtol=2e-2, atol=2e-2)
+
+
+def test_sparse_grad_falls_back_dense_stays_fused(monkeypatch):
+    """A row_sparse grad takes the legacy per-param path; the dense
+    params of the same call still fuse into one jitted apply."""
+    wnp, gnp = _mk_tensors(seed=5, shapes=[(4, 5), (4, 5), (4, 5)])
+    rs_np = onp.zeros((4, 5), onp.float32)
+    rs_np[1] = gnp[1][1]
+    rs = sp.RowSparseNDArray(rs_np[1:2].copy(), onp.array([1]), (4, 5))
+    grads = [NDArray(jnp.array(gnp[0])), rs, NDArray(jnp.array(gnp[2]))]
+    reset_apply_counters()
+    ws_f, _ = _run_steps(_mk("sgd"), wnp, gnp, steps=1, grads=grads)
+    assert apply_counters["fallback_params"] == 1
+    assert apply_counters["fused_calls"] == 1
+    assert apply_counters["fused_params"] == 2
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    ws_l, _ = _run_steps(_mk("sgd"), wnp, gnp, steps=1, grads=[
+        NDArray(jnp.array(gnp[0])),
+        sp.RowSparseNDArray(rs_np[1:2].copy(), onp.array([1]), (4, 5)),
+        NDArray(jnp.array(gnp[2]))])
+    _assert_close(ws_f, ws_l, "sgd-sparse-fallback")
+
+
+def test_sgld_not_fused():
+    """SGLD's host-RNG rule opts out of fusion entirely."""
+    wnp, gnp = _mk_tensors(seed=6)
+    mx.random.seed(0)
+    reset_apply_counters()
+    _run_steps(_mk("sgld"), wnp, gnp, steps=1)
+    assert apply_counters["fused_calls"] == 0
+    assert apply_counters["fallback_params"] == len(wnp)
+
+
+def _many_param_trainer(n, optimizer="sgd", opt_params=None, dtypes=None):
+    rng = onp.random.RandomState(7)
+    params = []
+    for i in range(n):
+        dt = dtypes[i % len(dtypes)] if dtypes else "float32"
+        p = Parameter(f"w{i}", shape=(3, 4), dtype=dt)
+        p.initialize(init=mx.init.Uniform())
+        p.grad()._rebind(jnp.asarray(rng.randn(3, 4), p.data()._data.dtype))
+        params.append(p)
+    trainer = gluon.Trainer(
+        params, optimizer, opt_params or {"learning_rate": 0.01},
+        kvstore=None)
+    return params, trainer
+
+
+def test_dispatch_count_one_call_per_group_not_per_param():
+    """Acceptance: a >=50-param Trainer.step issues O(#groups) jitted
+    optimizer-apply calls (here: 1 group), not O(#params)."""
+    params, trainer = _many_param_trainer(60)
+    reset_apply_counters()
+    trainer.step(1)
+    assert apply_counters["fused_calls"] == 1
+    assert apply_counters["fused_params"] == 60
+    assert apply_counters["fallback_params"] == 0
+    # steady state: still one dispatch per step
+    trainer.step(1)
+    assert apply_counters["fused_calls"] == 2
+
+
+def test_dispatch_count_groups_by_dtype():
+    params, trainer = _many_param_trainer(
+        50, dtypes=["float32", "bfloat16"])
+    reset_apply_counters()
+    trainer.step(1)
+    assert apply_counters["fused_calls"] == 2  # one per dtype group
+    assert apply_counters["fused_params"] == 50
+
+
+def test_env_escape_hatch_disables_fusion(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    params, trainer = _many_param_trainer(50)
+    reset_apply_counters()
+    trainer.step(1)
+    assert apply_counters["fused_calls"] == 0
+    assert apply_counters["fallback_params"] == 50
+
+
+def test_trainer_fused_step_value():
+    """End-to-end: fused Trainer.step produces the analytically expected
+    SGD update (same assertion style as test_gluon.test_trainer_sgd_step,
+    but through the fused path with many params)."""
+    params, trainer = _many_param_trainer(
+        8, opt_params={"learning_rate": 0.1})
+    before = [onp.asarray(p.data()._data).copy() for p in params]
+    grads = [onp.asarray(p.grad()._data).copy() for p in params]
+    trainer.step(1)
+    for p, b, g in zip(params, before, grads):
+        onp.testing.assert_allclose(
+            onp.asarray(p.data()._data), b - 0.1 * g, rtol=1e-6, atol=1e-7)
+
+
+def test_kvstore_server_push_is_fused(monkeypatch):
+    """update_on_kvstore: a list push applies the server-side optimizer
+    as ONE fused multi_update over the whole wave."""
+    from mxnet_tpu import kvstore as kv_mod
+    rng = onp.random.RandomState(8)
+    wnp = [rng.randn(3, 4).astype(onp.float32) for _ in range(6)]
+    gnp = [rng.randn(3, 4).astype(onp.float32) for _ in range(6)]
+
+    def run():
+        kv = kv_mod.create("local")
+        kv.set_optimizer(opt_mod.create("sgd", learning_rate=0.1,
+                                        momentum=0.9))
+        for i, w in enumerate(wnp):
+            kv.init(i, NDArray(jnp.array(w)))
+        for _ in range(2):
+            kv.push(list(range(6)),
+                    [NDArray(jnp.array(g)) for g in gnp])
+        outs = [NDArray(jnp.zeros((3, 4), jnp.float32)) for _ in range(6)]
+        kv.pull(list(range(6)), outs)
+        return outs
+
+    reset_apply_counters()
+    fused = run()
+    assert apply_counters["fused_calls"] == 2  # one per push wave
+    assert apply_counters["fused_params"] == 12
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    legacy = run()
+    _assert_close(fused, legacy, "kvstore-server")
+
+
+def test_optimizer_pickles_without_executable_cache(tmp_path):
+    """The jitted executable cache must not leak into checkpoints
+    (kvstore save_optimizer_states pickles the optimizer)."""
+    import pickle
+    opt = _mk("adam")
+    wnp, gnp = _mk_tensors(seed=9)
+    _run_steps(opt, wnp, gnp, steps=1)
+    assert opt.__dict__.get("_fused_cache")
+    blob = pickle.dumps(opt)
+    opt2 = pickle.loads(blob)
+    assert "_fused_cache" not in opt2.__dict__
+    # and the restored optimizer still updates (rebuilds its cache)
+    _run_steps(opt2, wnp, gnp, steps=1)
+
+
+def test_hyperparam_mutation_retraces():
+    """Mutating a closed-over hyperparameter (momentum) must not replay
+    the stale executable."""
+    opt = _mk("sgd")
+    wnp, gnp = _mk_tensors(seed=10, shapes=[(4, 5)])
+    ws, ss = _run_steps(opt, wnp, gnp, steps=1)
+    opt.momentum = 0.0  # rule branches on it at trace time
+    w2 = [NDArray(jnp.array(wnp[0]))]
+    g2 = [NDArray(jnp.array(gnp[0]))]
+    s2 = [None]  # momentum-0 SGD state
+    opt.multi_update([0], w2, g2, s2)
+    expected = wnp[0] - 0.05 * (0.5 * gnp[0] + 0.01 * wnp[0])
+    onp.testing.assert_allclose(onp.asarray(w2[0]._data), expected,
+                                rtol=2e-5, atol=1e-6)

@@ -441,3 +441,81 @@ class TestJittableCSRUnion:
                                     rtol=1e-6)
         onp.testing.assert_array_equal(onp.asarray(r.indices.asnumpy()),
                                        [1, 7])
+
+
+class TestSparseScalarDtypeGate:
+    """Scalar mul/div storage-preservation is gated to floating dtypes
+    and nonzero divisors — int sparse must promote like the dense op
+    instead of truncating the scale factor to 0 (ADVICE.md item)."""
+
+    def _int_rs(self):
+        d = onp.zeros((4, 5), "int32")
+        d[1] = [1, 2, 0, 4, 5]
+        d[3] = [0, 0, 3, 0, 0]
+        return d, mx.nd.array(d.astype("float32")).tostype(
+            "row_sparse"), sparse.RowSparseNDArray(
+                onp.asarray([[1, 2, 0, 4, 5], [0, 0, 3, 0, 0]], "int32"),
+                onp.asarray([1, 3]), (4, 5))
+
+    def test_int_rowsparse_div_promotes(self):
+        import jax.numpy as jnp
+        d, _, rs = self._int_rs()
+        out = rs / 2
+        # dense semantics: int / 2 -> float, 0.5 not truncated to 0
+        onp.testing.assert_allclose(onp.asarray(out.asnumpy()), d / 2,
+                                    rtol=1e-6)
+        assert jnp.issubdtype(jnp.dtype(out.dtype), jnp.floating)
+
+    def test_int_rowsparse_mul_matches_dense(self):
+        d, _, rs = self._int_rs()
+        # the dense scalar op casts the scalar to the array dtype
+        # (reference NDArray scalar semantics) — int sparse must agree
+        # with the dense result instead of scaling through _scale
+        dense = mx.nd.array(d) * 0.5
+        onp.testing.assert_allclose(onp.asarray((rs * 0.5).asnumpy()),
+                                    onp.asarray(dense.asnumpy()),
+                                    rtol=1e-6)
+        dense3 = mx.nd.array(d) * 3
+        onp.testing.assert_allclose(onp.asarray((rs * 3).asnumpy()),
+                                    onp.asarray(dense3.asnumpy()),
+                                    rtol=1e-6)
+
+    def test_float_rowsparse_scalar_keeps_storage(self):
+        _, f, _ = self._int_rs()
+        out = f / 2
+        assert out.stype == "row_sparse"
+        onp.testing.assert_allclose(
+            onp.asarray(out.asnumpy())[1], [0.5, 1, 0, 2, 2.5], rtol=1e-6)
+        out2 = f * 3.0
+        assert out2.stype == "row_sparse"
+
+    def test_nonfinite_scalar_goes_dense(self):
+        _, f, _ = self._int_rs()
+        # 0 * inf = nan at UNSTORED positions — only the dense op can
+        # represent that, so inf/nan scalars must bypass _scale
+        out = f * float("inf")
+        a = onp.asarray(out.asnumpy())
+        assert onp.isnan(a[0]).all()      # unstored row: 0 * inf
+        assert onp.isinf(a[1][0])         # stored value: 1 * inf
+        out2 = f / float("nan")
+        assert onp.isnan(onp.asarray(out2.asnumpy())).all()
+
+    def test_float_div_by_zero_goes_dense(self):
+        _, f, _ = self._int_rs()
+        out = f / 0
+        # dense semantics: unstored zeros become 0/0 = nan (the sparse
+        # _scale path could only scale stored values)
+        a = onp.asarray(out.asnumpy())
+        assert onp.isnan(a[0]).all()
+        assert onp.isinf(a[1][0])
+
+    def test_int_csr_div_promotes(self):
+        d = onp.zeros((3, 4), "int32")
+        d[0, 1] = 6
+        d[2, 3] = 9
+        mat = sp.csr_matrix(d)
+        a = sparse.csr_matrix((onp.asarray(mat.data, "int32"),
+                               mat.indices, mat.indptr), shape=(3, 4))
+        out = a / 4
+        onp.testing.assert_allclose(onp.asarray(out.asnumpy()), d / 4,
+                                    rtol=1e-6)
