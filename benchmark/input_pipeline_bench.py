@@ -4,9 +4,14 @@ hard-part 3: host decode must feed ~11k img/s/chip for ResNet-50).
 
 Measures the native RecordIO + libjpeg decode + threaded prefetch path at
 ImageNet shapes (224×224 JPEEGs), stage by stage, and end-to-end feeding a
-device step.  Prints one JSON line per stage.
+device step, plus the device-prefetch overlap stage (``h2d_overlap_*``
+rows): steady-state step latency with the ``DevicePrefetchIter`` ring vs
+the legacy synchronous path, against the input-only / compute-only
+floors — with the ring, step ≈ max(input, compute).  Prints one JSON
+line per stage.
 
     python benchmark/input_pipeline_bench.py [--n 2048] [--threads N]
+    python benchmark/input_pipeline_bench.py --smoke   # tiny, no PIL/native
 """
 from __future__ import annotations
 
@@ -52,25 +57,131 @@ def _make_rec(path, n, hw=224):
     return total / n
 
 
-def main():
+def bench_h2d_overlap(emit, bs=64, hw=96, steps=24, depth=2):
+    """Device-prefetch overlap stage: a synthetic workload where host input
+    time is a measurable fraction of device compute.  Both loops block on
+    the step result every iteration (the usual loss-readback pattern);
+    only the ring differs — so the `overlap` row's win over `sync` is
+    exactly the hidden input + H2D time.  Emits input-only and
+    compute-only floors so `step ≈ max(input, compute)` is checkable."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.context import current_context
+    from mxnet_tpu.gluon.data.dataloader import DevicePrefetchIter
+
+    ctx = current_context()
+    platform = jax.devices()[0].platform
+    rng = onp.random.RandomState(0)
+    base = (rng.rand(bs, 3, hw, hw) * 255).astype(onp.float32)
+    kernel = jnp.asarray(rng.rand(8, 3, 5, 5).astype(onp.float32))
+
+    def host_batch():
+        # deliberate host work standing in for decode + augment
+        img = base
+        for ax in (2, 3):
+            img = (onp.roll(img, 1, ax) + img + onp.roll(img, -1, ax)) / 3
+        return onp.ascontiguousarray(img)
+
+    def batches(n):
+        for _ in range(n):
+            yield host_batch()
+
+    @jax.jit
+    def device_step(x):
+        from jax import lax
+        dn = lax.conv_dimension_numbers(x.shape, kernel.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        y = lax.conv_general_dilated(x / 255.0, kernel, (2, 2),
+                                     [(2, 2), (2, 2)],
+                                     dimension_numbers=dn)
+        for _ in range(4):  # enough device work to be worth hiding behind
+            y = jnp.tanh(y) + y * 0.5
+        return y.mean()
+
+    # floors: host input alone, device compute alone (resident batch)
+    t0 = time.perf_counter()
+    for _ in batches(steps):
+        pass
+    input_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    xb = jax.device_put(base)
+    device_step(xb).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        device_step(xb).block_until_ready()
+    compute_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    def run(ring_depth):
+        it = DevicePrefetchIter(batches(steps + 2), ctx, depth=ring_depth,
+                                background=ring_depth > 0)
+        # warm the ring AND the executable for committed-placement inputs
+        # (first call would otherwise recompile inside the timed loop)
+        device_step(next(it).asjax()).block_until_ready()
+        t0 = time.perf_counter()
+        n = 0
+        for b in it:
+            device_step(b.asjax()).block_until_ready()
+            n += 1
+            if n == steps:
+                break
+        dt = (time.perf_counter() - t0) / n * 1e3
+        it.close()
+        return dt
+
+    prev = os.environ.get("MXNET_DEVICE_PREFETCH")
+    try:
+        os.environ["MXNET_DEVICE_PREFETCH"] = "0"   # legacy synchronous
+        sync_ms = run(0)
+        os.environ["MXNET_DEVICE_PREFETCH"] = str(depth)
+        overlap_ms = run(depth)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_DEVICE_PREFETCH", None)
+        else:
+            os.environ["MXNET_DEVICE_PREFETCH"] = prev
+
+    common = {"platform": platform, "bs": bs, "hw": hw, "depth": depth}
+    emit("h2d_input_only", bs / input_ms * 1e3, ms_per_step=round(input_ms, 2),
+         **common)
+    emit("h2d_compute_only", bs / compute_ms * 1e3,
+         ms_per_step=round(compute_ms, 2), **common)
+    emit("h2d_step_sync", bs / sync_ms * 1e3, ms_per_step=round(sync_ms, 2),
+         **common)
+    emit("h2d_step_overlap", bs / overlap_ms * 1e3,
+         ms_per_step=round(overlap_ms, 2),
+         ideal_ms=round(max(input_ms, compute_ms), 2),
+         speedup_vs_sync=round(sync_ms / overlap_ms, 2), **common)
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2048)
     ap.add_argument("--threads", type=int, default=os.cpu_count() or 8)
     ap.add_argument("--hw", type=int, default=224)
-    args = ap.parse_args()
-
-    from mxnet_tpu import _native, recordio
-
-    if not _native.available():
-        print(json.dumps({"bench": "input_pipeline",
-                          "error": "native IO unavailable"}))
-        return 0
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes, overlap stage only (no PIL / native "
+                         "IO requirement) — the tier-1 bit-rot gate")
+    args = ap.parse_args(argv)
 
     def emit(stage, imgs_per_sec, **extra):
         print(json.dumps({"bench": "input_pipeline", "stage": stage,
                           "imgs_per_sec": round(imgs_per_sec, 1),
                           "threads": args.threads, **extra}))
         sys.stdout.flush()
+
+    if args.smoke:
+        bench_h2d_overlap(emit, bs=8, hw=32, steps=10, depth=2)
+        return 0
+
+    from mxnet_tpu import _native, recordio
+
+    if not _native.available():
+        print(json.dumps({"bench": "input_pipeline",
+                          "error": "native IO unavailable"}))
+        # stage 5 needs no native IO — still emit the overlap rows
+        bench_h2d_overlap(emit, bs=64, hw=min(args.hw, 128), steps=24)
+        return 0
 
     with tempfile.TemporaryDirectory() as td:
         rec = os.path.join(td, "bench.rec")
@@ -147,6 +258,9 @@ def main():
             float(pending)
         dt = time.perf_counter() - t0
         emit("end_to_end_device_feed", cnt / dt, platform=platform)
+
+    # stage 5: device-prefetch ring vs legacy synchronous feed
+    bench_h2d_overlap(emit, bs=64, hw=min(args.hw, 128), steps=24)
     return 0
 
 

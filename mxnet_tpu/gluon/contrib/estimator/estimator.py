@@ -16,7 +16,7 @@ from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
 
 class Estimator:
     def __init__(self, net, loss, metrics=None, initializer=None,
-                 trainer=None, context=None):
+                 trainer=None, context=None, device_prefetch=None):
         from .... import init as init_mod, context as ctx_mod
         self.net = net
         if not isinstance(loss, loss_mod.Loss):
@@ -26,6 +26,7 @@ class Estimator:
         self.train_metrics = metrics if isinstance(metrics, list) \
             else [metrics]
         self.context = context or ctx_mod.current_context()
+        self._device_prefetch = device_prefetch
         if not self._net_initialized():
             self.net.initialize(initializer or init_mod.Xavier(),
                                 ctx=self.context)
@@ -41,10 +42,29 @@ class Estimator:
         return True
 
     # ------------------------------------------------------------------ #
+    def _prefetched(self, data):
+        """One epoch's iterator over ``data``, routed through the
+        device-prefetch ring when the estimator context is an accelerator:
+        batch ``k+1``'s host load + H2D copy overlap step ``k``.  Inert on
+        host contexts, when the loader already places on device
+        (``DataLoader(device=...)``), or under ``MXNET_DEVICE_PREFETCH=0``
+        — iteration then is exactly ``iter(data)``."""
+        from ...data.dataloader import (DevicePrefetchIter,
+                                        _resolve_device_prefetch)
+        ctx = self.context
+        if ctx is None or getattr(ctx, "device_type", "cpu").startswith("cpu"):
+            return iter(data)
+        if getattr(data, "_device", None) is not None:
+            return iter(data)  # loader already device-aware
+        depth = _resolve_device_prefetch(self._device_prefetch)
+        if depth <= 0:
+            return iter(data)
+        return DevicePrefetchIter(iter(data), ctx, depth)
+
     def evaluate(self, val_data, batch_axis=0):
         for m in self.val_metrics:
             m.reset()
-        for batch in val_data:
+        for batch in self._prefetched(val_data):
             data, label = self._unpack(batch)
             pred = self.net(data)
             loss = self.loss(pred, label)
@@ -95,7 +115,7 @@ class Estimator:
         while not stopper.stop_training:
             for h in epoch_begin:
                 h.epoch_begin(self)
-            for batch in train_data:
+            for batch in self._prefetched(train_data):
                 data, label = self._unpack(batch)
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)

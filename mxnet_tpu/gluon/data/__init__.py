@@ -5,7 +5,8 @@ from .dataset import (Dataset, SimpleDataset, ArrayDataset,
                       RecordFileDataset)
 from .sampler import (Sampler, SequentialSampler, RandomSampler,
                       BatchSampler, IntervalSampler, FilterSampler)
-from .dataloader import DataLoader, default_batchify_fn, default_mp_batchify_fn
+from .dataloader import (DataLoader, DevicePrefetchIter, default_batchify_fn,
+                         default_mp_batchify_fn)
 from . import vision
 from . import dataset
 from . import sampler

@@ -12,18 +12,33 @@ host numpy before a single device put.  The queue/prefetch structure
 (``prefetch`` batches in flight, ``pin_memory``≈host staging) matches the
 reference's semantics; ``ConnectionWrapper``/shm plumbing is intentionally
 absent because no process boundary exists.
+
+Device prefetch (the TPU-native layer the reference never needed): with
+``DataLoader(..., device=ctx, device_prefetch=N)`` batches come off the
+iterator already RESIDENT on device — a :class:`DevicePrefetchIter` ring
+keeps the H2D copies of batches ``k+1..k+N`` in flight while the caller
+consumes batch ``k`` (``jax.device_put`` is async under XLA), so
+steady-state step latency becomes ``max(host input, device compute)``
+instead of their sum.  ``device`` also accepts a ``jax.sharding.Sharding``
+or a device/context list: data-parallel runs get every batch landed
+pre-sharded by ONE ``device_put`` (no per-replica host slicing in the
+step).  ``MXNET_DEVICE_PREFETCH=0`` is the escape hatch back to the legacy
+synchronous path (placement happens inline, bit-for-bit identical values).
 """
 from __future__ import annotations
 
+import os
 import queue as _queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor, TimeoutError as _FutTimeout
 
 import numpy as onp
 
 from ...base import MXNetError
 from ... import ndarray as nd
 from ...ndarray import NDArray
+from ...ndarray.ndarray import _placement_target, to_device
 from .dataset import Dataset
 from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
 
@@ -48,61 +63,284 @@ def default_batchify_fn(data):
 default_mp_batchify_fn = default_batchify_fn
 
 
+def _env_device_prefetch(default=2):
+    """``MXNET_DEVICE_PREFETCH``: default device-ring depth; ``0`` forces
+    the legacy synchronous placement path everywhere (escape hatch)."""
+    try:
+        return int(os.environ.get("MXNET_DEVICE_PREFETCH", str(default)))
+    except ValueError:
+        return default
+
+
+def _resolve_device_prefetch(depth):
+    """Effective device-ring depth: ``MXNET_DEVICE_PREFETCH=0`` (the
+    legacy-synchronous escape hatch) wins over any explicit argument;
+    otherwise an explicit ``depth`` wins over the env default."""
+    env = _env_device_prefetch()
+    if env <= 0:
+        return 0
+    return max(0, int(depth)) if depth is not None else env
+
+
+def _worker_load(dataset, batchify_fn, place_fn, indices):
+    """One worker-thread batch: load samples, batchify, optionally place on
+    device (the device-prefetch plumbing — H2D initiated right here in the
+    pool thread, ``jax.device_put`` is async)."""
+    samples = [dataset[i] for i in indices]
+    batch = batchify_fn(samples)
+    if place_fn is not None:
+        batch = place_fn(batch)
+    return batch
+
+
 class _MultiWorkerIter:
     """Prefetching iterator: worker threads run ``dataset[idx]`` + batchify;
-    results are delivered in order (reference ``_MultiWorkerIter``)."""
+    results are delivered in order (reference ``_MultiWorkerIter``).
+
+    ``prefetch`` is honored exactly as given (it bounds host memory — the
+    ``2*num_workers`` default is applied by :class:`DataLoader` only when
+    the user passed ``prefetch=None``).  ``timeout`` bounds the wait for
+    any single batch; a stuck worker raises :class:`MXNetError` naming the
+    batch index instead of hanging forever.  ``place_fn`` (set by the
+    device-prefetch plumbing) runs as the last step of the worker-thread
+    batchify so the thread pool feeds the device ring directly."""
 
     def __init__(self, dataset, batch_sampler, batchify_fn, num_workers,
-                 prefetch, pin_memory):
+                 prefetch, pin_memory, timeout=None, place_fn=None):
         self._dataset = dataset
         self._batchify_fn = batchify_fn
         self._batch_iter = iter(batch_sampler)
         self._executor = ThreadPoolExecutor(max_workers=num_workers)
-        self._prefetch = max(prefetch, 2 * num_workers)
-        self._pending = []
+        self._prefetch = max(1, prefetch)
+        self._pending = deque()
         self._pin_memory = pin_memory
+        self._timeout = timeout if timeout and timeout > 0 else None
+        self._place_fn = place_fn
+        self._batch_idx = 0
+        self._closed = False
         for _ in range(self._prefetch):
             self._push_next()
-
-    def _load_batch(self, indices):
-        samples = [self._dataset[i] for i in indices]
-        return self._batchify_fn(samples)
 
     def _push_next(self):
         indices = next(self._batch_iter, None)
         if indices is None:
             return
-        self._pending.append(self._executor.submit(self._load_batch, indices))
+        # module-level worker fn: queued work items must not hold a
+        # reference back to this iterator, or an abandoned epoch's
+        # __del__ cleanup never fires while batches are still queued
+        self._pending.append(self._executor.submit(
+            _worker_load, self._dataset, self._batchify_fn, self._place_fn,
+            indices))
 
     def __iter__(self):
         return self
 
     def __next__(self):
         if not self._pending:
-            self._executor.shutdown(wait=False)
+            self.shutdown()
             raise StopIteration
-        fut = self._pending.pop(0)
+        fut = self._pending.popleft()
         self._push_next()
-        return fut.result()
+        try:
+            batch = fut.result(self._timeout)
+        except _FutTimeout:
+            idx = self._batch_idx
+            self.shutdown()
+            raise MXNetError(
+                f"DataLoader worker timed out after {self._timeout}s "
+                f"waiting for batch {idx}; raise DataLoader(timeout=...) if "
+                f"your per-batch load legitimately takes longer") from None
+        except BaseException:
+            self.shutdown()
+            raise
+        self._batch_idx += 1
+        return batch
 
     next = __next__
+
+    def shutdown(self):
+        """Cancel in-flight work and release the thread pool.  Safe to call
+        repeatedly; runs from ``__del__`` so an epoch abandoned mid-way
+        (``break``) doesn't leak the executor or its futures."""
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending:
+            fut.cancel()
+        self._pending.clear()
+        try:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+        except TypeError:  # python < 3.9: no cancel_futures kwarg
+            self._executor.shutdown(wait=False)
+
+    close = shutdown
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+_END = object()  # device-prefetch producer's end-of-stream marker
+
+
+class DevicePrefetchIter:
+    """Depth-``N`` device-resident prefetch ring over any batch iterator.
+
+    While the caller consumes batch ``k``, batches ``k+1..k+N`` are already
+    batchified with their host→device copies in flight (``jax.device_put``
+    dispatches asynchronously), so steady-state step latency is
+    ``max(input time, compute time)`` rather than their sum — the
+    TPU-native analog of the reference's ``PrefetchingIter`` /
+    ``dmlc::ThreadedIter``, extended to hide the H2D copy the reference
+    never had to pay.
+
+    ``device`` accepts a ``Context``, ``jax.Device``,
+    ``jax.sharding.Sharding``, or a list of contexts/devices (one
+    ``device_put`` with a batch-axis ``NamedSharding`` lands each device's
+    slice pre-sharded for data-parallel step loops).
+
+    Pump modes:
+
+    * ``background=True`` (default; right for same-process sources): a
+      producer thread pulls from ``source`` and places, so host batchify
+      itself also overlaps the training step.
+    * ``background=False`` (used over :class:`_MultiWorkerIter`, whose
+      thread pool already batchifies ahead): threadless ring — each
+      ``__next__`` pulls one completed host batch from the pool,
+      initiates its async transfer, and returns the batch whose transfer
+      was initiated ``N`` calls earlier.
+
+    ``depth=0`` (or ``MXNET_DEVICE_PREFETCH=0``) degenerates to the legacy
+    synchronous path: pull + place inline, no ring, no thread — values are
+    bit-for-bit identical, only the overlap disappears.
+    """
+
+    def __init__(self, source, device, depth=None, background=True):
+        self._source = iter(source)
+        self._target = _placement_target(device)
+        self._depth = _resolve_device_prefetch(depth)
+        self._ring = deque()
+        self._exhausted = False
+        self._done = False
+        self._queue = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._err = None
+        self._background = bool(background) and self._depth > 0
+        if self._background:
+            self._queue = _queue.Queue(maxsize=self._depth)
+            self._thread = threading.Thread(
+                target=self._produce, name="mx-device-prefetch", daemon=True)
+            self._thread.start()
+
+    # -- placement ------------------------------------------------------- #
+    def _place(self, batch):
+        if self._target is None:
+            return batch
+        return to_device(batch, self._target)
+
+    # -- background producer --------------------------------------------- #
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                if not self._put(self._place(batch)):
+                    return
+        except BaseException as e:  # deliver to the consumer thread
+            self._err = e
+        self._put(_END)
+
+    # -- iterator protocol ----------------------------------------------- #
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._background:
+            if self._done:  # the single _END was already consumed — a
+                raise StopIteration  # further next() must not block forever
+            item = self._queue.get()
+            if item is _END:
+                self._done = True
+                if self._err is not None:
+                    err, self._err = self._err, None
+                    raise err
+                raise StopIteration
+            return item
+        if self._depth == 0:  # legacy synchronous path
+            return self._place(next(self._source))
+        # threadless ring over an already-asynchronous source
+        while len(self._ring) < self._depth and not self._exhausted:
+            try:
+                self._ring.append(self._place(next(self._source)))
+            except StopIteration:
+                self._exhausted = True
+        if not self._ring:
+            raise StopIteration
+        return self._ring.popleft()
+
+    next = __next__
+
+    def close(self):
+        """Stop the producer and release the source (cancels a wrapped
+        ``_MultiWorkerIter``'s pool).  Called from ``__del__`` so breaking
+        out of an epoch cleans up both layers."""
+        self._stop.set()
+        if self._thread is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._ring.clear()
+        for attr in ("shutdown", "close"):
+            fn = getattr(self._source, attr, None)
+            if callable(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+                break
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class DataLoader:
     """Load a ``Dataset`` in mini-batches (reference ``gluon.data.DataLoader``
     API: sampler/batch_sampler/shuffle/last_batch/num_workers/batchify_fn/
-    pin_memory/prefetch/timeout)."""
+    pin_memory/prefetch/timeout) plus the TPU-native device-prefetch layer
+    (``device=``/``device_prefetch=`` — see :class:`DevicePrefetchIter`)."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=True, timeout=120):
+                 thread_pool=True, timeout=120, device=None,
+                 device_prefetch=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._num_workers = max(0, num_workers)
-        self._prefetch = max(0, prefetch) if prefetch is not None \
+        self._prefetch = max(1, prefetch) if prefetch is not None \
             else 2 * self._num_workers
         self._timeout = timeout
+        self._device = device
+        self._device_prefetch = device_prefetch
 
         if batch_sampler is None:
             if batch_size is None:
@@ -126,10 +364,32 @@ class DataLoader:
             def _same_process_iter():
                 for batch in self._batch_sampler:
                     yield self._batchify_fn([self._dataset[i] for i in batch])
-            return _same_process_iter()
-        return _MultiWorkerIter(self._dataset, self._batch_sampler,
-                                self._batchify_fn, self._num_workers,
-                                self._prefetch, self._pin_memory)
+            base = _same_process_iter()
+            if self._device is None:
+                return base
+            # background producer: host batchify AND the H2D copy both
+            # overlap the consumer's step
+            return DevicePrefetchIter(base, self._device,
+                                      self._device_prefetch, background=True)
+        place_fn = None
+        depth = _resolve_device_prefetch(self._device_prefetch)
+        if self._device is not None and depth >= self._prefetch:
+            # the device ring is at least as deep as the host prefetch
+            # bound, so every in-flight batch may be device-resident:
+            # place inside the worker thread — H2D is initiated the
+            # moment batchify finishes, no extra layer
+            target = _placement_target(self._device)
+            place_fn = lambda batch: to_device(batch, target)  # noqa: E731
+        it = _MultiWorkerIter(self._dataset, self._batch_sampler,
+                              self._batchify_fn, self._num_workers,
+                              self._prefetch, self._pin_memory,
+                              timeout=self._timeout, place_fn=place_fn)
+        if self._device is None or place_fn is not None:
+            return it
+        # the worker pool already batchifies ahead — the threadless ring
+        # pulls completed host batches straight into the device ring
+        # (depth 0 = MXNET_DEVICE_PREFETCH=0 = synchronous placement)
+        return DevicePrefetchIter(it, self._device, depth, background=False)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -134,9 +134,15 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + rescale(1/batch_size) + update (reference
-        ``Trainer.step``)."""
+        ``Trainer.step``).
+
+        Dispatches asynchronously end to end — with an int ``batch_size``
+        (the ``data.shape[0]`` idiom) nothing here reads a device value
+        back to host, so a training loop fed by the device-prefetch input
+        pipeline (``DataLoader(device=...)``) keeps batch ``k+1``'s host
+        decode + H2D copy overlapped with this step's device compute."""
         self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = self._scale / float(batch_size)
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -205,8 +211,12 @@ class Trainer:
         for i, ns in zip(idxs, new_states):
             self._states[i] = ns
             # broadcast updated weights to the other replicas (the
-            # reference's kvstore weight pull after the server update)
-            self._params[i]._sync_replicas()
+            # reference's kvstore weight pull after the server update);
+            # skipped entirely on the single-canonical-array path so the
+            # steady-state step stays a pure async dispatch chain
+            p = self._params[i]
+            if p._replicas is not None:
+                p._sync_replicas()
 
     # -- state checkpointing (SURVEY.md §5.4 d) --------------------------- #
     def save_states(self, fname):

@@ -44,13 +44,61 @@ def _take_axis(data, axis, lo, hi):
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     """Split a batch across a context list and load each slice
     (reference ``split_and_load``).  On a 1-element ctx list this is a
-    single ``as_in_context``."""
+    single ``as_in_context``.
+
+    Batches that arrive PRE-SHARDED along ``batch_axis`` over exactly
+    these devices (the ``DataLoader(device=[...])`` /
+    ``DevicePrefetchIter`` path — one ``device_put`` with a batch-axis
+    ``NamedSharding``) are returned as each device's already-resident
+    shard: no host slicing, no re-transfer, no sync."""
     if not isinstance(data, NDArray):
         data = nd.array(data)
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
+    shards = _presharded_views(data, ctx_list, batch_axis)
+    if shards is not None:
+        return shards
     slices = split_data(data, len(ctx_list), batch_axis, even_split)
     return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def _presharded_views(data, ctx_list, batch_axis):
+    """Device-local views of an already batch-sharded array, in ctx order;
+    ``None`` when the layout doesn't match (caller falls back to the host
+    slice + per-device load path)."""
+    if getattr(data, "_sparse_kind", False):
+        return None
+    arr = data._data
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None or not hasattr(arr, "addressable_shards"):
+        return None
+    n = len(ctx_list)
+    try:
+        if sharding.is_fully_replicated or data.shape[batch_axis] % n != 0:
+            return None
+        shards = list(arr.addressable_shards)
+    except Exception:
+        return None
+    if len(shards) != n:
+        return None
+    want = list(data.shape)
+    want[batch_axis] //= n
+    by_dev = {s.device: s for s in shards}
+    out = []
+    for i, ctx in enumerate(ctx_list):
+        try:
+            dev = ctx.jax_device() if hasattr(ctx, "jax_device") else ctx
+        except Exception:
+            return None
+        s = by_dev.get(dev)
+        if s is None or s.data is None or list(s.data.shape) != want:
+            return None
+        start = s.index[batch_axis].start or 0
+        if start != i * want[batch_axis]:
+            return None  # shard order disagrees with ctx order
+        out.append(type(data)(s.data, ctx if hasattr(ctx, "jax_device")
+                              else None))
+    return out
 
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):

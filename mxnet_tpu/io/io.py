@@ -239,16 +239,32 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference
-    ``PrefetchingIter`` ≈ ``dmlc::ThreadedIter`` double buffering)."""
+    ``PrefetchingIter`` ≈ ``dmlc::ThreadedIter`` double buffering).
+
+    ``device`` (a ``Context``, ``jax.Device``, ``jax.sharding.Sharding``,
+    or a list of contexts/devices) extends the reference semantics with
+    the TPU-native H2D stage: the producer thread places every batch's
+    data/label on device as it is prefetched, so the async copy of batch
+    ``k+1`` overlaps step ``k`` — a device list lands each batch
+    pre-sharded along the batch axis in ONE ``device_put``.
+    ``MXNET_DEVICE_PREFETCH=0`` drops the producer thread entirely
+    (legacy synchronous pull + inline placement, bit-identical values)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, device=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        from ..gluon.data.dataloader import _env_device_prefetch
+        from ..ndarray.ndarray import _placement_target
+        self._target = _placement_target(device)
+        # the escape hatch governs the DEVICE ring only: a device-less
+        # PrefetchingIter keeps its reference host-side producer thread
+        self._sync = self._target is not None and _env_device_prefetch() <= 0
+        self._err = None
         self._depth = prefetch_depth
         self._queue = None
         self._thread = None
@@ -271,37 +287,67 @@ class PrefetchingIter(DataIter):
                      for d in it.provide_label]
                     for r, it in zip(self.rename_label, self.iters)], [])
 
+    def _pull(self):
+        """One host pull + async device placement (raises StopIteration)."""
+        batches = [it.next() for it in self.iters]
+        if self._target is not None:
+            batches = [self._place_batch(b) for b in batches]
+        return batches
+
+    def _place_batch(self, batch):
+        from ..ndarray.ndarray import to_device
+        return DataBatch(data=to_device(batch.data, self._target),
+                         label=to_device(batch.label, self._target)
+                         if batch.label is not None else None,
+                         pad=batch.pad, index=batch.index,
+                         bucket_key=batch.bucket_key,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
     def _producer(self):
         while not self._stop.is_set():
             try:
-                batches = [it.next() for it in self.iters]
+                batches = self._pull()
             except StopIteration:
+                self._queue.put(None)
+                return
+            except BaseException as e:  # deliver to the consumer — a dead
+                self._err = e           # producer must not hang next()
                 self._queue.put(None)
                 return
             self._queue.put(batches)
 
     def _start(self):
+        if self._sync:  # MXNET_DEVICE_PREFETCH=0: no producer thread
+            return
         self._queue = _queue.Queue(maxsize=self._depth)
         self._stop.clear()
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._thread.start()
 
     def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        if not self._sync:
+            self._stop.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=5)
         for it in self.iters:
             it.reset()
         self._start()
 
     def next(self):
-        batches = self._queue.get()
-        if batches is None:
-            raise StopIteration
+        if self._sync:
+            batches = self._pull()  # StopIteration propagates
+        else:
+            batches = self._queue.get()
+            if batches is None:
+                if self._err is not None:
+                    err, self._err = self._err, None
+                    raise err
+                raise StopIteration
         data = sum([b.data for b in batches], [])
         label = sum([(b.label or []) for b in batches], [])
         return DataBatch(data=data, label=label or None, pad=batches[0].pad,
@@ -386,16 +432,18 @@ class MNISTIter(DataIter):
 
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
                     shuffle=False, preprocess_threads=4, prefetch_buffer=2,
-                    **kwargs):
+                    device=None, **kwargs):
     """RecordIO image iterator (reference C++ ``ImageRecordIter``, SURVEY.md
     §4.5).  Built from :class:`mxnet_tpu.image.ImageIter` wrapped in
     :class:`PrefetchingIter` for background decode — the role the reference's
     OMP decode pool + ``PrefetcherIter`` play.  Honors the same keyword
-    surface (augmentation kwargs pass through)."""
+    surface (augmentation kwargs pass through); ``device=`` adds the
+    TPU-native H2D overlap stage (batches arrive device-resident)."""
     from ..image import ImageIter
     kwargs.pop("path_imgidx", None)
     inner = ImageIter(batch_size=batch_size, data_shape=data_shape,
                       path_imgrec=path_imgrec, shuffle=shuffle, **kwargs)
-    if prefetch_buffer and prefetch_buffer > 0:
-        return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
+    if (prefetch_buffer and prefetch_buffer > 0) or device is not None:
+        return PrefetchingIter(inner, prefetch_depth=max(1, prefetch_buffer),
+                               device=device)
     return inner

@@ -27,7 +27,8 @@ import numpy as onp
 from ..base import MXNetError, integer_types, numeric_types
 from ..context import Context, current_context
 
-__all__ = ["NDArray", "array", "_wrap_like", "waitall", "from_jax", "empty"]
+__all__ = ["NDArray", "array", "_wrap_like", "waitall", "from_jax", "empty",
+           "to_device"]
 
 
 def _is_tracer(x) -> bool:
@@ -691,10 +692,104 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
                 dtype = onp.float32
         except Exception:
             pass
+    if ctx is not None and not isinstance(data, jax.Array):
+        # non-blocking single-hop H2D: hand host memory straight to the
+        # target device — ``jax.device_put`` returns immediately with the
+        # copy in flight (and canonicalizes dtypes exactly like
+        # ``jnp.asarray``), instead of committing to the default device
+        # first and re-transferring.  This is the path the device-prefetch
+        # input pipeline rides: batch k+1's copy overlaps step k.
+        host = onp.asarray(data, dtype=dtype)
+        return NDArray(jax.device_put(host, ctx.jax_device()), ctx)
     arr = jnp.asarray(data, dtype=dtype)
     if ctx is not None:
         arr = jax.device_put(arr, ctx.jax_device())
     return NDArray(arr, ctx)
+
+
+# ---------------------------------------------------------------------- #
+# device placement (the device-prefetch input pipeline's H2D stage)
+# ---------------------------------------------------------------------- #
+
+def _placement_target(device):
+    """Normalize a placement spec into the one object ``jax.device_put``
+    accepts: a ``Context``/``jax.Device`` resolves to that device; a
+    ``jax.sharding.Sharding`` passes through; a multi-element list of
+    contexts/devices becomes a batch-axis ``NamedSharding`` so ONE
+    ``device_put`` lands each device's slice pre-sharded (data-parallel
+    feeds with no per-replica host slicing)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec, Sharding
+    if device is None:
+        return None
+    if isinstance(device, Context):
+        return device.jax_device()
+    if isinstance(device, Sharding):
+        return device
+    if isinstance(device, (list, tuple)):
+        devs = [d.jax_device() if isinstance(d, Context) else d
+                for d in device]
+        if not devs:
+            raise MXNetError("empty device list")
+        if not all(isinstance(d, jax.Device) for d in devs):
+            raise MXNetError(f"invalid device list {device!r}")
+        if len(devs) == 1:
+            return devs[0]
+        return NamedSharding(Mesh(onp.array(devs), ("dp",)),
+                             PartitionSpec("dp"))
+    if isinstance(device, jax.Device):
+        return device
+    raise MXNetError(
+        f"cannot interpret {device!r} as a Context, jax.Device, Sharding, "
+        "or list of contexts/devices")
+
+
+def _device_put_leaf(arr, target):
+    """One async ``device_put``.  A batch whose leading dim doesn't divide
+    a batch-axis sharding (e.g. the ``last_batch='keep'`` tail) is placed
+    replicated on the same mesh instead — every device still holds it, and
+    consumers (``split_and_load``) fall back to slicing for that batch."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    try:
+        return jax.device_put(arr, target)
+    except ValueError:
+        if isinstance(target, NamedSharding):
+            return jax.device_put(
+                arr, NamedSharding(target.mesh, PartitionSpec()))
+        raise
+
+
+def to_device(data, device):
+    """Asynchronously place a batch on a device (or pre-sharded across
+    devices).
+
+    ``data`` may be an :class:`NDArray`, a numpy/jax array, or an
+    arbitrarily nested list/tuple/dict of them (the shapes batchify
+    functions produce); ``device`` accepts everything
+    :func:`_placement_target` does.  Returns the same structure with every
+    array leaf replaced by a device-resident :class:`NDArray` whose
+    transfer is already in flight — nothing blocks (``jax.device_put`` is
+    async under XLA), which is what lets the prefetch ring overlap H2D of
+    batch ``k+1`` with step ``k``."""
+    target = _placement_target(device)
+    if target is None:
+        return data
+    return _place_tree(data, target)
+
+
+def _place_tree(x, target):
+    if isinstance(x, NDArray):
+        out = _wrap_like(_device_put_leaf(x._data, target), x)
+        out._ctx = None  # context now derives from the actual placement
+        return out
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # namedtuple
+        return type(x)(*(_place_tree(v, target) for v in x))
+    if isinstance(x, (list, tuple)):
+        return type(x)(_place_tree(v, target) for v in x)
+    if isinstance(x, dict):
+        return {k: _place_tree(v, target) for k, v in x.items()}
+    if isinstance(x, (onp.ndarray, jax.Array)):
+        return NDArray(_device_put_leaf(x, target))
+    return x
 
 
 def empty(shape, ctx=None, dtype=None):

@@ -238,6 +238,286 @@ class TestImage:
         assert b.label[0].shape[0] == 2 and b.label[0].shape[2] == 5
 
 
+def _dev_id(arr):
+    return list(arr._data.devices())[0].id
+
+
+class TestMultiWorkerIter:
+    """Satellites: ordering, last_batch modes, explicit prefetch, early-
+    break cleanup, timeout raise (ISSUE 3)."""
+
+    def _ds(self, n=17):
+        return ArrayDataset(onp.arange(3 * n, dtype=onp.float32).reshape(n, 3),
+                            onp.arange(n, dtype=onp.float32))
+
+    def test_order_matches_serial_across_worker_counts(self):
+        ds = self._ds()
+        serial = [x.asnumpy() for x, _ in DataLoader(ds, batch_size=4,
+                                                     last_batch="keep")]
+        for nw in (1, 2, 4):
+            threaded = [x.asnumpy() for x, _ in
+                        DataLoader(ds, batch_size=4, last_batch="keep",
+                                   num_workers=nw)]
+            assert len(threaded) == len(serial)
+            for a, b in zip(serial, threaded):
+                onp.testing.assert_array_equal(a, b)
+
+    @pytest.mark.parametrize("last_batch,want", [("keep", 5),
+                                                 ("discard", 4),
+                                                 ("rollover", 4)])
+    def test_last_batch_modes_with_workers(self, last_batch, want):
+        dl = DataLoader(self._ds(17), batch_size=4, last_batch=last_batch,
+                        num_workers=2)
+        assert len([b for b in dl]) == want
+
+    def test_explicit_prefetch_honored(self):
+        it = iter(DataLoader(self._ds(), batch_size=4, num_workers=4,
+                             prefetch=1))
+        assert it._prefetch == 1  # not silently raised to 2*num_workers
+        it2 = iter(DataLoader(self._ds(), batch_size=4, num_workers=4))
+        assert it2._prefetch == 8  # default stays 2*num_workers
+        it.shutdown()
+        it2.shutdown()
+
+    def test_early_break_shuts_down_executor(self):
+        import gc
+        dl = DataLoader(self._ds(), batch_size=2, num_workers=2)
+        it = iter(dl)
+        next(it)  # abandon the epoch after one batch
+        executor = it._executor
+        del it  # queued work items hold a bound-method cycle → needs gc
+        gc.collect()
+        assert executor._shutdown
+
+    def test_timeout_raises_with_batch_index(self):
+        import time as _time
+
+        class SlowDataset(SimpleDataset):
+            def __getitem__(self, idx):
+                _time.sleep(1.5)
+                return super().__getitem__(idx)
+
+        dl = DataLoader(SlowDataset(list(range(8))), batch_size=2,
+                        num_workers=1, timeout=0.2)
+        with pytest.raises(mx.MXNetError, match="batch 0"):
+            next(iter(dl))
+
+    def test_worker_error_propagates_and_cleans_up(self):
+        class BadDataset(SimpleDataset):
+            def __getitem__(self, idx):
+                raise ValueError("boom")
+
+        it = iter(DataLoader(BadDataset(list(range(8))), batch_size=2,
+                             num_workers=1))
+        with pytest.raises(ValueError, match="boom"):
+            next(it)
+        assert it._executor._shutdown
+
+
+class TestDevicePrefetch:
+    """Tentpole: device-resident / pre-sharded prefetched batches
+    (ISSUE 3).  Runs on the 8-device virtual CPU platform."""
+
+    def _ds(self, n=16):
+        return ArrayDataset(onp.arange(3 * n, dtype=onp.float32).reshape(n, 3),
+                            onp.arange(n, dtype=onp.float32))
+
+    def _serial(self, ds, bs=4):
+        return [x.asnumpy() for x, _ in DataLoader(ds, batch_size=bs)]
+
+    def test_batches_device_resident_and_bit_identical(self):
+        ds = self._ds()
+        ref = self._serial(ds)
+        dl = DataLoader(ds, batch_size=4, device=mx.Context("cpu", 1))
+        got = list(dl)
+        assert len(got) == len(ref)
+        for (x, y), r in zip(got, ref):
+            assert _dev_id(x) == 1 and _dev_id(y) == 1
+            onp.testing.assert_array_equal(x.asnumpy(), r)
+
+    def test_multiworker_device_order_and_residency(self):
+        ds = self._ds()
+        ref = self._serial(ds)
+        for dp in (2, 8):  # ring path (2 < prefetch) and worker-place path
+            dl = DataLoader(ds, batch_size=4, num_workers=2,
+                            device=mx.Context("cpu", 2), device_prefetch=dp)
+            for (x, _), r in zip(dl, ref):
+                assert _dev_id(x) == 2
+                onp.testing.assert_array_equal(x.asnumpy(), r)
+
+    def test_env_zero_restores_synchronous_path(self, monkeypatch):
+        monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "0")
+        ds = self._ds()
+        dl = DataLoader(ds, batch_size=4, device=mx.Context("cpu", 1),
+                        device_prefetch=4)
+        it = iter(dl)
+        from mxnet_tpu.gluon.data.dataloader import DevicePrefetchIter
+        assert isinstance(it, DevicePrefetchIter)
+        assert it._depth == 0 and it._thread is None  # no ring, no thread
+        for (x, _), r in zip(it, self._serial(ds)):
+            assert _dev_id(x) == 1  # placement still honored
+            onp.testing.assert_array_equal(x.asnumpy(), r)
+
+    def test_sharded_placement_over_device_list(self):
+        ctxs = [mx.Context("cpu", i) for i in range(4)]
+        dl = DataLoader(self._ds(), batch_size=8, device=ctxs)
+        xb, yb = next(iter(dl))
+        sh = xb._data.sharding
+        assert len(sh.device_set) == 4 and not sh.is_fully_replicated
+        shapes = {tuple(s.data.shape) for s in xb._data.addressable_shards}
+        assert shapes == {(2, 3)}
+
+    def test_split_and_load_uses_resident_shards(self):
+        from mxnet_tpu.gluon.utils import split_and_load
+        ctxs = [mx.Context("cpu", i) for i in range(4)]
+        xb, _ = next(iter(DataLoader(self._ds(), batch_size=8, device=ctxs)))
+        full = xb.asnumpy()
+        parts = split_and_load(xb, ctxs)
+        for i, p in enumerate(parts):
+            assert _dev_id(p) == i
+            onp.testing.assert_array_equal(p.asnumpy(), full[2 * i:2 * i + 2])
+
+    def test_partial_tail_batch_replicates(self):
+        ctxs = [mx.Context("cpu", i) for i in range(4)]
+        batches = list(DataLoader(self._ds(14), batch_size=4, device=ctxs,
+                                  last_batch="keep"))
+        tail = batches[-1][0]
+        assert tail.shape == (2, 3)  # 14 = 3*4 + 2
+        assert tail._data.sharding.is_fully_replicated
+
+    def test_early_break_cleans_both_layers(self):
+        dl = DataLoader(self._ds(), batch_size=2, num_workers=2,
+                        device=mx.Context("cpu", 1), device_prefetch=1)
+        it = iter(dl)
+        next(it)
+        inner = it._source
+        it.close()
+        assert inner._closed and inner._executor._shutdown
+
+    def test_explicit_sharding_object(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(onp.array(jax.devices()[:2]), ("dp",))
+        sh = NamedSharding(mesh, PartitionSpec("dp"))
+        xb, _ = next(iter(DataLoader(self._ds(), batch_size=4, device=sh)))
+        assert xb._data.sharding == sh
+
+    def test_standalone_iter_over_plain_iterable(self):
+        from mxnet_tpu.gluon.data import DevicePrefetchIter
+        src = [onp.full((2, 2), i, onp.float32) for i in range(5)]
+        out = list(DevicePrefetchIter(iter(src), mx.Context("cpu", 3),
+                                      depth=2))
+        assert len(out) == 5
+        for i, x in enumerate(out):
+            assert _dev_id(x) == 3
+            onp.testing.assert_array_equal(x.asnumpy(), src[i])
+
+    def test_source_error_propagates(self):
+        from mxnet_tpu.gluon.data import DevicePrefetchIter
+
+        def bad():
+            yield onp.zeros((2, 2), onp.float32)
+            raise RuntimeError("pipeline broke")
+
+        it = DevicePrefetchIter(bad(), mx.Context("cpu", 0), depth=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="pipeline broke"):
+            next(it)
+        with pytest.raises(StopIteration):  # terminal, must not block
+            next(it)
+
+    def test_next_after_exhaustion_raises_not_hangs(self):
+        from mxnet_tpu.gluon.data import DevicePrefetchIter
+        it = DevicePrefetchIter(iter([onp.zeros((2,), onp.float32)]),
+                                mx.Context("cpu", 0), depth=2)
+        assert len(list(it)) == 1
+        for _ in range(2):  # repeated next() past the single end marker
+            with pytest.raises(StopIteration):
+                next(it)
+
+    def test_io_prefetching_iter_producer_error_propagates(self):
+        class BadIter(mx.io.DataIter):
+            def next(self):
+                raise RuntimeError("decode failed")
+
+        p = mx.io.PrefetchingIter(BadIter(batch_size=2),
+                                  device=mx.Context("cpu", 1))
+        with pytest.raises(RuntimeError, match="decode failed"):
+            p.next()
+
+    def test_io_env_zero_keeps_hostside_thread_without_device(self,
+                                                              monkeypatch):
+        monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "0")
+        it = mx.io.NDArrayIter(
+            onp.arange(12, dtype=onp.float32).reshape(6, 2), onp.zeros(6),
+            batch_size=2)
+        p = mx.io.PrefetchingIter(it)  # no device: escape hatch inert
+        assert not p._sync and p._thread is not None
+        assert len(list(p)) == 3
+
+    def test_io_prefetching_iter_device(self):
+        it = mx.io.NDArrayIter(
+            onp.arange(12, dtype=onp.float32).reshape(6, 2), onp.zeros(6),
+            batch_size=2)
+        p = mx.io.PrefetchingIter(it, device=mx.Context("cpu", 5))
+        bs = list(p)
+        assert len(bs) == 3
+        assert all(_dev_id(b.data[0]) == 5 for b in bs)
+        p.reset()
+        assert len(list(p)) == 3
+
+    def test_io_prefetching_iter_env_zero_sync(self, monkeypatch):
+        monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "0")
+        it = mx.io.NDArrayIter(
+            onp.arange(12, dtype=onp.float32).reshape(6, 2), onp.zeros(6),
+            batch_size=2)
+        p = mx.io.PrefetchingIter(it, device=mx.Context("cpu", 4))
+        assert p._sync and p._thread is None
+        bs = list(p)
+        assert len(bs) == 3 and all(_dev_id(b.data[0]) == 4 for b in bs)
+
+    def test_estimator_wraps_epoch_iterator(self):
+        from mxnet_tpu import gluon
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+        from mxnet_tpu.gluon.data.dataloader import DevicePrefetchIter
+        net = gluon.nn.Dense(2, in_units=3)
+        data = [(onp.ones((2, 3), onp.float32), onp.zeros((2, 2), onp.float32))]
+        # accelerator context (degrades to host device here): ring engaged
+        est = Estimator(net, gluon.loss.L2Loss(),
+                        context=mx.Context("tpu", 0))
+        it = est._prefetched(data)
+        assert isinstance(it, DevicePrefetchIter)
+        batches = list(it)
+        assert len(batches) == 1 and isinstance(batches[0][0], mx.nd.NDArray)
+        # host context: inert, plain iteration
+        est2 = Estimator(net, gluon.loss.L2Loss(),
+                         context=mx.Context("cpu", 0))
+        assert not isinstance(est2._prefetched(data), DevicePrefetchIter)
+
+    def test_nd_array_ctx_single_hop(self):
+        a = mx.nd.array(onp.arange(6, dtype=onp.int64), ctx=mx.Context("cpu", 3))
+        assert str(a.dtype) == "int32" and _dev_id(a) == 3  # canonicalized
+        b = mx.nd.array([1.5, 2.5], ctx=mx.Context("cpu", 2))
+        assert str(b.dtype) == "float32" and _dev_id(b) == 2
+
+
+class TestInputPipelineBenchSmoke:
+    """The overlap measurement can't bit-rot: --smoke runs the h2d stage
+    at tiny sizes with no PIL/native dependency (ISSUE 3 CI satellite)."""
+
+    def test_smoke_mode_emits_overlap_rows(self, capsys):
+        import json
+        import benchmark.input_pipeline_bench as bench
+        assert bench.main(["--smoke"]) == 0
+        rows = [json.loads(l) for l in
+                capsys.readouterr().out.strip().splitlines()]
+        stages = {r["stage"] for r in rows}
+        assert {"h2d_input_only", "h2d_compute_only", "h2d_step_sync",
+                "h2d_step_overlap"} <= stages
+        overlap = next(r for r in rows if r["stage"] == "h2d_step_overlap")
+        assert overlap["ms_per_step"] > 0 and overlap["speedup_vs_sync"] > 0
+
+
 class TestBatchify:
     def test_pad_variable_lengths(self):
         from mxnet_tpu.gluon.data import batchify
