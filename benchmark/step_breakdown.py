@@ -20,12 +20,44 @@ import numpy as onp
 PEAK_TFLOPS = 197.0  # v5e bf16
 
 
+def emit_fused_step_rows(platform, smoke=False):
+    """Section 8: the whole train step as ONE donated-buffer executable
+    (``Trainer.fused_step``) vs the phase-by-phase chain, with the
+    gradient-accumulation window sweep — methodology shared with
+    step_profile (``measure_fused_step``)."""
+    from benchmark.step_profile import measure_fused_step
+    kw = dict(n_layers=8, units=8, bs=4, reps=3, intervals=(1, 2),
+              warm=2) if smoke else {}
+    n, rows = measure_fused_step(**kw)
+    for mode, disp, dt in rows:
+        name = "train_step_phase" if mode.startswith("phase") else \
+            "train_step_fused_" + mode.split("N=")[-1].strip()
+        print(json.dumps({
+            "bench": "step_breakdown",
+            "component": name,
+            "ms": round(dt, 3),
+            "params": n,
+            "host_dispatches_per_step": round(disp),
+            "platform": platform}))
+        sys.stdout.flush()
+
+
 def main():
+    import argparse
+
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="fused-step section only, tiny sizes (tier-1 "
+                         "gate)")
+    args = ap.parse_args()
     platform = jax.devices()[0].platform
+    if args.smoke:
+        emit_fused_step_rows(platform, smoke=True)
+        return 0
     B, L, U, H, FF, V = 64, 128, 768, 12, 3072, 30528
     NL = 12
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
@@ -241,6 +273,10 @@ def main():
             "apply_dispatches_per_step": round(disp),
             "platform": platform}))
         sys.stdout.flush()
+
+    # 8. fused train step: fwd+bwd+apply as ONE executable, accumulate
+    # window sweep
+    emit_fused_step_rows(platform)
     return 0
 
 

@@ -197,6 +197,134 @@ def measure_optimizer_apply(params, opt_name, reps=10):
     return len(live), rows
 
 
+def measure_fused_step(n_layers=200, units=64, bs=32, reps=10,
+                       intervals=(1, 4), opt_name="adamw", warm=2):
+    """Fused-step phase: the whole train step (forward + loss + backward
+    + optimizer apply) as ONE donated-buffer XLA executable
+    (``Trainer.fused_step``) vs today's phase-by-phase chain (jitted
+    CachedOp forward → tape backward → fused ``multi_update`` apply) on
+    the BASELINE 200-param workload (``n_layers`` chained bias-free
+    Dense(units) layers = n_layers (units,units) f32 params).  Sweeps the
+    gradient-accumulation window (``Trainer(update_interval=N)``): the N
+    amortizes the optimizer apply + its host bookkeeping over the window.
+    Returns ``(n_params, [(mode, host_dispatches_per_step, ms_per_step)])``
+    — one implementation shared by step_profile and step_breakdown.
+    ``host_dispatches_per_step`` counts registry invokes + jitted apply
+    calls on the phase path, and fused-step executable invocations on the
+    fused path (exactly 1)."""
+    import time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.fused_step import (step_counters,
+                                            reset_step_counters)
+    from mxnet_tpu.ndarray.ndarray import waitall
+    from mxnet_tpu.ops import registry as reg
+    from mxnet_tpu.optimizer import optimizer as opt_impl
+
+    rng = onp.random.RandomState(0)
+    X = rng.randn(bs, units).astype(onp.float32)
+    Y = rng.randn(bs, 1).astype(onp.float32)
+    loss_l = gluon.loss.L2Loss()
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(n_layers - 1):
+                net.add(nn.Dense(units, use_bias=False, in_units=units))
+            net.add(nn.Dense(1, use_bias=False, in_units=units))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    rows = []
+
+    # -- phase-by-phase (today's path) --------------------------------- #
+    net = build()
+    trainer = gluon.Trainer(net.collect_params(), opt_name,
+                            {"learning_rate": 1e-4}, kvstore=None)
+    x, y = mx.nd.array(X), mx.nd.array(Y)
+
+    def phase_step():
+        with mx.autograd.record():
+            loss = loss_l(net(x), y)
+        loss.backward()
+        trainer.step(bs)
+        return loss
+
+    for _ in range(warm):
+        phase_step()
+    waitall()
+    invokes = [0]
+    orig_invoke = reg.invoke
+
+    def counting_invoke(*a, **k):
+        invokes[0] += 1
+        return orig_invoke(*a, **k)
+
+    reg.invoke = counting_invoke
+    opt_impl.reset_apply_counters()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            phase_step()
+        waitall()
+        dt = (time.perf_counter() - t0) / reps * 1e3
+    finally:
+        reg.invoke = orig_invoke
+    disp = (invokes[0] + opt_impl.apply_counters["fused_calls"]
+            + opt_impl.apply_counters["fallback_params"]) / reps
+    rows.append(("phase-by-phase", disp, dt))
+
+    # -- fused step, accumulate window sweep --------------------------- #
+    for N in intervals:
+        net = build()
+        trainer = gluon.Trainer(net.collect_params(), opt_name,
+                                {"learning_rate": 1e-4}, kvstore=None,
+                                update_interval=N)
+
+        def loss_fn(xx, yy):
+            return loss_l(net(xx), yy)
+
+        # two full windows of warmup: the second window re-executes both
+        # executables on buffers PRODUCED by them (donation steady state)
+        warm_n = max(warm, 2 * N) + (-max(warm, 2 * N)) % N
+        for _ in range(warm_n):  # compile micro + apply executables
+            trainer.fused_step(loss_fn, x, y)
+        waitall()
+        reset_step_counters()
+        reps_n = max(N, reps - reps % N)  # whole windows only
+        t0 = time.perf_counter()
+        for _ in range(reps_n):
+            trainer.fused_step(loss_fn, x, y)
+        waitall()
+        dt = (time.perf_counter() - t0) / reps_n * 1e3
+        assert step_counters["compiles"] == 0, "retraced in steady state"
+        disp = step_counters["dispatches"] / reps_n
+        rows.append((f"fused step, N={N}", disp, dt))
+
+    n_params = len([p for p in net.collect_params().values()
+                    if p.grad_req != "null"])
+    return n_params, rows
+
+
+def profile_fused_step(smoke=False):
+    """Fused-step phase rows (imperative Trainer path): ms/step and
+    host-dispatch count, phase-by-phase vs one-executable, with the
+    gradient-accumulation window sweep."""
+    kw = dict(n_layers=8, units=8, bs=4, reps=3, intervals=(1, 2),
+              warm=2) if smoke else {}
+    n, rows = measure_fused_step(**kw)
+    print(f"\nfused-step phase (imperative Trainer, {n}-param chain, "
+          f"{'smoke' if smoke else 'baseline'} workload):")
+    for mode, disp, dt in rows:
+        print(f"  {mode:18s}: {disp:6.0f} host dispatches/step   "
+              f"{dt:8.2f} ms/step")
+    return rows
+
+
 def profile_optimizer_apply(trainer, iters=10):
     """Optimizer-apply phase row for the IMPERATIVE Trainer path (the
     API-parity path the SPMD profile above doesn't cover): the fused
@@ -287,8 +415,8 @@ def profile_input_overlap(trainer, x, y, steps=8, depth=2):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("model", choices=["resnet", "bert", "gpt",
-                                      "transformer"])
+    ap.add_argument("model", nargs="?",
+                    choices=["resnet", "bert", "gpt", "transformer"])
     ap.add_argument("--bs", type=int, default=0)
     ap.add_argument("--by", default="tf_op",
                     choices=["tf_op", "name", "category", "source"])
@@ -298,7 +426,23 @@ def main():
                     help="skip the imperative optimizer-apply phase row")
     ap.add_argument("--no-input-phase", action="store_true",
                     help="skip the input-pipeline / H2D overlap phase rows")
+    ap.add_argument("--no-fused-step-phase", action="store_true",
+                    help="skip the fused-step phase rows")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fused-step phase rows only (tier-1 gate: "
+                         "no model build, no trace, runs on CPU in "
+                         "seconds)")
     args = ap.parse_args()
+
+    if args.smoke:
+        rows = profile_fused_step(smoke=True)
+        # the smoke gate checks the mechanism, not the speedup (CPU
+        # timing at toy sizes is noise): every fused row must be exactly
+        # one executable dispatch per step
+        assert all(d == 1 for m, d, _ in rows if m.startswith("fused"))
+        return 0
+    if args.model is None:
+        ap.error("model is required unless --smoke")
 
     import jax
 
@@ -349,6 +493,8 @@ def main():
         profile_input_overlap(trainer, x, y)
     if not args.no_opt_phase:
         profile_optimizer_apply(trainer)
+    if not args.no_fused_step_phase:
+        profile_fused_step()
     return 0
 
 

@@ -29,7 +29,7 @@ from .base import MXNetError
 __all__ = [
     "record", "pause", "train_mode", "predict_mode", "is_recording",
     "is_training", "set_recording", "set_training", "mark_variables",
-    "backward", "grad", "Function", "get_symbol",
+    "backward", "grad", "Function", "get_symbol", "trace_value_and_grad",
 ]
 
 _STATE = threading.local()
@@ -370,6 +370,73 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     with pause(train_mode=train_mode):
         outs = _backward_walk(heads, head_grads, targets, retain_graph)
     return outs[0] if single else outs
+
+
+def trace_value_and_grad(fn, params, frozen_params=(), train_mode=True):
+    """Grad-and-value capture for the fused train step — the tape is never
+    materialized.
+
+    Where ``record()``/``backward()`` append one TapeNode per op and walk
+    it afterwards, this functionalizes the whole ``fn`` call (forward +
+    loss) and differentiates it with ``jax.value_and_grad``, so a single
+    XLA program carries forward AND backward (the reference's
+    whole-step-behind-CachedOp amalgamation, SURVEY.md §4.2).  Returns a
+    PURE function, intended to be traced inside ``jax.jit``::
+
+        pure(key, train_vals, frozen_vals, *args)
+            -> (outs, grads, new_frozen_vals)
+
+    - ``fn`` is NDArray-level user code (e.g. ``lambda x, y:
+      loss(net(x), y)``); it may return a single loss or a tuple whose
+      FIRST element is the loss (extra outputs — predictions — ride along
+      undifferentiated).
+    - ``params``/``frozen_params`` are the Parameters whose values ride
+      in as ``train_vals``/``frozen_vals`` operands (CachedOp's
+      weights-as-arguments discipline, via ``params_swapped``).
+    - The backward is seeded with the gradient of ``sum(loss)`` — the
+      identical seeding to ``loss.backward()`` on the tape path.
+    - ``new_frozen_vals`` are the frozen params' values with staged aux
+      updates (BN moving stats) applied, aligned with ``frozen_params``.
+    - ``pure.out_struct['is_seq']`` records (at first trace) whether
+      ``fn`` returned a sequence.
+    """
+    from .gluon.block import trace_scope
+    from .gluon.parameter import params_swapped
+    from .ndarray.ndarray import NDArray
+
+    params = list(params)
+    frozen = list(frozen_params)
+    all_params = params + frozen
+    struct: dict = {}
+
+    def run(key, train_vals, frozen_vals, args):
+        all_vals = list(train_vals) + list(frozen_vals)
+        with trace_scope(key, train_mode) as aux:
+            with params_swapped(all_params, all_vals):
+                nd_args = [a if isinstance(a, NDArray) else NDArray(a)
+                           for a in args]
+                out = fn(*nd_args)
+        is_seq = isinstance(out, (tuple, list))
+        struct["is_seq"] = is_seq
+        outs = [o._data if isinstance(o, NDArray) else o
+                for o in (out if is_seq else [out])]
+        aux_by_id = {id(p): jax.lax.stop_gradient(v)
+                     for (p, v) in aux.values()}
+        new_frozen = [aux_by_id.get(id(p), v)
+                      for p, v in zip(frozen, frozen_vals)]
+        return outs, new_frozen
+
+    def pure(key, train_vals, frozen_vals, *args):
+        def loss_of(tv):
+            outs, new_frozen = run(key, tv, frozen_vals, args)
+            return jnp.sum(outs[0]), (outs, new_frozen)
+
+        (_, (outs, new_frozen)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(tuple(train_vals))
+        return tuple(outs), grads, new_frozen
+
+    pure.out_struct = struct
+    return pure
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

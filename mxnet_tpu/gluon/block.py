@@ -21,6 +21,7 @@ execution — no tracer ever leaks into a Parameter.
 """
 from __future__ import annotations
 
+import contextlib
 import re
 import threading
 from collections import OrderedDict
@@ -34,7 +35,8 @@ from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "_TraceState"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "_TraceState",
+           "trace_scope"]
 
 
 # --------------------------------------------------------------------------- #
@@ -103,6 +105,29 @@ class _TraceState(threading.local):
 
 
 _trace_state = _TraceState()
+
+
+@contextlib.contextmanager
+def trace_scope(key, training):
+    """The CachedOp trace discipline as a reusable scope, shared by
+    ``_CachedOp`` tracing, ``SPMDTrainer``'s fused SPMD step and the
+    fused train step (``gluon/fused_step.py``): aux-state updates (BN
+    moving stats) are STAGED functionally instead of mutating Parameters,
+    the RNG ``key`` is threaded to random ops (``mxrandom.next_key``
+    splits it instead of the eager global key), autograd is paused in
+    ``training`` mode, and nested CachedOps are inlined (``_no_hybrid``).
+    Yields the aux OrderedDict ``id(param) -> (param, staged_value)``."""
+    from .. import autograd, random as mxrandom
+
+    aux: OrderedDict = OrderedDict()
+    _trace_state.stack.append(aux)
+    mxrandom.push_trace_key(key)
+    try:
+        with autograd.pause(train_mode=training), _no_hybrid():
+            yield aux
+    finally:
+        mxrandom.pop_trace_key()
+        _trace_state.stack.pop()
 
 
 def commit_aux(param: Parameter, value):
@@ -544,23 +569,14 @@ class _CachedOp:
         param_objs = [p for _, p in self._param_list]
 
         def fn(key, *arrays):
-            from .. import autograd, random as mxrandom
             from .parameter import params_swapped
             n = len(param_objs)
             param_vals, inputs = arrays[:n], arrays[n:]
-            aux: OrderedDict = OrderedDict()
-            _trace_state.stack.append(aux)
-            mxrandom.push_trace_key(key)
-            try:
+            with trace_scope(key, training) as aux:
                 with params_swapped(param_objs, param_vals):
                     nd_inputs = [NDArray(x) if not isinstance(x, NDArray)
                                  else x for x in inputs]
-                    with autograd.pause(train_mode=training):
-                        with _no_hybrid():
-                            out = block.forward(*nd_inputs)
-            finally:
-                mxrandom.pop_trace_key()
-                _trace_state.stack.pop()
+                    out = block.forward(*nd_inputs)
 
             is_seq = isinstance(out, (tuple, list))
             outs = list(out) if is_seq else [out]

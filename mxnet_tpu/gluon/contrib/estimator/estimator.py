@@ -16,7 +16,8 @@ from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
 
 class Estimator:
     def __init__(self, net, loss, metrics=None, initializer=None,
-                 trainer=None, context=None, device_prefetch=None):
+                 trainer=None, context=None, device_prefetch=None,
+                 fused_step=False):
         from .... import init as init_mod, context as ctx_mod
         self.net = net
         if not isinstance(loss, loss_mod.Loss):
@@ -27,6 +28,12 @@ class Estimator:
             else [metrics]
         self.context = context or ctx_mod.current_context()
         self._device_prefetch = device_prefetch
+        # opt-in fast path: when the net is hybridized, fit() runs each
+        # batch through Trainer.fused_step — forward+loss+backward+
+        # optimizer apply as ONE donated-buffer XLA dispatch instead of
+        # the record/backward/step phase chain (MXNET_FUSED_STEP=0 or an
+        # unsupported Trainer config falls back transparently)
+        self._fused_step = bool(fused_step)
         if not self._net_initialized():
             self.net.initialize(initializer or init_mod.Xavier(),
                                 ctx=self.context)
@@ -103,6 +110,14 @@ class Estimator:
         if not any(isinstance(h, LoggingHandler) for h in handlers):
             handlers.append(LoggingHandler(metrics=self.train_metrics))
 
+        use_fused = (self._fused_step
+                     and getattr(self.net, "_active", False)
+                     and hasattr(self.trainer, "fused_step"))
+
+        def _fused_loss(x, y):
+            pred = self.net(x)
+            return self.loss(pred, y), pred
+
         train_begin = self._sorted(handlers, TrainBegin)
         epoch_begin = self._sorted(handlers, EpochBegin)
         batch_begin = self._sorted(handlers, BatchBegin)
@@ -119,11 +134,16 @@ class Estimator:
                 data, label = self._unpack(batch)
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(data.shape[batch_axis])
+                if use_fused:
+                    loss, pred = self.trainer.fused_step(
+                        _fused_loss, data, label,
+                        batch_size=data.shape[batch_axis])
+                else:
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                    self.trainer.step(data.shape[batch_axis])
                 for h in batch_end:
                     h.batch_end(self, batch=batch, pred=pred, label=label,
                                 loss=loss)

@@ -28,7 +28,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, update_interval=1):
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -53,6 +53,21 @@ class Trainer:
         self._states = [None] * len(self._params)
         self._states_created = [False] * len(self._params)
         self._optimizer_registered_on_kv = False
+        # gradient accumulation: apply the optimizer (and replica sync)
+        # every Nth step() / fused_step() call; grads of the window's
+        # micro-batches accumulate (on device on the fused path, in the
+        # grad_req='add' buffers on the legacy path)
+        self._update_interval = int(update_interval)
+        if self._update_interval < 1:
+            raise MXNetError("update_interval must be >= 1")
+        self._window_pos = 0   # micro-batches seen in the current window
+        # True while FusedStep's phase-by-phase fallback drives step():
+        # it accumulates 'write' grads itself, so the grad_req guard in
+        # step() must not fire
+        self._accum_managed = False
+        # id(loss_fn) -> FusedStep, strong refs (so ids stay unique),
+        # FIFO-capped — see fused_step()
+        self._fused_steps = {}
 
     def _init_optimizer(self, optimizer, optimizer_params):
         # kvstore keys are strings — register both forms so per-param
@@ -140,11 +155,60 @@ class Trainer:
         (the ``data.shape[0]`` idiom) nothing here reads a device value
         back to host, so a training loop fed by the device-prefetch input
         pipeline (``DataLoader(device=...)``) keeps batch ``k+1``'s host
-        decode + H2D copy overlapped with this step's device compute."""
+        decode + H2D copy overlapped with this step's device compute.
+
+        With ``Trainer(update_interval=N)``, ``batch_size`` is the
+        MICRO-batch size: the first N-1 calls of each window only count
+        (grads keep accumulating — use ``grad_req='add'`` or
+        ``fused_step``); the Nth call allreduces, rescales ONCE by the
+        effective batch ``N * batch_size``, applies the optimizer, and
+        resets the ``'add'`` accumulators for the next window."""
         self._init_kvstore()
+        if self._update_interval > 1:
+            self._window_pos += 1
+            if self._window_pos == 1 and not self._accum_managed:
+                # a 'write' grad buffer is OVERWRITTEN by each backward:
+                # mid-window micro-batches would be silently discarded —
+                # fail loudly at the window's first step() instead
+                bad = [p.name for p in self._params
+                       if p.grad_req == "write"]
+                if bad:
+                    raise MXNetError(
+                        "Trainer(update_interval="
+                        f"{self._update_interval}) with step() requires "
+                        "grad_req='add' so micro-batch gradients "
+                        "accumulate; these parameters have "
+                        f"grad_req='write' (first: {bad[0]}) and each "
+                        "backward would overwrite, not accumulate. Set "
+                        "grad_req='add' (then zero_grad() is automatic "
+                        "at the window boundary) or drive the window "
+                        "with fused_step(), which accumulates on "
+                        "device.")
+            if self._window_pos < self._update_interval:
+                return  # mid-window micro-batch: accumulate only
+            self._window_pos = 0
+            self._optimizer.rescale_grad = self._scale / float(
+                batch_size * self._update_interval)
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
+            for p in self._params:
+                if p.grad_req == "add":
+                    p.zero_grad()
+            return
         self._optimizer.rescale_grad = self._scale / float(batch_size)
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _check_window_boundary(self, what):
+        if self._update_interval > 1 and self._window_pos != 0:
+            raise MXNetError(
+                f"{what} called mid-accumulation window (micro-batch "
+                f"{self._window_pos}/{self._update_interval} of "
+                f"Trainer(update_interval={self._update_interval})): "
+                "syncing partial gradients would corrupt the accumulated "
+                "update; call it only at the window boundary (after the "
+                "Nth backward), or let step()/fused_step() drive the "
+                "window")
 
     def allreduce_grads(self):
         """Explicit allreduce for the clip-then-update pattern."""
@@ -152,6 +216,7 @@ class Trainer:
         if self._update_on_kvstore:
             raise MXNetError(
                 "allreduce_grads() is not supported with update_on_kvstore")
+        self._check_window_boundary("allreduce_grads()")
         self._allreduce_grads()
 
     def _allreduce_grads(self):
@@ -174,13 +239,76 @@ class Trainer:
                 self._kvstore.pushpull(i, p.list_grad(), out=p.list_grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
-        """Update-only half of step (after manual allreduce + clipping)."""
+        """Update-only half of step (after manual allreduce + clipping).
+
+        With ``update_interval=N``, ``batch_size`` is the micro-batch
+        size and the rescale is by the effective batch ``N * batch_size``
+        — applied ONCE on the accumulated grads, not per micro-batch."""
         self._init_kvstore()
         if self._update_on_kvstore:
             raise MXNetError("update() is not supported with "
                              "update_on_kvstore")
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._check_window_boundary("update()")
+        self._optimizer.rescale_grad = self._scale / float(
+            batch_size * self._update_interval)
         self._update(ignore_stale_grad)
+
+    def zero_grad(self):
+        """Reset the gradient buffers of every managed parameter to zero
+        — the ``grad_req='add'`` accumulator reset that previously had to
+        be hand-rolled as a loop over ``collect_params().values()``."""
+        for p in self._params:
+            if p.grad_req != "null":
+                p.zero_grad()
+
+    def _ensure_state(self, i):
+        """Create optimizer state for param ``i`` once (shared by the
+        fused step compiler and the imperative update loop, so the two
+        paths interoperate on the same state list)."""
+        if not self._states_created[i]:
+            self._states[i] = \
+                self._optimizer.create_state_multi_precision(
+                    i, self._params[i].data())
+            self._states_created[i] = True
+
+    def fused_step(self, loss_fn, *batch, batch_size=None,
+                   data_sharding=None):
+        """One-executable train step: forward + loss + backward + grad
+        rescale + (GSPMD) replica reduction + optimizer apply compiled
+        into a single donated-buffer XLA dispatch
+        (``gluon/fused_step.py``) — the reference's whole-step CachedOp
+        amalgamation.  ``loss_fn(*batch)`` returns the per-sample loss
+        (or ``(loss, *extras)``); define it ONCE outside the loop.
+        ``batch_size`` defaults to ``batch[0].shape[0]``.  With
+        ``update_interval=N`` grads accumulate on device and the apply
+        (with its 1/(N·batch) rescale) fires every Nth call.  Pass
+        ``data_sharding`` (e.g. ``parallel.collectives.dp_sharding``) to
+        lay batches over the data axis so GSPMD compiles the grad
+        all-reduce into the step.  ``MXNET_FUSED_STEP=0`` or an
+        unsupported config (kvstore reduction, replicas, sparse, SGLD)
+        falls back to the phase-by-phase path with identical semantics.
+        On the fused path the tape and ``param.grad()`` buffers are never
+        touched — gradients live only inside the executable."""
+        from .fused_step import FusedStep
+
+        fs = self._fused_steps.get(id(loss_fn))
+        if fs is None:
+            if len(self._fused_steps) >= 16:
+                # a fresh lambda per loop iteration would otherwise pin
+                # one compiled step (executables + device accumulators)
+                # per call forever — evict oldest and tell the user once
+                self._fused_steps.pop(next(iter(self._fused_steps)))
+                if not getattr(self, "_fused_evict_warned", False):
+                    import warnings
+                    warnings.warn(
+                        "fused_step: more than 16 distinct loss_fn "
+                        "objects seen — define the loss_fn ONCE outside "
+                        "the training loop, or every call retraces",
+                        stacklevel=2)
+                    self._fused_evict_warned = True
+            fs = FusedStep(self, loss_fn, data_sharding=data_sharding)
+            self._fused_steps[id(loss_fn)] = fs
+        return fs(batch, batch_size)
 
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore:
@@ -197,10 +325,7 @@ class Trainer:
                 if ignore_stale_grad:
                     continue
                 raise MXNetError(f"parameter {p.name} not initialized")
-            if not self._states_created[i]:
-                self._states[i] = \
-                    self._optimizer.create_state_multi_precision(i, p.data())
-                self._states_created[i] = True
+            self._ensure_state(i)
             idxs.append(i)
             ws.append(p.data())
             gs.append(p.grad())

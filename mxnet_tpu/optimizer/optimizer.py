@@ -244,6 +244,31 @@ class Optimizer:
             (k, v) for k, v in self.__dict__.items()
             if k not in skip and isinstance(v, (bool, int, float, str))))
 
+    def _apply_one(self, w, g, s, lr, wd, t, rescale, clip, use_mp,
+                   has_clip):
+        """Pure single-parameter apply with the fused dtype discipline —
+        the ONE implementation behind both the jitted group apply
+        (``_build_fused_apply``) and the whole-step executable
+        (``fused_step_apply``), so the two paths cannot drift."""
+        if use_mp:
+            master, inner = s
+            g2 = g.astype(jnp.float32) * rescale
+            if has_clip:
+                g2 = jnp.clip(g2, -clip, clip)
+            nm, ni = self._update_rule(master, g2, inner, lr, wd, t)
+            return nm.astype(w.dtype), (nm, _cast_like(inner, ni))
+        # match the legacy per-param dtype discipline: grad is cast to
+        # the weight dtype BEFORE rescale/clip, and the new weight is
+        # rounded back (the traced f32 lr/wd scalars promote
+        # low-precision math to f32 — more accurate than the legacy
+        # loop, within 1 ulp of it)
+        g2 = g.astype(w.dtype) * rescale.astype(w.dtype)
+        if has_clip:
+            cl = clip.astype(w.dtype)
+            g2 = jnp.clip(g2, -cl, cl)
+        nw, ns = self._update_rule(w, g2, s, lr, wd, t)
+        return nw.astype(w.dtype), _cast_like(s, ns)
+
     def _build_fused_apply(self, use_mp, has_clip):
         """One pure pytree-level apply for a parameter group, jitted with
         weight/state buffer donation so the update is in-place at the XLA
@@ -254,31 +279,33 @@ class Optimizer:
         def apply_fn(ws, gs, ss, lrs, wds, ts, rescale, clip):
             new_ws, new_ss = [], []
             for i, (w, g, s) in enumerate(zip(ws, gs, ss)):
-                lr, wd, t = lrs[i], wds[i], ts[i]
-                if use_mp:
-                    master, inner = s
-                    g2 = g.astype(jnp.float32) * rescale
-                    if has_clip:
-                        g2 = jnp.clip(g2, -clip, clip)
-                    nm, ni = self._update_rule(master, g2, inner, lr, wd, t)
-                    new_ws.append(nm.astype(w.dtype))
-                    new_ss.append((nm, _cast_like(inner, ni)))
-                else:
-                    # match the legacy per-param dtype discipline: grad is
-                    # cast to the weight dtype BEFORE rescale/clip, and the
-                    # new weight is rounded back (the traced f32 lr/wd
-                    # scalars promote low-precision math to f32 — more
-                    # accurate than the legacy loop, within 1 ulp of it)
-                    g2 = g.astype(w.dtype) * rescale.astype(w.dtype)
-                    if has_clip:
-                        cl = clip.astype(w.dtype)
-                        g2 = jnp.clip(g2, -cl, cl)
-                    nw, ns = self._update_rule(w, g2, s, lr, wd, t)
-                    new_ws.append(nw.astype(w.dtype))
-                    new_ss.append(_cast_like(s, ns))
+                nw, ns = self._apply_one(w, g, s, lrs[i], wds[i], ts[i],
+                                         rescale, clip, use_mp, has_clip)
+                new_ws.append(nw)
+                new_ss.append(ns)
             return new_ws, new_ss
 
         return jax.jit(apply_fn, donate_argnums=(0, 2))
+
+    def fused_step_apply(self, ws, gs, ss, mp_flags, lrs, wds, ts, rescale):
+        """Pure (trace-safe) multi-tensor apply for use INSIDE a larger
+        jitted step — the fused train step (``gluon/fused_step.py``)
+        traces this directly so forward+backward+apply compile into ONE
+        executable; donation belongs to that enclosing executable, not
+        here.  ``mp_flags`` are per-parameter (a mixed bf16+master / f32
+        model applies in one pass instead of one call per group);
+        ``rescale`` is the traced scalar that carries the gradient-
+        accumulation 1/(N·batch) factor.  ``clip_gradient`` is read at
+        trace time (hyperparameter, part of the step's cache key)."""
+        has_clip = self.clip_gradient is not None
+        clip = jnp.float32(self.clip_gradient if has_clip else 0.0)
+        new_ws, new_ss = [], []
+        for i, (w, g, s, mp) in enumerate(zip(ws, gs, ss, mp_flags)):
+            nw, ns = self._apply_one(w, g, s, lrs[i], wds[i], ts[i],
+                                     rescale, clip, mp, has_clip)
+            new_ws.append(nw)
+            new_ss.append(ns)
+        return new_ws, new_ss
 
     def multi_update(self, indices, weights, grads, states):
         """Fused multi-tensor apply (the reference's ``multi_sgd_update``

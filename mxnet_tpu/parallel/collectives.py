@@ -26,7 +26,22 @@ from .._jax_compat import NO_CHECK as _NO_CHECK, shard_map
 from .mesh import Mesh, P, default_mesh, local_mesh_axes
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
-           "ring_pass"]
+           "ring_pass", "dp_sharding"]
+
+
+def dp_sharding(mesh: Optional[Mesh] = None, axis: str = "dp"):
+    """``NamedSharding`` laying a batch out over the data-parallel axis
+    (delegates to :func:`..mesh.data_sharding` — one definition).
+
+    The fused train step (``Trainer.fused_step(...,
+    data_sharding=dp_sharding(mesh))``) places its batch operands with
+    this sharding; with the parameters replicated (or GSPMD-sharded) over
+    the same mesh, the compiled step then CONTAINS the cross-replica
+    gradient all-reduce — the reference's per-step KVStore pushpull phase
+    folded into the one traced executable, inserted by GSPMD instead of
+    engine-scheduled ops (SURVEY.md §7 "KVStore")."""
+    from .mesh import data_sharding
+    return data_sharding(mesh, axis)
 
 
 def _unwrap(x):

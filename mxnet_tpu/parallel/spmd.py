@@ -16,7 +16,6 @@ each param to a ``PartitionSpec`` on the mesh.
 from __future__ import annotations
 
 import re
-from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -194,26 +193,18 @@ class SPMDTrainer:
         imperatively (ops dispatch straight to jnp on tracers), collect aux
         (running-stat) updates."""
         from ..ndarray.ndarray import NDArray
-        from ..gluon.block import _no_hybrid, _trace_state
+        from ..gluon.block import trace_scope
         from ..gluon.parameter import params_swapped
-        from .. import autograd, random as mxrandom
 
         all_params = self._train_params + self._frozen_params
         all_vals = list(train_vals) + list(frozen_vals)
-        aux: OrderedDict = OrderedDict()
-        _trace_state.stack.append(aux)
-        mxrandom.push_trace_key(key)
-        try:
-            with params_swapped(all_params, all_vals), \
-                    autograd.pause(train_mode=True), _no_hybrid():
+        with trace_scope(key, training=True) as aux:
+            with params_swapped(all_params, all_vals):
                 out = self._block(NDArray(data))
                 out0 = out[0] if isinstance(out, (list, tuple)) else out
                 loss = self._loss_fn(out0, NDArray(label))
                 loss_val = jnp.mean(loss._data if isinstance(loss, NDArray)
                                     else loss)
-        finally:
-            mxrandom.pop_trace_key()
-            _trace_state.stack.pop()
         aux_out.append([(p, jax.lax.stop_gradient(v))
                         for (p, v) in aux.values()])
         return loss_val

@@ -1,0 +1,643 @@
+"""Fused train step (Trainer.fused_step / gluon/fused_step.py).
+
+Parity: N micro-batches of size B through the fused, gradient-
+accumulating step (``Trainer(update_interval=N)``) must match ONE batch
+of size N*B through the legacy phase-by-phase path (record → tape
+backward → step) for SGD/Adam/AdamW including multi-precision — the
+grads are sums over the same N*B samples and the apply rescales once by
+1/(N*B) on both paths.  f32 comparisons use tight allclose (fused
+forward+backward is one XLA program; reassociation differs from the
+tape walk by ulps).
+
+Dispatch-count regression (ISSUE 4 acceptance): every fused_step call is
+exactly ONE XLA executable dispatch, the optimizer apply runs exactly
+once per update interval, zero ops go through the registry (no tape) in
+steady state, and the executable cache stops growing after the first
+window.
+
+Satellites: Trainer.zero_grad, effective-batch rescale on accumulated
+step(), mid-accumulation-window errors from allreduce_grads()/update(),
+MXNET_FUSED_STEP=0 escape hatch, estimator fused fit, benchmark smoke
+gates.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.fused_step import (fused_step_enabled,
+                                        reset_step_counters,
+                                        step_counters)
+from mxnet_tpu.optimizer.optimizer import (apply_counters,
+                                           reset_apply_counters)
+
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _build_net(seed=0, units=8, depth=3, bn=False, dtype=None):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(depth):
+            net.add(nn.Dense(units, activation="relu", in_units=units))
+            if bn:
+                net.add(nn.BatchNorm(in_channels=units))
+        net.add(nn.Dense(1, in_units=units))
+    net.initialize(mx.init.Xavier())
+    if dtype is not None:
+        net.cast(dtype)
+    return net
+
+
+def _data(n, units=8, dtype=onp.float32, seed=0):
+    rng = onp.random.RandomState(seed)
+    return (rng.randn(n, units).astype(dtype),
+            rng.randn(n, 1).astype(dtype))
+
+
+def _params_np(net):
+    return [onp.asarray(p.data()._data, onp.float32)
+            for p in net.collect_params().values()]
+
+
+def _run_fused(opt, opt_params, N, B, X, Y, windows=2, seed=0, bn=False,
+               dtype=None, cast=None):
+    net = _build_net(seed=seed, bn=bn, dtype=dtype)
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), opt, dict(opt_params),
+                       kvstore=None, update_interval=N)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    for w in range(windows):
+        for j in range(N):
+            sl = slice(j * B, (j + 1) * B)
+            xb, yb = X[sl], Y[sl]
+            if cast:
+                xb, yb = xb.astype(cast), yb.astype(cast)
+            loss = tr.fused_step(loss_fn, mx.nd.array(xb), mx.nd.array(yb))
+    return net, tr, loss
+
+
+def _run_legacy_big_batch(opt, opt_params, NB, X, Y, windows=2, seed=0,
+                          dtype=None, cast=None):
+    net = _build_net(seed=seed, dtype=dtype)
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), opt, dict(opt_params),
+                       kvstore=None)
+    xb, yb = (X.astype(cast), Y.astype(cast)) if cast else (X, Y)
+    for w in range(windows):
+        with mx.autograd.record():
+            loss = loss_l(net(mx.nd.array(xb)), mx.nd.array(yb))
+        loss.backward()
+        tr.step(NB)
+    return net, tr, loss
+
+
+# --------------------------------------------------------------------- #
+# parity: N micro-batches (fused, accumulated) == 1 batch of N*B (legacy)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("opt", ["sgd", "adam", "adamw"])
+@pytest.mark.parametrize("N", [1, 4])
+def test_accumulated_fused_matches_legacy_big_batch(opt, N):
+    B = 4
+    X, Y = _data(N * B)
+    kw = {"learning_rate": 0.05, "wd": 0.01}
+    if opt == "sgd":
+        kw["momentum"] = 0.9
+    netf, _, _ = _run_fused(opt, kw, N, B, X, Y)
+    netl, _, _ = _run_legacy_big_batch(opt, kw, N * B, X, Y)
+    for i, (a, b) in enumerate(zip(_params_np(netf), _params_np(netl))):
+        onp.testing.assert_allclose(
+            a, b, rtol=2e-5, atol=1e-6,
+            err_msg=f"{opt} N={N} param {i}: fused-accum != legacy-NB")
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_accumulated_fused_multi_precision(opt):
+    """bf16 weights + fp32 master: the fused accumulated step keeps the
+    weight bf16, carries the f32 master, and tracks the legacy big-batch
+    mp path (bf16-scale tolerance on weights, tight on masters)."""
+    N, B = 2, 4
+    X, Y = _data(N * B)
+    kw = {"learning_rate": 0.05, "multi_precision": True}
+    netf, trf, _ = _run_fused(opt, kw, N, B, X, Y, dtype="bfloat16",
+                              cast=jnp.bfloat16)
+    netl, trl, _ = _run_legacy_big_batch(opt, kw, N * B, X, Y,
+                                         dtype="bfloat16",
+                                         cast=jnp.bfloat16)
+    for p, s in zip([p for p in netf.collect_params().values()
+                     if p.grad_req != "null"],
+                    [trf._states[i] for i in trf._fused_steps[
+                        list(trf._fused_steps)[0]]._train_idx]):
+        assert p.data()._data.dtype == jnp.bfloat16
+        assert isinstance(s, tuple) and s[0].dtype == jnp.float32
+    masters_f = [s[0] for s in trf._states if isinstance(s, tuple)]
+    masters_l = [s[0] for s in trl._states if isinstance(s, tuple)]
+    assert masters_f and len(masters_f) == len(masters_l)
+    # masters advance in f32 but FROM bf16 gradients: N micro-batch
+    # grads (bf16 rounding per chunk) vs one N*B-batch grad differ at
+    # bf16 epsilon (~4e-3 relative) before the f32 apply even starts
+    for i, (a, b) in enumerate(zip(masters_f, masters_l)):
+        onp.testing.assert_allclose(
+            onp.asarray(a), onp.asarray(b), rtol=1e-2, atol=1e-4,
+            err_msg=f"{opt} master {i}")
+    for i, (a, b) in enumerate(zip(_params_np(netf), _params_np(netl))):
+        onp.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-2,
+                                    err_msg=f"{opt} bf16 weight {i}")
+
+
+def test_fused_clip_gradient_parity():
+    N, B = 2, 4
+    X, Y = _data(N * B)
+    kw = {"learning_rate": 0.05, "clip_gradient": 0.05}
+    netf, _, _ = _run_fused("adam", kw, N, B, X, Y)
+    netl, _, _ = _run_legacy_big_batch("adam", kw, N * B, X, Y)
+    for a, b in zip(_params_np(netf), _params_np(netl)):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_env_hatch_restores_phase_by_phase(monkeypatch):
+    """MXNET_FUSED_STEP=0: fused_step runs record → tape backward →
+    Trainer.step — same weights as hand-written phases, zero executable
+    dispatches, and parity with the env=1 fused result."""
+    B = 8
+    X, Y = _data(B)
+    kw = {"learning_rate": 0.05}
+    netf, _, _ = _run_fused("adam", kw, 1, B, X, Y)
+
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    assert not fused_step_enabled()
+    reset_step_counters()
+    netl, _, _ = _run_fused("adam", kw, 1, B, X, Y)
+    assert step_counters["legacy_steps"] == 2
+    assert step_counters["dispatches"] == 0
+    for a, b in zip(_params_np(netf), _params_np(netl)):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+    # and the hand-written phase loop lands on identical weights
+    net2 = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr2 = gluon.Trainer(net2.collect_params(), "adam", dict(kw),
+                        kvstore=None)
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = loss_l(net2(mx.nd.array(X)), mx.nd.array(Y))
+        loss.backward()
+        tr2.step(B)
+    for a, b in zip(_params_np(netl), _params_np(net2)):
+        onp.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+def test_env_hatch_accumulation_parity(monkeypatch):
+    """The fallback also accumulates: N micro-batches with
+    MXNET_FUSED_STEP=0 (grad_req='write' host accumulation) match the
+    big-batch update."""
+    N, B = 3, 4
+    X, Y = _data(N * B)
+    kw = {"learning_rate": 0.05}
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    netf, _, _ = _run_fused("sgd", kw, N, B, X, Y)
+    monkeypatch.delenv("MXNET_FUSED_STEP")
+    netl, _, _ = _run_legacy_big_batch("sgd", kw, N * B, X, Y)
+    for a, b in zip(_params_np(netf), _params_np(netl)):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_batchnorm_aux_updates_match_legacy_micro_path(monkeypatch):
+    """BN moving stats update once per micro-batch inside the executable
+    (staged aux, committed after the call) — identical to the legacy
+    per-micro path (env hatch), which is the right reference for aux
+    state (a big batch updates BN stats once, not N times)."""
+    B = 8
+    X, Y = _data(2 * B)
+    kw = {"learning_rate": 0.05}
+    netf, _, _ = _run_fused("sgd", kw, 2, B, X, Y, windows=1, bn=True)
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    netl, _, _ = _run_fused("sgd", kw, 2, B, X, Y, windows=1, bn=True)
+    for (n, pf), pl in zip(netf.collect_params().items(),
+                           netl.collect_params().values()):
+        onp.testing.assert_allclose(
+            onp.asarray(pf.data()._data, onp.float32),
+            onp.asarray(pl.data()._data, onp.float32),
+            rtol=2e-5, atol=1e-6, err_msg=n)
+
+
+# --------------------------------------------------------------------- #
+# dispatch-count regression (acceptance criterion)
+# --------------------------------------------------------------------- #
+
+def test_dispatch_count_one_executable_per_step_one_apply_per_interval():
+    """Acceptance: on the fused path every fused_step call is exactly ONE
+    XLA executable dispatch; the optimizer apply (and its donated-buffer
+    weight update) runs exactly once per update interval; the standalone
+    multi_update path is never dispatched (the apply is folded into the
+    step executable)."""
+    N, B = 4, 4
+    X, Y = _data(N * B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05}, kvstore=None,
+                       update_interval=N)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    # warm: compile micro + apply executables over one window
+    for j in range(N):
+        sl = slice(j * B, (j + 1) * B)
+        tr.fused_step(loss_fn, mx.nd.array(X[sl]), mx.nd.array(Y[sl]))
+    reset_step_counters()
+    reset_apply_counters()
+    windows = 2
+    for w in range(windows):
+        for j in range(N):
+            sl = slice(j * B, (j + 1) * B)
+            tr.fused_step(loss_fn, mx.nd.array(X[sl]), mx.nd.array(Y[sl]))
+    assert step_counters["dispatches"] == windows * N      # 1 per call
+    assert step_counters["apply_dispatches"] == windows    # 1 per interval
+    assert step_counters["micro_dispatches"] == windows * (N - 1)
+    assert step_counters["compiles"] == 0                  # steady state
+    assert apply_counters["fused_calls"] == 0              # apply folded in
+    assert apply_counters["fallback_params"] == 0
+
+
+def test_no_registry_dispatch_in_steady_state(monkeypatch):
+    """Steady state never re-enters Python op dispatch: zero
+    ops.registry.invoke calls during a fused step (the loss_fn is only
+    re-run when a new signature forces a retrace)."""
+    from mxnet_tpu.ops import registry as reg
+
+    B = 8
+    X, Y = _data(B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))  # compile
+    calls = {"n": 0}
+    orig = reg.invoke
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(reg, "invoke", counting)
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))
+    assert calls["n"] == 0
+
+
+def test_signature_change_retraces_once():
+    B = 8
+    X, Y = _data(2 * B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    reset_step_counters()
+    tr.fused_step(loss_fn, mx.nd.array(X[:B]), mx.nd.array(Y[:B]))
+    assert step_counters["compiles"] == 1
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))  # new shape
+    assert step_counters["compiles"] == 2
+    tr.fused_step(loss_fn, mx.nd.array(X[:B]), mx.nd.array(Y[:B]))
+    assert step_counters["compiles"] == 2  # both signatures cached
+
+
+def test_lr_change_is_an_operand_not_a_retrace():
+    B = 8
+    X, Y = _data(B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))
+    reset_step_counters()
+    before = _params_np(net)
+    tr.set_learning_rate(0.0)  # freeze: next update must be a no-op
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))
+    assert step_counters["compiles"] == 0
+    for a, b in zip(before, _params_np(net)):
+        onp.testing.assert_allclose(a, b, rtol=0, atol=1e-7)
+
+
+def test_sgld_falls_back():
+    """SGLD's host-RNG update rule opts the whole step out of fusion."""
+    B = 4
+    X, Y = _data(B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgld",
+                       {"learning_rate": 0.01}, kvstore=None)
+    reset_step_counters()
+    tr.fused_step(lambda x, y: loss_l(net(x), y),
+                  mx.nd.array(X), mx.nd.array(Y))
+    assert step_counters["legacy_steps"] == 1
+    assert step_counters["dispatches"] == 0
+
+
+# --------------------------------------------------------------------- #
+# Trainer satellites: zero_grad, accumulated step(), mid-window errors
+# --------------------------------------------------------------------- #
+
+def test_trainer_zero_grad_resets_add_accumulators():
+    net = _build_net()
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            p.grad_req = "add"
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    X, Y = _data(4)
+    for _ in range(2):
+        with mx.autograd.record():
+            loss_l(net(mx.nd.array(X)), mx.nd.array(Y)).backward()
+    g = [p for p in net.collect_params().values()
+         if p.grad_req != "null"][0].grad().asnumpy()
+    assert onp.abs(g).max() > 0
+    tr.zero_grad()
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            assert onp.abs(p.grad().asnumpy()).max() == 0
+
+
+def test_step_accumulated_add_rescales_by_effective_batch_once():
+    """grad_req='add' + Trainer(update_interval=N): N backwards then N
+    step() calls -> ONE update rescaled by 1/(N*B), matching the big
+    batch; mid-window step() calls are pure accounting; the boundary
+    auto-resets the 'add' accumulators."""
+    N, B = 3, 4
+    X, Y = _data(N * B)
+
+    net = _build_net()
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            p.grad_req = "add"
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None,
+                       update_interval=N)
+    before = _params_np(net)
+    for j in range(N):
+        sl = slice(j * B, (j + 1) * B)
+        with mx.autograd.record():
+            loss_l(net(mx.nd.array(X[sl])), mx.nd.array(Y[sl])).backward()
+        mid = _params_np(net)
+        tr.step(B)
+        if j < N - 1:  # mid-window: no weight motion
+            for a, b in zip(mid, _params_np(net)):
+                onp.testing.assert_allclose(a, b, rtol=0, atol=0)
+    assert any(onp.abs(a - b).max() > 0
+               for a, b in zip(before, _params_np(net)))
+    # boundary reset the accumulators
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            assert onp.abs(p.grad().asnumpy()).max() == 0
+
+    netl, _, _ = _run_legacy_big_batch("sgd", {"learning_rate": 0.05},
+                                       N * B, X, Y, windows=1)
+    for a, b in zip(_params_np(net), _params_np(netl)):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_step_with_write_grads_mid_window_raises():
+    """update_interval>1 + grad_req='write' + step(): each backward
+    would OVERWRITE the accumulating grads — step() fails loudly at the
+    window's first call instead of silently dropping micro-batches."""
+    X, Y = _data(4)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None,
+                       update_interval=2)
+    with mx.autograd.record():
+        loss_l(net(mx.nd.array(X)), mx.nd.array(Y)).backward()
+    with pytest.raises(MXNetError, match="grad_req='add'"):
+        tr.step(4)
+
+
+def test_allreduce_and_update_raise_mid_window():
+    N, B = 4, 4
+    X, Y = _data(B)
+    net = _build_net()
+    for p in net.collect_params().values():
+        if p.grad_req != "null":
+            p.grad_req = "add"
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None,
+                       update_interval=N)
+    with mx.autograd.record():
+        loss_l(net(mx.nd.array(X)), mx.nd.array(Y)).backward()
+    tr.step(B)  # micro-batch 1 of 4 — window now open
+    with pytest.raises(MXNetError, match="mid-accumulation window"):
+        tr.allreduce_grads()
+    with pytest.raises(MXNetError, match="mid-accumulation window"):
+        tr.update(B)
+    # finishing the window closes it again
+    for _ in range(N - 1):
+        with mx.autograd.record():
+            loss_l(net(mx.nd.array(X)), mx.nd.array(Y)).backward()
+        tr.step(B)
+    tr.allreduce_grads()  # boundary: allowed
+
+
+def test_update_interval_validation():
+    net = _build_net()
+    with pytest.raises(MXNetError, match="update_interval"):
+        gluon.Trainer(net.collect_params(), "sgd", {}, update_interval=0)
+
+
+# --------------------------------------------------------------------- #
+# integration: extras, estimator, state checkpointing
+# --------------------------------------------------------------------- #
+
+def test_loss_fn_extras_ride_through():
+    """loss_fn returning (loss, pred): extras come back as NDArrays from
+    the same single dispatch, matching the imperative forward."""
+    B = 8
+    X, Y = _data(B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.0}, kvstore=None)
+
+    def loss_fn(x, y):
+        pred = net(x)
+        return loss_l(pred, y), pred
+
+    expect = net(mx.nd.array(X)).asnumpy()  # lr=0: weights frozen
+    loss, pred = tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))
+    assert loss.shape == (B,)
+    onp.testing.assert_allclose(pred.asnumpy(), expect, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_estimator_fused_fit_path():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X, Y = _data(16)
+    net = _build_net(seed=5)
+    net.hybridize()
+    loss_l = gluon.loss.L2Loss()
+    est = Estimator(net, loss_l,
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}),
+                    fused_step=True)
+    dl = DataLoader(ArrayDataset(mx.nd.array(X), mx.nd.array(Y)),
+                    batch_size=8)
+    reset_step_counters()
+    est.fit(dl, epochs=2)
+    assert step_counters["apply_dispatches"] == 4  # 2 epochs x 2 batches
+    assert step_counters["legacy_steps"] == 0
+
+
+def test_estimator_fused_fit_matches_legacy_fit():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X, Y = _data(16)
+
+    def run(fused):
+        net = _build_net(seed=6)
+        net.hybridize()
+        est = Estimator(net, gluon.loss.L2Loss(),
+                        trainer=gluon.Trainer(net.collect_params(),
+                                              "adam",
+                                              {"learning_rate": 0.05}),
+                        fused_step=fused)
+        dl = DataLoader(ArrayDataset(mx.nd.array(X), mx.nd.array(Y)),
+                        batch_size=8, shuffle=False)
+        est.fit(dl, epochs=2)
+        return _params_np(net)
+
+    for a, b in zip(run(True), run(False)):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_save_load_states_after_fused_steps(tmp_path):
+    B = 4
+    X, Y = _data(B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.05}, kvstore=None)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    for _ in range(3):
+        tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))
+    assert tr._optimizer.num_update == 3
+    f = str(tmp_path / "t.states")
+    tr.save_states(f)
+    tr.load_states(f)
+    assert tr._optimizer.num_update == 3
+    # and fused + imperative paths interoperate on the same state list
+    with mx.autograd.record():
+        loss_fn(mx.nd.array(X), mx.nd.array(Y)).backward()
+    tr.step(B)
+    assert tr._optimizer.num_update == 4
+
+
+def test_mixed_fused_and_imperative_steps_share_window():
+    """fused_step and step() drive the same accumulation window."""
+    N, B = 2, 4
+    X, Y = _data(B)
+    net = _build_net()
+    loss_l = gluon.loss.L2Loss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None,
+                       update_interval=N)
+
+    def loss_fn(x, y):
+        return loss_l(net(x), y)
+
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))  # micro 1/2
+    assert tr._window_pos == 1
+    with pytest.raises(MXNetError, match="mid-accumulation window"):
+        tr.allreduce_grads()
+    tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y))  # boundary
+    assert tr._window_pos == 0
+
+
+def test_data_sharded_fused_step_matches_unsharded():
+    """data_sharding=dp_sharding(mesh): the batch is laid over the dp
+    axis, weights/states are replicated onto the mesh at build, and
+    GSPMD compiles the cross-replica grad reduction INTO the step —
+    same weights as the single-device fused step, still one dispatch."""
+    from mxnet_tpu.parallel import collectives
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    sh = collectives.dp_sharding(mesh)
+    B = 16
+    X, Y = _data(B)
+    loss_l = gluon.loss.L2Loss()
+
+    def run(data_sharding):
+        net = _build_net(seed=7)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, kvstore=None)
+
+        def loss_fn(x, y):
+            return loss_l(net(x), y)
+
+        for _ in range(3):
+            tr.fused_step(loss_fn, mx.nd.array(X), mx.nd.array(Y),
+                          data_sharding=data_sharding)
+        return net
+
+    nets = run(sh)
+    reset_step_counters()
+    netu = run(None)
+    assert step_counters["dispatches"] == 3
+    for a, b in zip(_params_np(nets), _params_np(netu)):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# benchmark smoke gates (tier-1)
+# --------------------------------------------------------------------- #
+
+def _run_bench(args):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, cwd="/root/repo", env=_ENV,
+                          timeout=570)
+
+
+class TestFusedStepBenchSmoke:
+    def test_step_profile_smoke(self):
+        r = _run_bench(["benchmark/step_profile.py", "--smoke"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "fused step, N=1" in r.stdout
+        assert "phase-by-phase" in r.stdout
+
+    def test_step_breakdown_smoke(self):
+        r = _run_bench(["benchmark/step_breakdown.py", "--smoke"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "train_step_fused_1" in r.stdout
+        assert "train_step_phase" in r.stdout
