@@ -114,6 +114,17 @@ register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
              "NaiveEngine = synchronous dispatch for debugging")
 register_env("MXNET_EXEC_BULK_EXEC_TRAIN", 1, "no-op on TPU; XLA fuses")
 register_env("MXNET_GPU_MEM_POOL_TYPE", "Naive", "no-op; XLA manages HBM")
+# accepted-and-ignored CUDA/engine-era vars (docs/ENV_VARS.md "Data /
+# misc"): registering them keeps ported scripts working AND keeps the
+# tracelint TL005 docs<->reads reconciliation honest — every documented
+# hatch has exactly one read/registration site.
+register_env("MXNET_CUDNN_AUTOTUNE_DEFAULT", 1,
+             "no-op; XLA autotunes convolutions itself")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
+             "no-op; collectives replace the kvstore server batching")
+register_env("MXNET_USE_FUSION", 1, "no-op; XLA fusion is always on")
+register_env("MXNET_GPU_WORKER_NTHREADS", 2,
+             "no-op; XLA manages device streams")
 
 
 def is_naive_engine() -> bool:

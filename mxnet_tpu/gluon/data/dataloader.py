@@ -118,29 +118,41 @@ class _MultiWorkerIter:
         self._place_fn = place_fn
         self._batch_idx = 0
         self._closed = False
+        # ring lock: ``next()`` (possibly on a training thread) races
+        # ``shutdown()`` (``__del__`` runs on whatever thread drops the
+        # last reference) — _pending/_closed/_batch_iter only move under
+        # it.  RLock because _push_next is reached both ways.
+        self._lock = threading.RLock()
         for _ in range(self._prefetch):
             self._push_next()
 
     def _push_next(self):
-        indices = next(self._batch_iter, None)
-        if indices is None:
-            return
-        # module-level worker fn: queued work items must not hold a
-        # reference back to this iterator, or an abandoned epoch's
-        # __del__ cleanup never fires while batches are still queued
-        self._pending.append(self._executor.submit(
-            _worker_load, self._dataset, self._batchify_fn, self._place_fn,
-            indices))
+        with self._lock:
+            if self._closed:
+                return
+            indices = next(self._batch_iter, None)
+            if indices is None:
+                return
+            # module-level worker fn: queued work items must not hold a
+            # reference back to this iterator, or an abandoned epoch's
+            # __del__ cleanup never fires while batches are still queued
+            self._pending.append(self._executor.submit(
+                _worker_load, self._dataset, self._batchify_fn,
+                self._place_fn, indices))
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if not self._pending:
+        with self._lock:
+            fut = self._pending.popleft() if self._pending else None
+            if fut is not None:
+                self._push_next()
+        if fut is None:
             self.shutdown()
             raise StopIteration
-        fut = self._pending.popleft()
-        self._push_next()
+        # the (possibly blocking) wait happens OUTSIDE the lock so a
+        # concurrent shutdown() is never stuck behind a slow batch
         try:
             batch = fut.result(self._timeout)
         except _FutTimeout:
@@ -160,14 +172,19 @@ class _MultiWorkerIter:
 
     def shutdown(self):
         """Cancel in-flight work and release the thread pool.  Safe to call
-        repeatedly; runs from ``__del__`` so an epoch abandoned mid-way
-        (``break``) doesn't leak the executor or its futures."""
-        if self._closed:
-            return
-        self._closed = True
-        for fut in self._pending:
+        repeatedly and from any thread (a concurrent ``next()`` either
+        got its future out before the drain — and may see it cancelled —
+        or finds the ring closed and stops); runs from ``__del__`` so an
+        epoch abandoned mid-way (``break``) doesn't leak the executor or
+        its futures."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._pending)
+            self._pending.clear()
+        for fut in pending:
             fut.cancel()
-        self._pending.clear()
         try:
             self._executor.shutdown(wait=False, cancel_futures=True)
         except TypeError:  # python < 3.9: no cancel_futures kwarg
@@ -228,6 +245,10 @@ class DevicePrefetchIter:
         self._thread = None
         self._stop = threading.Event()
         self._err = None
+        # guards _ring/_done/_exhausted: ``close()`` runs from __del__
+        # on whatever thread drops the last reference while ``next()``
+        # may still be mid-pull on the training thread
+        self._lock = threading.RLock()
         self._background = bool(background) and self._depth > 0
         if self._background:
             self._queue = _queue.Queue(maxsize=self._depth)
@@ -268,11 +289,24 @@ class DevicePrefetchIter:
 
     def __next__(self):
         if self._background:
-            if self._done:  # the single _END was already consumed — a
-                raise StopIteration  # further next() must not block forever
+            with self._lock:
+                if self._done:  # the single _END was already consumed —
+                    raise StopIteration  # next() must not block forever
+            # blocking get is safe: the producer always delivers _END
+            # (even on error), and close() injects one after the join
+            # so a consumer parked here wakes instead of hanging
             item = self._queue.get()
             if item is _END:
-                self._done = True
+                with self._lock:
+                    self._done = True
+                # rebroadcast the pill so any OTHER consumer parked in
+                # queue.get() wakes too (later calls stop at _done).
+                # Dropping on Full is safe HERE: a full queue means no
+                # consumer is parked, and _done is already set above
+                try:
+                    self._queue.put_nowait(_END)
+                except _queue.Full:
+                    pass
                 if self._err is not None:
                     err, self._err = self._err, None
                     raise err
@@ -280,24 +314,36 @@ class DevicePrefetchIter:
             return item
         if self._depth == 0:  # legacy synchronous path
             return self._place(next(self._source))
-        # threadless ring over an already-asynchronous source
-        while len(self._ring) < self._depth and not self._exhausted:
+        # threadless ring over an already-asynchronous source; the pull
+        # (which may block on the wrapped pool) stays outside the lock
+        while True:
+            with self._lock:
+                if len(self._ring) >= self._depth or self._exhausted:
+                    break
             try:
-                self._ring.append(self._place(next(self._source)))
+                item = self._place(next(self._source))
             except StopIteration:
-                self._exhausted = True
-        if not self._ring:
-            raise StopIteration
-        return self._ring.popleft()
+                with self._lock:
+                    self._exhausted = True
+                break
+            with self._lock:
+                self._ring.append(item)
+        with self._lock:
+            if not self._ring:
+                raise StopIteration
+            return self._ring.popleft()
 
     next = __next__
 
     def close(self):
         """Stop the producer and release the source (cancels a wrapped
         ``_MultiWorkerIter``'s pool).  Called from ``__del__`` so breaking
-        out of an epoch cleans up both layers."""
+        out of an epoch cleans up both layers; safe against a consumer
+        concurrently blocked in ``next()``."""
         self._stop.set()
         if self._thread is not None:
+            # drain so a producer stuck on a full queue exits its put
+            # loop promptly (it re-checks _stop every 50 ms regardless)
             try:
                 while True:
                     self._queue.get_nowait()
@@ -305,7 +351,24 @@ class DevicePrefetchIter:
                 pass
             self._thread.join(timeout=5)
             self._thread = None
-        self._ring.clear()
+            # the producer skips its end-of-stream marker once _stop is
+            # set — inject one so a consumer parked in queue.get() wakes.
+            # A straggler batch may have landed in the drained slot
+            # before the producer noticed _stop; the producer is dead
+            # after the join, so evicting and retrying must terminate
+            # and the pill is GUARANTEED to land (a dropped pill means a
+            # consumer blocks forever).
+            while True:
+                try:
+                    self._queue.put_nowait(_END)
+                    break
+                except _queue.Full:
+                    try:
+                        self._queue.get_nowait()
+                    except _queue.Empty:
+                        pass
+        with self._lock:
+            self._ring.clear()
         for attr in ("shutdown", "close"):
             fn = getattr(self._source, attr, None)
             if callable(fn):

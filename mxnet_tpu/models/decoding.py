@@ -299,6 +299,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     def _sample(logits, t, key0):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # temperature is a python-scalar closure capture, not an operand:
+        # tracelint: disable=TL001 -- scalar cast folds at trace time
         lg = logits / max(float(temperature), 1e-6)
         if top_k and top_k < lg.shape[-1]:
             kth = jax.lax.top_k(lg, top_k)[0][:, -1]

@@ -108,7 +108,8 @@ def _hash_bits(seed, bh, qpos, kpos):
 
 
 def _keep_threshold(rate: float):
-    # drop iff bits < rate * 2^32  (P = rate)
+    # drop iff bits < rate * 2^32  (P = rate, a python float hyperparam)
+    # tracelint: disable=TL001 -- scalar cast folds at trace time
     return jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
 
 

@@ -151,10 +151,11 @@ def dump(finished=True, profile_process="worker"):
 def dumps(reset=False):
     """Return the aggregate stats table as a string (reference
     ``MXAggregateProfileStatsPrint``)."""
-    st = _state["op_stats"]
-    s = st.table() if st else ""
-    if reset and st:
-        _state["op_stats"] = _OpStats()
+    with _lock:
+        st = _state["op_stats"]
+        s = st.table() if st else ""
+        if reset and st:
+            _state["op_stats"] = _OpStats()
     return s
 
 

@@ -1,0 +1,592 @@
+"""tracelint test suite (ISSUE 5): per-rule fixtures — true positive,
+true negative, suppressed — plus the tier-1 CI gate: a self-run over
+``mxnet_tpu/`` must be clean, and a synthetic ``float(loss)`` seeded
+into a fused-step body must fail it.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.tracelint import run_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def lint(tmp_path, source, name="snippet.py", **kw):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run_paths([str(p)], **kw)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tracelint"] + args,
+        capture_output=True, text=True, cwd=cwd, env=_ENV)
+
+
+# ------------------------------------------------------------------ #
+# TL001 — host sync inside traced code
+# ------------------------------------------------------------------ #
+
+class TestTL001HostSync:
+    def test_float_in_jitted_fn(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def step(w, g):
+                lr = float(g)
+                return w - lr * g
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL001"]
+        assert "float" in fs[0].message and "step" in fs[0].message
+
+    def test_item_via_callgraph_helper(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def helper(x):
+                return x.item()
+
+            def step(x):
+                return helper(x)
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL001"]
+        assert "helper" in fs[0].message
+
+    def test_branch_on_traced_array(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def step(x):
+                s = jnp.sum(x)
+                if s > 0:
+                    return x
+                return -x
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL001"]
+        assert "branches on a traced array" in fs[0].message
+
+    def test_numpy_materialization_in_trace_scope(self, tmp_path):
+        fs = lint(tmp_path, """
+            import numpy as onp
+            from mxnet_tpu.gluon.block import trace_scope
+
+            def run(key, vals):
+                with trace_scope(key, True) as aux:
+                    host = onp.asarray(vals[0])
+                return host
+        """)
+        assert rules_of(fs) == ["TL001"]
+        assert "onp.asarray" in fs[0].message
+
+    def test_true_negatives(self, tmp_path):
+        # host work outside the traced region, trace-time python on
+        # hyperparameters/shapes, identity tests: all fine
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def host_metric(x):
+                return float(x)  # never traced
+
+            class Rule:
+                momentum = 0.0
+
+                def step(self, w, g, state):
+                    n = float(w.shape[0])
+                    if self.momentum == 0.0:
+                        return w - g / n
+                    if state is None:
+                        state = jnp.zeros_like(w)
+                    return w + self.momentum * state - g / n
+
+            def outer(w, g, s):
+                return Rule().step(w, g, s)
+
+            fn = jax.jit(outer)
+        """)
+        assert fs == []
+
+    def test_suppressed_with_reason(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def step(w, g):
+                lr = float(g)  # tracelint: disable=TL001 -- test fixture
+                return w - lr * g
+
+            fn = jax.jit(step)
+        """)
+        assert fs == []
+
+    def test_suppression_without_reason_is_tl000_and_keeps_finding(
+            self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def step(w, g):
+                lr = float(g)  # tracelint: disable=TL001
+                return w - lr * g
+
+            fn = jax.jit(step)
+        """)
+        assert sorted(rules_of(fs)) == ["TL000", "TL001"]
+
+
+# ------------------------------------------------------------------ #
+# TL002 — donated buffer read after dispatch
+# ------------------------------------------------------------------ #
+
+class TestTL002Donation:
+    def test_read_after_donating_dispatch(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def add(a, b):
+                return a + b
+
+            def outer(w, g):
+                fn = jax.jit(add, donate_argnums=(0,))
+                out = fn(w, g)
+                return w + out
+        """)
+        assert rules_of(fs) == ["TL002"]
+        assert "`w`" in fs[0].message
+
+    def test_producer_method_indirection(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def add(a, b):
+                return a + b
+
+            class Step:
+                def _make(self):
+                    return jax.jit(add, donate_argnums=(1,))
+
+                def run(self, w, g):
+                    fn = self._make()
+                    out = fn(w, g)
+                    return g + out
+        """)
+        assert rules_of(fs) == ["TL002"]
+        assert "`g`" in fs[0].message
+
+    def test_rebind_from_result_is_fine(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def add(a, b):
+                return a + b
+
+            def outer(w, g):
+                fn = jax.jit(add, donate_argnums=(0,))
+                w = fn(w, g)
+                return w + 1
+        """)
+        assert fs == []
+
+    def test_phase_polymorphic_producer_intersects(self, tmp_path):
+        # the FusedStep._compile regression: a compiler returning
+        # different jits per phase must not union donated positions
+        fs = lint(tmp_path, """
+            import jax
+
+            def add(a, b):
+                return a + b
+
+            class Step:
+                def _make(self, phase):
+                    if phase == "micro":
+                        return jax.jit(add, donate_argnums=(0,))
+                    return jax.jit(add, donate_argnums=(1,))
+
+                def run(self, w, g):
+                    fn = self._make("micro")
+                    out = fn(w, g)
+                    return w + g + out
+        """)
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def add(a, b):
+                return a + b
+
+            def outer(w, g):
+                fn = jax.jit(add, donate_argnums=(0,))
+                out = fn(w, g)
+                return w + out  # tracelint: disable=TL002 -- fixture
+        """)
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL003 — retrace hazards
+# ------------------------------------------------------------------ #
+
+class TestTL003Retrace:
+    def test_list_in_cache_key(self, tmp_path):
+        fs = lint(tmp_path, """
+            def lookup(cache, shape):
+                opts = [shape]
+                key = (shape, opts)
+                return cache.get(key)
+        """)
+        assert rules_of(fs) == ["TL003"]
+        assert "a list" in fs[0].message
+
+    def test_lambda_and_id_keys(self, tmp_path):
+        fs = lint(tmp_path, """
+            def store(cache, f, shape):
+                cache[(shape, lambda x: x)] = 1
+                cache[(id(f), shape)] = 2
+        """)
+        assert sorted(rules_of(fs)) == ["TL003", "TL003"]
+        msgs = " ".join(f.message for f in fs)
+        assert "lambda" in msgs and "identity key" in msgs
+
+    def test_jit_inside_loop(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def build(fns):
+                outs = []
+                for f in fns:
+                    outs.append(jax.jit(f))
+                return outs
+        """)
+        assert "TL003" in rules_of(fs)
+        assert "inside a loop" in fs[0].message
+
+    def test_hashable_key_and_hoisted_jit_are_fine(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def get(cache, arr, training, hyper_key):
+                key = (tuple(arr.shape), str(arr.dtype), training,
+                       hyper_key)
+                fn = cache.get(key)
+                if fn is None:
+                    fn = jax.jit(lambda x: x + 1)
+                    cache[key] = fn
+                return fn
+        """)
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            def store(cache, f, shape):
+                # bounded registry, evicted on pickle:
+                # tracelint: disable=TL003 -- fixture justification
+                cache[(id(f), shape)] = 2
+        """)
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL004 — lock discipline
+# ------------------------------------------------------------------ #
+
+class TestTL004Locks:
+    def test_unlocked_mutation_of_protected_field(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def push(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def drop(self):
+                    self._items.clear()
+        """)
+        assert rules_of(fs) == ["TL004"]
+        assert "_items" in fs[0].message and "drop" in fs[0].message
+
+    def test_lock_order_inversion(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._x = 0
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            self._x = 1
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            self._x = 2
+        """)
+        assert rules_of(fs) == ["TL004"]
+        assert "inversion" in fs[0].message
+
+    def test_consistent_locking_and_init_are_fine(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []      # pre-sharing: exempt
+
+                def push(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def drop(self):
+                    with self._lock:
+                        self._items.clear()
+
+                def peek(self):
+                    return len(self._items)  # read, not mutation
+        """)
+        assert fs == []
+
+    def test_module_level_lock(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            _lock = threading.Lock()
+            _registry = {}
+
+            def put(k, v):
+                with _lock:
+                    _registry[k] = v
+
+            def drop(k):
+                _registry.pop(k)
+        """)
+        assert rules_of(fs) == ["TL004"]
+        assert "_registry" in fs[0].message
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Ring:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def push(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def drop(self):
+                    self._items.clear()  # tracelint: disable=TL004 -- fixture
+        """)
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL005 — env-hatch registry
+# ------------------------------------------------------------------ #
+
+class TestTL005EnvRegistry:
+    def _docs(self, tmp_path):
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        f = d / "ENV_VARS.md"
+        f.write_text("| Variable | Default | Effect |\n|---|---|---|\n"
+                     "| `MXNET_DOCUMENTED` | 1 | real |\n"
+                     "| `MXNET_STALE` | 1 | nobody reads me |\n")
+        return str(f)
+
+    def test_undocumented_read_and_stale_row(self, tmp_path):
+        docs = self._docs(tmp_path)
+        fs = lint(tmp_path, """
+            import os
+
+            a = os.environ.get("MXNET_DOCUMENTED", "1")
+            b = os.environ.get("MXNET_SECRET", "0")
+        """, env_docs=docs)
+        assert sorted(rules_of(fs)) == ["TL005", "TL005"]
+        msgs = " ".join(f.message for f in fs)
+        assert "MXNET_SECRET" in msgs and "MXNET_STALE" in msgs
+        assert "MXNET_DOCUMENTED" not in msgs
+
+    def test_registered_and_documented_is_clean(self, tmp_path):
+        d = tmp_path / "docs"
+        d.mkdir()
+        (d / "ENV_VARS.md").write_text("| `MXNET_IGNORED_COMPAT` | 1 | "
+                                       "accepted, no-op |\n")
+        fs = lint(tmp_path, """
+            from mxnet_tpu.base import register_env
+
+            register_env("MXNET_IGNORED_COMPAT", 1, "no-op")
+        """, env_docs=str(d / "ENV_VARS.md"))
+        assert fs == []
+
+    def test_prose_mentions_are_not_documentation(self, tmp_path):
+        # a var named in a row's PROSE cell (not the first cell) is a
+        # reference, not a doc row — it must not mask a stale/missing row
+        d = tmp_path / "docs"
+        d.mkdir()
+        (d / "ENV_VARS.md").write_text(
+            "| `MXNET_REAL` | 1 | replaces `MXNET_LEGACY_PROSE` |\n")
+        fs = lint(tmp_path, """
+            import os
+
+            a = os.environ.get("MXNET_REAL")
+        """, env_docs=str(d / "ENV_VARS.md"))
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# the tier-1 gate: self-run, seeded violation, baseline
+# ------------------------------------------------------------------ #
+
+class TestGate:
+    def test_self_run_is_clean(self):
+        """THE CI gate: tracelint over the library must stay clean at
+        merge — a regression in trace discipline fails tier-1."""
+        r = cli(["mxnet_tpu/", "--format=json"])
+        assert r.returncode == 0, f"tracelint found:\n{r.stdout}\n{r.stderr}"
+        payload = json.loads(r.stdout)
+        assert payload["findings"] == []
+
+    def test_seeded_float_loss_fails_gate(self, tmp_path):
+        """Acceptance check: a synthetic host sync in a fused-step body
+        is caught (the analyzer sees through jax.jit(apply, ...))."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "gluon", "fused_step.py")).read()
+        needle = ("            outs, grads, new_frozen = "
+                  "pure(key, train_vals, frozen_vals,\n")
+        assert needle in src
+        seeded = src.replace(
+            needle, needle.rstrip("\n") + "\n                loss_val = "
+            "float(outs[0])  # seeded violation\n", 1)
+        bad = tmp_path / "fused_step_seeded.py"
+        bad.write_text(seeded)
+        r = cli([str(bad), "--format=json"])
+        assert r.returncode == 1
+        payload = json.loads(r.stdout)
+        assert any(f["rule"] == "TL001" and "float" in f["message"]
+                   for f in payload["findings"])
+
+    def test_baseline_lands_rule_warn_only(self, tmp_path):
+        """--baseline lets a future rule land without failing the gate:
+        recorded fingerprints are ignored, fresh findings are not."""
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            def step(w, g):
+                lr = float(g)
+                return w - lr * g
+
+            fn = jax.jit(step)
+        """))
+        base = tmp_path / "baseline.json"
+        r = cli([str(bad), "--write-baseline", str(base)])
+        assert r.returncode == 0 and base.exists()
+        r = cli([str(bad), "--baseline", str(base)])
+        assert r.returncode == 0, r.stdout
+        # a NEW violation is still caught through the same baseline
+        bad.write_text(bad.read_text().replace(
+            "return w - lr * g", "return w - lr * g.item()"))
+        r = cli([str(bad), "--baseline", str(base), "--format=json"])
+        assert r.returncode == 1
+        assert any(f["rule"] == "TL001" and "item" in f["message"]
+                   for f in json.loads(r.stdout)["findings"])
+
+    def test_select_restricts_rules(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            def step(w, g):
+                return w - float(g) * g
+
+            fn = jax.jit(step)
+        """))
+        assert cli([str(bad), "--select", "TL004"]).returncode == 0
+        assert cli([str(bad), "--select", "TL001"]).returncode == 1
+        assert cli([str(bad), "--select", "TL999"]).returncode == 2
+
+
+class TestReviewRegressions:
+    """Post-review regression net: partial-tree TL005, nested-class
+    TL004 attribution, suppression markers inside string literals."""
+
+    def test_single_file_lint_has_no_stale_doc_false_positives(self):
+        # the natural lint-the-file-I-edited workflow: env vars read
+        # elsewhere in the repo must not be reported as stale doc rows
+        r = cli(["mxnet_tpu/gluon/data/dataloader.py", "--format=json"])
+        assert r.returncode == 0, r.stdout
+        assert json.loads(r.stdout)["findings"] == []
+
+    def test_nested_class_owns_its_own_lock_discipline(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Outer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def push(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                class Inner:  # unrelated single-threaded helper
+                    def __init__(self):
+                        self._items = []
+
+                    def drop(self):
+                        self._items.clear()
+        """)
+        assert fs == []
+
+    def test_suppression_marker_inside_string_is_not_a_suppression(
+            self, tmp_path):
+        # core.py's own TL000 help text quotes the syntax; a string
+        # must neither raise TL000 nor suppress the next line
+        fs = lint(tmp_path, """
+            import jax
+
+            HELP = "write '# tracelint: disable=TLxxx -- reason'"
+
+            def step(w, g):
+                msg = "see '# tracelint: disable=TL001 -- like this'"
+                lr = float(g)
+                return w - lr * g
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL001"]
+
+    def test_self_lint_of_tracelint_itself(self):
+        # the analyzer's own sources (which quote the suppression
+        # syntax in strings/docstrings) must lint clean
+        r = cli(["tools/tracelint/", "--format=json"])
+        assert r.returncode == 0, r.stdout

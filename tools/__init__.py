@@ -1,0 +1,3 @@
+# Makes `python -m tools.tracelint` resolvable from the repo root.  The
+# standalone scripts in this directory (im2rec.py, launch.py, ...) are
+# still invoked by path and do not rely on package-relative imports.

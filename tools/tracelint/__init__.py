@@ -1,0 +1,40 @@
+"""tracelint — trace-discipline static analyzer for the mxnet_tpu tree.
+
+The fused hot path (gluon/fused_step.py, gluon/block.py ``_CachedOp``,
+optimizer/optimizer.py ``multi_update``, gluon/data's device-prefetch
+ring) is fast because of invariants the code cannot express in types:
+
+* no host synchronization inside anything that traces under ``jax.jit``
+  (one stray ``float(x)`` re-serializes the step);
+* donated buffers are dead after the dispatch that donates them;
+* executable-cache keys stay hashable and value-keyed, or every step
+  silently retraces;
+* the iterator rings mutate shared state only under their lock, and
+  locks are always taken in one order;
+* every ``MXNET_*`` escape hatch is documented in docs/ENV_VARS.md.
+
+tracelint checks those invariants with ``ast`` only (no third-party
+dependencies) so CI fails the moment a change reintroduces the
+74.8 ms/step world.  Run it as::
+
+    python -m tools.tracelint mxnet_tpu/ [--format=json] [--baseline f]
+
+Rules (see docs/TRACELINT.md for the full catalog):
+
+=======  ==========================================================
+TL000    malformed/unjustified ``# tracelint: disable=`` comment
+TL001    host sync reachable from traced code
+TL002    donated buffer read after the dispatch that donates it
+TL003    retrace hazard (unhashable / identity cache key, jit-in-loop)
+TL004    lock-order inversion or unlocked shared-state mutation
+TL005    ``MXNET_*`` env read and docs/ENV_VARS.md out of sync
+=======  ==========================================================
+
+Suppress a deliberate violation with a justified comment on the same
+line (or on a comment line directly above)::
+
+    x = float(loss)  # tracelint: disable=TL001 -- epoch boundary, sync is the point
+"""
+from .core import RULES, Finding, run_paths  # noqa: F401
+
+__all__ = ["RULES", "Finding", "run_paths"]

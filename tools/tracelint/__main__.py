@@ -1,0 +1,76 @@
+"""CLI: ``python -m tools.tracelint <paths> [options]``.
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (RULES, apply_baseline, load_baseline, run_paths,
+                   write_baseline)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tracelint",
+        description="trace-discipline static analyzer (host-sync, "
+                    "donation, retrace, lock-order, env-hatch checks)")
+    ap.add_argument("paths", nargs="+",
+                    help="python files or directories to analyze")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="ignore findings whose fingerprint is in FILE "
+                         "(lets a new rule land warn-only)")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write current findings to FILE and exit 0")
+    ap.add_argument("--env-docs", default=None, metavar="FILE",
+                    help="override the docs/ENV_VARS.md location for "
+                         "TL005 (auto-discovered by default)")
+    args = ap.parse_args(argv)
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in select if r not in RULES]
+        if unknown:
+            print(f"tracelint: unknown rule id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        findings = run_paths(args.paths, select=select,
+                             env_docs=args.env_docs)
+    except FileNotFoundError as e:
+        print(f"tracelint: no such path: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"tracelint: wrote {len(findings)} fingerprint(s) to "
+              f"{args.write_baseline}")
+        return 0
+    if args.baseline:
+        findings = apply_baseline(findings, load_baseline(args.baseline))
+
+    if args.format == "json":
+        counts: dict = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "counts": counts}, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+            if f.snippet:
+                print(f"    {f.snippet}")
+        n = len(findings)
+        print(f"tracelint: {n} finding(s)" if n else "tracelint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""Module-local call-graph + traced-region discovery.
+
+"Traced" code is anything jax re-executes abstractly: bodies passed to
+``jax.jit`` / ``value_and_grad`` / ``vjp`` / ``pallas_call`` / control-flow
+combinators, functions decorated with jit, and functions that open a
+``trace_scope`` (the repo's CachedOp trace discipline — their body runs
+under an active jax trace by construction).  From those seeds we walk the
+*module-local* call graph: bare-name calls resolve lexically through
+nested scopes; ``self.method`` calls resolve within the enclosing class,
+its module-local ancestors and descendants (the optimizer registry
+pattern: ``Optimizer._apply_one`` calls ``self._update_rule``, overridden
+by every registered subclass).
+
+Cross-module calls are deliberately not followed — each hot-path module
+carries its own seeds (the jit/trace_scope call sites live next to the
+functions they trace), and a repo-wide points-to analysis would buy
+little precision for a lot of fragility.
+"""
+from __future__ import annotations
+
+import ast
+
+# jax entry points whose function-valued arguments are (re)traced
+TRACING_FNS = {
+    "jit", "pjit", "value_and_grad", "grad", "vjp", "jvp", "linearize",
+    "checkpoint", "remat", "eval_shape", "make_jaxpr", "vmap", "pmap",
+    "pallas_call", "shard_map", "scan", "while_loop", "cond", "fori_loop",
+    "switch", "associative_scan", "custom_vjp", "custom_jvp",
+}
+# bare (un-dotted) names we accept as tracing entries without an alias
+_BARE_OK = {"jit", "pjit", "pallas_call", "shard_map", "checkpoint",
+            "value_and_grad"}
+_JAXISH_ROOTS = {"jax", "jnp", "lax", "pl", "pltpu", "plgpu"}
+
+
+def dotted(expr):
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_own(node):
+    """Walk a function body without descending into nested function /
+    class definitions (lambdas and comprehensions DO run as part of the
+    enclosing trace, so they are included)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class FuncInfo:
+    __slots__ = ("node", "name", "qualname", "scopes", "cls")
+
+    def __init__(self, node, qualname, scopes, cls):
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.scopes = scopes      # enclosing scope nodes, outermost first
+        self.cls = cls            # innermost enclosing ClassDef or None
+
+    def __repr__(self):
+        return f"<FuncInfo {self.qualname}>"
+
+
+class Index:
+    """Scope-aware function/class/call index of one module."""
+
+    def __init__(self, module):
+        self.module = module
+        self.functions = []               # all FuncInfo
+        self.by_node = {}                 # id(fn node) -> FuncInfo
+        self.scope_funcs = {}             # id(scope node) -> {name: FuncInfo}
+        self.classes = {}                 # class name -> ClassDef
+        self.class_methods = {}           # id(ClassDef) -> {name: FuncInfo}
+        self.calls = []                   # (Call node, scope stack tuple)
+        self._subclasses = None
+        self._build(module.tree, (module.tree,), None, "")
+
+    def _build(self, scope_node, scopes, cls, prefix):
+        """Walk one scope: register defs (even when nested inside
+        if/try statements — they still belong to this scope), index
+        calls, recurse into each def/class with an extended stack."""
+        stack = list(ast.iter_child_nodes(scope_node))
+        while stack:
+            child = stack.pop()
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                info = FuncInfo(child, qual, scopes, cls)
+                self.functions.append(info)
+                self.by_node[id(child)] = info
+                self.scope_funcs.setdefault(id(scopes[-1]), {})[
+                    child.name] = info
+                if cls is not None and scopes[-1] is cls:
+                    self.class_methods.setdefault(id(cls), {})[
+                        child.name] = info
+                self._build(child, scopes + (child,), cls, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                self.classes[child.name] = child
+                self._build(child, scopes + (child,), child,
+                            prefix + child.name + ".")
+            else:
+                if isinstance(child, ast.Call):
+                    self.calls.append((child, scopes))
+                stack.extend(ast.iter_child_nodes(child))
+
+    # -- resolution ------------------------------------------------------ #
+    def resolve_name(self, name, scopes):
+        """Lexical lookup of a bare function name: innermost enclosing
+        function scope outward to module (class bodies are not lexical
+        scopes in python and are skipped)."""
+        for scope in reversed(scopes):
+            if isinstance(scope, ast.ClassDef):
+                continue
+            info = self.scope_funcs.get(id(scope), {}).get(name)
+            if info is not None:
+                return info
+        return None
+
+    def _class_family(self, cls):
+        """The class plus its module-local ancestors and descendants."""
+        if self._subclasses is None:
+            self._subclasses = {}
+            for name, node in self.classes.items():
+                for base in node.bases:
+                    b = dotted(base)
+                    if b and b.split(".")[-1] in self.classes:
+                        self._subclasses.setdefault(
+                            b.split(".")[-1], []).append(name)
+        family, work = {cls.name}, [cls.name]
+        while work:  # descendants
+            for sub in self._subclasses.get(work.pop(), []):
+                if sub not in family:
+                    family.add(sub)
+                    work.append(sub)
+        work = [cls.name]
+        while work:  # ancestors
+            node = self.classes.get(work.pop())
+            if node is None:
+                continue
+            for base in node.bases:
+                b = dotted(base)
+                if b:
+                    b = b.split(".")[-1]
+                    if b in self.classes and b not in family:
+                        family.add(b)
+                        work.append(b)
+        return [self.classes[n] for n in family]
+
+    def resolve_self_method(self, attr, scopes):
+        """``self.attr(...)`` — every matching method def in the
+        enclosing class's module-local family."""
+        cls = None
+        for scope in reversed(scopes):
+            if isinstance(scope, ast.ClassDef):
+                cls = scope
+                break
+        if cls is None:
+            return []
+        out = []
+        for c in self._class_family(cls):
+            info = self.class_methods.get(id(c), {}).get(attr)
+            if info is not None:
+                out.append(info)
+        return out
+
+    def resolve_call(self, call, scopes):
+        """FuncInfos a call statically resolves to (possibly empty)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            info = self.resolve_name(func.id, scopes)
+            return [info] if info else []
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == "self":
+            return self.resolve_self_method(func.attr, scopes)
+        return []
+
+
+def is_tracing_entry(call, module):
+    """True when ``call`` is a jax entry point that traces its
+    function-valued arguments."""
+    d = dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    last = parts[-1]
+    if last not in TRACING_FNS:
+        return False
+    if len(parts) == 1:
+        return last in _BARE_OK
+    root = parts[0]
+    return (root in _JAXISH_ROOTS or root in module.jax_aliases
+            or root in module.jnp_aliases)
+
+
+def _is_jit_decorator(dec, module):
+    d = dotted(dec)
+    if d and d.split(".")[-1] in ("jit", "pjit"):
+        return True
+    if isinstance(dec, ast.Call):
+        dd = dotted(dec.func)
+        if dd and dd.split(".")[-1] in ("jit", "pjit"):
+            return True
+        if dd and dd.split(".")[-1] == "partial" and dec.args:
+            inner = dotted(dec.args[0])
+            if inner and inner.split(".")[-1] in ("jit", "pjit"):
+                return True
+    return False
+
+
+def _opens_trace_scope(fn_node):
+    for n in iter_own(fn_node):
+        if isinstance(n, ast.With):
+            for item in n.items:
+                if isinstance(item.context_expr, ast.Call):
+                    d = dotted(item.context_expr.func)
+                    if d and d.split(".")[-1] == "trace_scope":
+                        return True
+    return False
+
+
+class CallGraph:
+    """Traced-function discovery for one module."""
+
+    def __init__(self, module):
+        self.module = module
+        self.index = Index(module)
+        self.traced = {}  # id(fn node) -> (FuncInfo, reason)
+        self._discover()
+
+    def _mark(self, info, reason, work):
+        if info is None or id(info.node) in self.traced:
+            return
+        self.traced[id(info.node)] = (info, reason)
+        work.append(info)
+
+    def _discover(self):
+        idx = self.index
+        work = []
+        # seeds: function-valued args of tracing entry points
+        for call, scopes in idx.calls:
+            if not is_tracing_entry(call, self.module):
+                continue
+            entry = dotted(call.func)
+            for arg in call.args:
+                if isinstance(arg, ast.Name):
+                    self._mark(idx.resolve_name(arg.id, scopes),
+                               f"passed to {entry} at line {call.lineno}",
+                               work)
+        for info in idx.functions:
+            # seeds: @jit decorators
+            for dec in info.node.decorator_list:
+                if _is_jit_decorator(dec, self.module):
+                    self._mark(info, "decorated with jit", work)
+            # seeds: opens a trace_scope (CachedOp trace discipline)
+            if _opens_trace_scope(info.node):
+                self._mark(info, "opens trace_scope", work)
+        # propagate through module-local calls
+        while work:
+            info = work.pop()
+            reason = self.traced[id(info.node)][1]
+            scopes = info.scopes + (info.node,)
+            for n in iter_own(info.node):
+                if isinstance(n, ast.Call):
+                    for callee in self.index.resolve_call(n, scopes):
+                        self._mark(
+                            callee,
+                            f"called from traced `{info.qualname}` "
+                            f"({reason})", work)
+
+    def traced_funcs(self):
+        return list(self.traced.values())

@@ -1,0 +1,256 @@
+"""tracelint core: file loading, suppressions, rule driver, baselines.
+
+Everything here is plain ``ast`` + stdlib so the linter can run in any
+environment the repo runs in (CI shells it from a tier-1 test).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+
+RULES = {
+    "TL000": "malformed or unjustified tracelint suppression",
+    "TL001": "host sync reachable from traced code",
+    "TL002": "donated buffer read after dispatch",
+    "TL003": "retrace hazard in executable cache key / jit construction",
+    "TL004": "lock-order inversion or unlocked shared-state mutation",
+    "TL005": "MXNET_* env var out of sync with docs/ENV_VARS.md",
+}
+
+# `# tracelint: disable=TL001[,TL004] -- justification`
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracelint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*--\s*(\S.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by ``--baseline`` so findings
+        survive unrelated edits above them."""
+        return f"{self.rule}:{os.path.normpath(self.path)}:{self.snippet}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}")
+
+
+class Module:
+    """One parsed python file plus the lookups every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # import alias maps (numpy vs jax.numpy matters for TL001)
+        self.np_aliases: set = set()
+        self.jnp_aliases: set = set()
+        self.jax_aliases: set = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_aliases.add(a.asname or "numpy")
+                    elif a.name == "jax.numpy":
+                        self.jnp_aliases.add(a.asname or "jax")
+                    elif a.name == "jax" or a.name.startswith("jax."):
+                        self.jax_aliases.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "numpy":
+                            self.jnp_aliases.add(a.asname or "numpy")
+        self.suppressions = self._parse_suppressions()
+
+    # -- suppressions ----------------------------------------------------- #
+    def _parse_suppressions(self):
+        """Map line number -> (rule-id set, justification or None).
+
+        A suppression on a code line covers that line; a whole-line
+        comment covers the next line (for statements too long to carry
+        the comment inline).  Real COMMENT tokens only — the marker
+        inside a string literal (an error message quoting the syntax, a
+        docstring example) is not a suppression.
+        """
+        out: dict = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            return out  # ast parsed but tokenize balked: no suppressions
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2)
+            line = tok.start[0]
+            whole_line = not self.lines[line - 1][:tok.start[1]].strip()
+            out[line + 1 if whole_line else line] = (rules, reason, line)
+        return out
+
+    def suppressed(self, finding: Finding):
+        """None if not suppressed, else the (rules, reason, line) entry."""
+        entry = self.suppressions.get(finding.line)
+        if entry and finding.rule in entry[0]:
+            return entry
+        return None
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def collect_py_files(paths):
+    files = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return files
+
+
+def load_modules(files):
+    """Parse files; unparsable ones become findings rather than crashes
+    (a syntax error in the audited tree must fail the gate loudly)."""
+    modules, findings = [], []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(Module(path, source))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding("TL000", path,
+                                    getattr(e, "lineno", 0) or 0, 0,
+                                    f"could not analyze file: {e}"))
+    return modules, findings
+
+
+def find_repo_docs(paths, explicit=None):
+    """Locate docs/ENV_VARS.md by walking up from the scanned paths."""
+    if explicit:
+        return explicit if os.path.isfile(explicit) else None
+    for p in paths:
+        d = os.path.abspath(p if os.path.isdir(p) else os.path.dirname(p))
+        while True:
+            cand = os.path.join(d, "docs", "ENV_VARS.md")
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def _validate_suppressions(module: Module):
+    """TL000: every suppression needs known rule ids and a justification
+    after ``--`` (an unexplained disable is itself a finding, and the
+    suppression does not take effect — enforced by emitting TL000 here
+    while rules keep reporting through reasonless entries)."""
+    out = []
+    for target, (rules, reason, line) in module.suppressions.items():
+        bad = [r for r in rules if r not in RULES]
+        if bad:
+            out.append(Finding(
+                "TL000", module.path, line, 0,
+                f"unknown rule id(s) {','.join(sorted(bad))} in suppression",
+                module.snippet(line)))
+        if not reason:
+            out.append(Finding(
+                "TL000", module.path, line, 0,
+                "suppression without justification: write "
+                "'# tracelint: disable=TLxxx -- <why this is deliberate>'",
+                module.snippet(line)))
+    return out
+
+
+def run_paths(paths, select=None, env_docs=None):
+    """Run every rule over ``paths``; returns the surviving findings.
+
+    ``select`` restricts to an iterable of rule ids.  Suppressions with a
+    justification remove matching findings; reasonless suppressions do
+    not (and raise TL000 themselves).
+    """
+    from . import rules_env, rules_threading, rules_trace
+
+    files = collect_py_files(paths)
+    modules, findings = load_modules(files)
+    mod_by_path = {m.path: m for m in modules}
+
+    for m in modules:
+        findings.extend(_validate_suppressions(m))
+        findings.extend(rules_trace.check_module(m))
+        findings.extend(rules_threading.check_module(m))
+    docs = find_repo_docs(paths, env_docs)
+    findings.extend(rules_env.check(modules, docs))
+
+    if select:
+        keep = set(select)
+        findings = [f for f in findings if f.rule in keep]
+
+    out = []
+    for f in findings:
+        if not f.snippet:
+            m = mod_by_path.get(f.path)
+            if m is not None:
+                f.snippet = m.snippet(f.line)
+        m = mod_by_path.get(f.path)
+        if m is not None and f.rule != "TL000":
+            entry = m.suppressed(f)
+            if entry and entry[1]:  # justified suppression
+                continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# -- baseline ----------------------------------------------------------- #
+
+def load_baseline(path):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return set(data.get("fingerprints", []))
+    except (OSError, ValueError, AttributeError):
+        print(f"tracelint: could not read baseline {path}", file=sys.stderr)
+        return set()
+
+
+def write_baseline(path, findings):
+    data = {"fingerprints": sorted({f.fingerprint() for f in findings})}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(findings, baseline):
+    return [f for f in findings if f.fingerprint() not in baseline]

@@ -1,0 +1,124 @@
+"""TL005 — every ``MXNET_*`` escape hatch and docs/ENV_VARS.md agree.
+
+Reads are collected via ast (``os.environ.get`` / ``os.environ[...]`` /
+``os.getenv`` / the repo's ``get_env`` / ``env_truthy`` /
+``register_env``).  Undocumented-read findings are scoped to the
+scanned files; the stale-row direction is judged against reads in the
+ENTIRE repo that owns the docs file (library, benchmark and tooling
+layers alike — regex fallback if a file does not parse), so linting a
+subset of the tree never reports hatches read elsewhere as stale.  The
+docs side takes only variables named in the FIRST cell of a table row —
+prose references to other systems' vars don't count as documentation.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .callgraph import dotted
+from .core import Finding
+
+__all__ = ["check"]
+
+_VAR_RE = re.compile(r"MXNET_[A-Z0-9_]+")
+_DOC_ROW_RE = re.compile(r"^\s*\|([^|]*)\|")
+_READ_FNS = {"get_env", "env_truthy", "register_env", "getenv"}
+_AUX_READ_RE = re.compile(
+    r"(?:environ\.get|environ\[|getenv|get_env|env_truthy|register_env)"
+    r"\(?\s*[\"'](MXNET_[A-Z0-9_]+)[\"']")
+
+
+def _reads_in_tree(tree):
+    """(var, line) pairs for every MXNET_* env read in one parsed file."""
+    out = []
+    for node in ast.walk(tree):
+        var = None
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            last = d.split(".")[-1] if d else None
+            if (last in _READ_FNS or (d and d.endswith("environ.get"))) \
+                    and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                var = node.args[0].value
+        elif isinstance(node, ast.Subscript):
+            d = dotted(node.value)
+            if d and d.endswith("environ") and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                var = node.slice.value
+        if var and _VAR_RE.fullmatch(var):
+            out.append((var, node.lineno))
+    return out
+
+
+def _documented_vars(docs_path):
+    """var -> first doc line, from the first cell of each table row."""
+    out = {}
+    with open(docs_path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = _DOC_ROW_RE.match(line)
+            if not m:
+                continue
+            for var in _VAR_RE.findall(m.group(1)):
+                out.setdefault(var, i)
+    return out
+
+
+def _aux_reads(docs_path):
+    """MXNET_* reads across the WHOLE repo that owns the docs file.
+
+    The stale-row direction ('documented but never read') must be
+    judged against the full tree, not just the paths being linted —
+    otherwise linting a single edited file reports every hatch read
+    elsewhere as stale.  The undocumented-read direction stays scoped
+    to the scanned files (those findings carry file/line anchors)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(docs_path)))
+    vars_seen = set()
+    candidates = []
+    for r, dirs, names in os.walk(root):
+        dirs[:] = [x for x in dirs
+                   if x not in ("__pycache__", "node_modules")
+                   and not x.startswith(".")]
+        candidates.extend(os.path.join(r, n) for n in names
+                          if n.endswith(".py"))
+    for path in candidates:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        try:
+            vars_seen.update(v for v, _ in _reads_in_tree(ast.parse(src)))
+        except SyntaxError:
+            vars_seen.update(_AUX_READ_RE.findall(src))
+    return vars_seen
+
+
+def check(modules, docs_path):
+    if docs_path is None or not modules:
+        return []  # nothing to reconcile against (fixture runs)
+    findings = []
+    read_lines = {}  # var -> (path, line) of first read
+    for m in modules:
+        for var, line in _reads_in_tree(m.tree):
+            read_lines.setdefault(var, (m.path, line))
+    documented = _documented_vars(docs_path)
+    for var, (path, line) in sorted(read_lines.items()):
+        if var not in documented:
+            findings.append(Finding(
+                "TL005", path, line, 0,
+                f"`{var}` is read here but has no row in "
+                f"{os.path.relpath(docs_path)} — document the hatch "
+                "(default + effect) or remove the read"))
+    all_reads = set(read_lines) | _aux_reads(docs_path)
+    for var, line in sorted(documented.items()):
+        if var not in all_reads:
+            findings.append(Finding(
+                "TL005", docs_path, line, 0,
+                f"`{var}` is documented but never read anywhere in the "
+                "library or tooling — stale row; delete it or wire the "
+                "hatch up (register_env keeps accepted-and-ignored vars "
+                "honest)", snippet=f"doc row for {var}"))
+    return findings

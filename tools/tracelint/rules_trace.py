@@ -1,0 +1,478 @@
+"""TL001 host-sync-in-trace, TL002 donation-after-use, TL003 retrace
+hazards — the three rules that guard the fused hot path's jit discipline.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .callgraph import CallGraph, dotted, is_tracing_entry, iter_own
+from .core import Finding
+
+__all__ = ["check_module"]
+
+# zero-arg methods that force a device->host round trip
+_HOST_SYNC_METHODS = {
+    "item": "`.item()` pulls the scalar to host",
+    "asnumpy": "`.asnumpy()` materializes the array on host",
+    "tolist": "`.tolist()` materializes the array on host",
+    "numpy": "`.numpy()` materializes the array on host",
+    "wait_to_read": "`.wait_to_read()` blocks on device completion",
+    "block_until_ready": "`.block_until_ready()` blocks on device "
+                         "completion",
+}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_NP_MATERIALIZERS = {"array", "asarray", "asanyarray", "ascontiguousarray",
+                     "frombuffer", "copy"}
+# containers that cannot be dict keys (or hash by identity)
+_UNHASHABLE_DISPLAYS = {
+    ast.List: "a list", ast.Dict: "a dict", ast.Set: "a set",
+    ast.ListComp: "a list comprehension", ast.DictComp: "a dict "
+    "comprehension", ast.SetComp: "a set comprehension",
+    ast.GeneratorExp: "a generator", ast.Lambda: "a lambda",
+}
+_UNHASHABLE_CTORS = {"list", "dict", "set", "bytearray"}
+# graph-walk memo dicts keyed by id(node) within one pass are legitimate,
+# so only *cache*-named receivers (executable/trace caches) are audited
+_CACHE_NAME_RE = re.compile(r"cache", re.IGNORECASE)
+_CACHE_EXACT = {"_jitted"}
+# attribute reads that are static under trace (no sync)
+_STATIC_ATTRS = {"ndim", "shape", "size", "dtype"}
+_TEST_SKIP_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable",
+                    "issubclass"}
+
+
+def check_module(module):
+    cg = CallGraph(module)
+    findings = []
+    findings.extend(_tl001(module, cg))
+    findings.extend(_tl002(module, cg))
+    findings.extend(_tl003(module, cg))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# TL001 — host sync inside traced code
+# --------------------------------------------------------------------- #
+
+def _benign_cast_arg(node):
+    """Casts of trace-time python values (shapes, lens, literals) are
+    fine; casts of anything array-flavored are a host sync."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        return d in ("len", "ord", "round", "abs")
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        return isinstance(node.value, ast.Attribute) and \
+            node.value.attr in _STATIC_ATTRS
+    if isinstance(node, ast.BinOp):
+        return _benign_cast_arg(node.left) and _benign_cast_arg(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _benign_cast_arg(node.operand)
+    return False
+
+
+def _host_sync_in_call(module, call):
+    """Message when ``call`` is a host sync, else None."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in _HOST_CASTS:
+        if len(call.args) == 1 and not _benign_cast_arg(call.args[0]):
+            return (f"host cast `{func.id}(...)` forces a device sync "
+                    "(and burns the value into the trace)")
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in _HOST_SYNC_METHODS and not call.args:
+            return _HOST_SYNC_METHODS[func.attr]
+        d = dotted(func)
+        if d:
+            root, last = d.split(".")[0], d.split(".")[-1]
+            if root in module.np_aliases and last in _NP_MATERIALIZERS:
+                return (f"`{d}(...)` materializes a traced value as a "
+                        "host numpy array")
+            if last == "device_get" and (root in module.jax_aliases
+                                         or root == "jax"):
+                return f"`{d}(...)` is an explicit device->host readback"
+    return None
+
+
+def _arrayish_locals(module, fn_node):
+    """Local names assigned from jnp/jax array producers (two passes so
+    derived names like ``y = x + 1`` propagate)."""
+    def produces_array(expr, known):
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d:
+                    root = d.split(".")[0]
+                    if root in module.jnp_aliases or \
+                            d.startswith("jax.numpy.") or \
+                            d.startswith("jax.lax."):
+                        return True
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in known:
+                return True
+        return False
+
+    known: set = set()
+    for _ in range(2):
+        for n in iter_own(fn_node):
+            if isinstance(n, ast.Assign) and produces_array(n.value, known):
+                for t in n.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            known.add(leaf.id)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name) and produces_array(n.value, known):
+                known.add(n.target.id)
+    return known
+
+
+def _traced_branch_value(module, test, arrayish):
+    """Name/expr when an if/while test depends on a traced array."""
+    stack = [test]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d and d.split(".")[0] in _TEST_SKIP_CALLS:
+                continue  # python-level predicates — no sync
+            if d and (d.split(".")[0] in module.jnp_aliases
+                      or d.startswith("jax.numpy.")):
+                return f"{d}(...)"
+            stack.extend(ast.iter_child_nodes(n))
+        elif isinstance(n, ast.Attribute):
+            if n.attr in _STATIC_ATTRS:
+                continue  # x.ndim / x.shape are static under trace
+            stack.extend(ast.iter_child_nodes(n))
+        elif isinstance(n, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                continue  # identity tests resolve at trace time
+            stack.extend(ast.iter_child_nodes(n))
+        elif isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load) and n.id in arrayish:
+                return n.id
+        else:
+            stack.extend(ast.iter_child_nodes(n))
+    return None
+
+
+def _tl001(module, cg):
+    out = []
+    for info, reason in cg.traced_funcs():
+        arrayish = _arrayish_locals(module, info.node)
+        for n in iter_own(info.node):
+            if isinstance(n, ast.Call):
+                msg = _host_sync_in_call(module, n)
+                if msg:
+                    out.append(Finding(
+                        "TL001", module.path, n.lineno, n.col_offset,
+                        f"{msg} — inside `{info.qualname}`, which is "
+                        f"traced ({reason}); hoist it out of the traced "
+                        "region or make the value an operand"))
+            elif isinstance(n, (ast.If, ast.While)):
+                val = _traced_branch_value(module, n.test, arrayish)
+                if val:
+                    kind = "while" if isinstance(n, ast.While) else "if"
+                    out.append(Finding(
+                        "TL001", module.path, n.lineno, n.col_offset,
+                        f"`{kind} {val}:` branches on a traced array — "
+                        f"inside `{info.qualname}`, which is traced "
+                        f"({reason}); use jnp.where/lax.cond or lift the "
+                        "decision to trace time"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL002 — donated buffer read after dispatch
+# --------------------------------------------------------------------- #
+
+def _is_jit_call(call, module):
+    d = dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    if parts[-1] not in ("jit", "pjit"):
+        return False
+    return len(parts) == 1 or parts[0] in module.jax_aliases or \
+        parts[0] == "jax"
+
+
+def _resolve_positions(expr, fn_node):
+    """Static donated-position sets: literals, tuples of literals, names
+    assigned such literals (IfExp unions both arms — conservative)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = set()
+        for e in expr.elts:
+            sub = _resolve_positions(e, fn_node)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    if isinstance(expr, ast.IfExp):
+        a = _resolve_positions(expr.body, fn_node)
+        b = _resolve_positions(expr.orelse, fn_node)
+        if a is None or b is None:
+            return None
+        return a | b
+    if isinstance(expr, ast.Name) and fn_node is not None:
+        out = set()
+        for n in iter_own(fn_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets):
+                sub = _resolve_positions(n.value, fn_node)
+                if sub is None:
+                    return None
+                out |= sub
+        return out or None
+    return None
+
+
+def _donation_index(module, cg):
+    """(donating jit call-exprs, producer functions returning them)."""
+    idx = cg.index
+    donating = {}  # id(call node) -> positions
+    for call, scopes in idx.calls:
+        if not _is_jit_call(call, module):
+            continue
+        kw = next((k for k in call.keywords
+                   if k.arg == "donate_argnums"), None)
+        if kw is None:
+            continue
+        fn_node = scopes[-1] if isinstance(
+            scopes[-1], (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+        pos = _resolve_positions(kw.value, fn_node)
+        if pos:
+            donating[id(call)] = pos
+
+    producers = {}  # id(fn node) -> positions (may be empty set)
+
+    def _value_positions(value, info, scopes):
+        """Positions known to be donated whenever ``value`` is the
+        dispatched callable, or None when nothing is known.  Multiple
+        reaching definitions / return paths INTERSECT: a position is
+        only 'donated' if every resolvable path donates it (a phase-
+        polymorphic compiler like FusedStep._compile returns different
+        jits per phase — the union would flag live operands)."""
+        if isinstance(value, ast.Call):
+            if id(value) in donating:
+                return set(donating[id(value)])
+            sets = [producers[id(c.node)]
+                    for c in cg.index.resolve_call(value, scopes)
+                    if id(c.node) in producers]
+            return set.intersection(*sets) if sets else None
+        if isinstance(value, ast.Name):
+            sets = []
+            for n in iter_own(info.node):
+                if isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == value.id
+                        for t in n.targets):
+                    s = _value_positions(n.value, info, scopes)
+                    if s is not None:
+                        sets.append(s)
+            return set.intersection(*sets) if sets else None
+        return None
+
+    changed, rounds = True, 0
+    while changed and rounds < 10:  # cap: recursive producer chains
+        changed = False
+        rounds += 1
+        for info in idx.functions:
+            scopes = info.scopes + (info.node,)
+            sets = []
+            for n in iter_own(info.node):
+                if isinstance(n, ast.Return) and n.value is not None:
+                    s = _value_positions(n.value, info, scopes)
+                    if s is not None:
+                        sets.append(s)
+            if not sets:
+                continue
+            pos = set.intersection(*sets)
+            if producers.get(id(info.node)) != pos:
+                producers[id(info.node)] = pos
+                changed = True
+    return donating, producers
+
+
+def _stores_and_loads(fn_node, key):
+    """Line numbers of stores/loads of a Name or dotted self-attr."""
+    stores, loads = [], []
+    for n in iter_own(fn_node):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if dotted(leaf) == key and isinstance(
+                            leaf, (ast.Name, ast.Attribute)) and \
+                            isinstance(leaf.ctx, ast.Store):
+                        stores.append(leaf.lineno)
+        if isinstance(n, (ast.Name, ast.Attribute)) and \
+                isinstance(getattr(n, "ctx", None), ast.Load) and \
+                dotted(n) == key:
+            loads.append(n.lineno)
+    return stores, loads
+
+
+def _tl002(module, cg):
+    donating, producers = _donation_index(module, cg)
+    if not donating and not producers:
+        return []
+    out = []
+    for info in cg.index.functions:
+        scopes = info.scopes + (info.node,)
+        local_sets = {}  # local name -> [position sets, one per assign]
+        for n in iter_own(info.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Call):
+                if id(n.value) in donating:
+                    pos = set(donating[id(n.value)])
+                else:
+                    sets = [producers[id(c.node)]
+                            for c in cg.index.resolve_call(n.value, scopes)
+                            if id(c.node) in producers]
+                    pos = set.intersection(*sets) if sets else None
+                if pos is not None:
+                    local_sets.setdefault(n.targets[0].id, []).append(pos)
+        # a name rebound from several sources donates only what EVERY
+        # source donates (see _donation_index on phase polymorphism)
+        donating_locals = {name: set.intersection(*sets)
+                           for name, sets in local_sets.items()
+                           if set.intersection(*sets)}
+        if not donating_locals:
+            continue
+        for n in iter_own(info.node):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in donating_locals):
+                continue
+            call_end = getattr(n, "end_lineno", n.lineno) or n.lineno
+            for p in sorted(donating_locals[n.func.id]):
+                if p >= len(n.args):
+                    continue
+                key = dotted(n.args[p])
+                if key is None:
+                    continue  # complex expr — no binding to track
+                stores, loads = _stores_and_loads(info.node, key)
+                if any(n.lineno <= s <= call_end for s in stores):
+                    continue  # rebound by the dispatch statement itself
+                later = [s for s in stores if s > call_end]
+                kill = min(later) if later else float("inf")
+                bad = [ln for ln in loads if call_end < ln <= kill]
+                if bad:
+                    out.append(Finding(
+                        "TL002", module.path, min(bad), 0,
+                        f"`{key}` is donated to `{n.func.id}(...)` "
+                        f"(arg {p}, dispatch at line {n.lineno}) and its "
+                        "buffer is dead after the call — rebind it from "
+                        "the result or stop reading it"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL003 — retrace hazards
+# --------------------------------------------------------------------- #
+
+def _is_cache_receiver(expr):
+    d = dotted(expr)
+    if d is None:
+        return False
+    last = d.split(".")[-1]
+    return bool(_CACHE_NAME_RE.search(last)) or last in _CACHE_EXACT
+
+
+def _unhashable_reason(elem, fn_node):
+    for typ, label in _UNHASHABLE_DISPLAYS.items():
+        if isinstance(elem, typ):
+            return label
+    if isinstance(elem, ast.Call):
+        d = dotted(elem.func)
+        if d in _UNHASHABLE_CTORS:
+            return f"a {d}()"
+        if d == "id":
+            return ("id(...) — an identity key retraces (and leaks an "
+                    "entry) whenever the object is recreated")
+    if isinstance(elem, ast.Name) and fn_node is not None:
+        for n in iter_own(fn_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == elem.id
+                    for t in n.targets):
+                reason = _unhashable_reason(n.value, None)
+                if reason:
+                    return f"`{elem.id}`, bound to {reason}"
+    return None
+
+
+def _tl003(module, cg):
+    out = []
+    idx = cg.index
+    # -- cache-key hygiene ------------------------------------------------ #
+    for info in idx.functions:
+        for n in iter_own(info.node):
+            key = None
+            if isinstance(n, ast.Subscript) and _is_cache_receiver(n.value):
+                key = n.slice
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("get", "setdefault") and \
+                    _is_cache_receiver(n.func.value) and n.args:
+                key = n.args[0]
+            if key is None:
+                continue
+            if isinstance(key, ast.Name):
+                # `key = (...)` then `cache.get(key)` — inspect the
+                # tuple the name is bound to
+                for n2 in iter_own(info.node):
+                    if isinstance(n2, ast.Assign) and isinstance(
+                            n2.value, ast.Tuple) and any(
+                            isinstance(t, ast.Name) and t.id == key.id
+                            for t in n2.targets):
+                        key = n2.value
+                        break
+            elems = key.elts if isinstance(key, ast.Tuple) else [key]
+            for elem in elems:
+                reason = _unhashable_reason(elem, info.node)
+                if reason:
+                    recv = dotted(n.value if isinstance(n, ast.Subscript)
+                                  else n.func.value)
+                    out.append(Finding(
+                        "TL003", module.path, elem.lineno, elem.col_offset,
+                        f"executable-cache key for `{recv}` contains "
+                        f"{reason} — unhashable/unstable keys mean a "
+                        "retrace (or TypeError) per step; key on "
+                        "shape/dtype/hashable hyperparameters instead"))
+    # -- jit constructed inside a loop ------------------------------------ #
+    for call, scopes in idx.calls:
+        if not _is_jit_call(call, module):
+            continue
+        owner = scopes[-1]
+        if _inside_loop(owner, call):
+            out.append(Finding(
+                "TL003", module.path, call.lineno, call.col_offset,
+                "jitted executable constructed inside a loop — every "
+                "iteration compiles a fresh executable; hoist the jit "
+                "and cache it by signature"))
+    return out
+
+
+def _inside_loop(scope_node, target):
+    """True when ``target`` sits under a For/While within its scope."""
+    hit = [False]
+
+    def walk(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if child is target and in_loop:
+                hit[0] = True
+                return
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            walk(child, in_loop or isinstance(
+                child, (ast.For, ast.While, ast.AsyncFor)))
+
+    walk(scope_node, False)
+    return hit[0]
