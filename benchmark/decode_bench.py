@@ -1,8 +1,23 @@
 #!/usr/bin/env python3
 """Autoregressive decode throughput: KV-cache (one compiled scan) vs the
-full-recompute ``GPT.generate`` loop.  Prints one JSON line per mode."""
+full-recompute ``GPT.generate`` loop.  Prints one JSON line per mode.
+
+Batch-1 arms sweep the per-token step implementation (unrolled per-layer
+/ stacked-layer scan / Pallas megakernel where its TPU gate passes) and
+report, next to the timings, the **ops/step column**: the optimized-HLO
+instruction count of ONE compiled decode step
+(``models.decode_step_program`` + ``profiler_xla.hlo_op_count``).  The
+r4 profile showed decode is sequencer-bound (~230 device ops x ~2.5 us
+of fixed per-op cost, BASELINE.md) — this column is the CAUSE metric the
+stacked-scan path collapses, measurable on any backend.
+
+``--smoke``: tiny geometry, no TPU — exercises the unrolled and stacked
+arms plus the op-count column and asserts greedy parity between them;
+gated in tier-1 like ``step_profile.py --smoke``.
+"""
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -13,11 +28,66 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as onp
 
 
+def _step_ops(net, total, weights, fused, stacked):
+    """ops/step for one compiled batch-1 decode step of this arm."""
+    from mxnet_tpu import profiler_xla
+    from mxnet_tpu.models import decode_step_program
+
+    fn, args = decode_step_program(net, batch=1, total=total,
+                                   weights=weights, fused=fused,
+                                   stacked=stacked)
+    return profiler_xla.hlo_op_count(fn, *args)
+
+
+def smoke():
+    """Tiny-geometry unrolled-vs-stacked decode: parity + op-count
+    collapse, CPU-friendly (the tier-1 gate)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT, GPTConfig, kv_generate
+
+    mx.random.seed(0)
+    cfg = GPTConfig(vocab_size=512, max_length=128, num_layers=2,
+                    units=64, num_heads=4, hidden_size=128)
+    net = GPT(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    B, P, N = 2, 8, 16
+    prompt = onp.random.RandomState(0).randint(0, cfg.vocab_size, (B, P))
+    outs, rows = {}, []
+    for arm, skw in (("unrolled", "off"), ("stacked", "on")):
+        kv_generate(net, prompt, max_new_tokens=N, temperature=0.0,
+                    stacked=skw)  # compile
+        t0 = time.perf_counter()
+        outs[arm] = kv_generate(net, prompt, max_new_tokens=N,
+                                temperature=0.0, stacked=skw)
+        dt = time.perf_counter() - t0
+        ops = _step_ops(net, P + N, "native", "off", skw)
+        rows.append((arm, ops))
+        print(json.dumps({"bench": "decode_smoke", "mode": arm,
+                          "ops_per_step": ops,
+                          "ms_per_token": round(dt / N * 1e3, 3),
+                          "batch": B, "new_tokens": N}))
+    onp.testing.assert_array_equal(outs["stacked"], outs["unrolled"])
+    ops = dict(rows)
+    assert ops["stacked"] < ops["unrolled"], rows
+    print(f"# parity OK; ops/step {ops['unrolled']} -> {ops['stacked']}")
+    return 0
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny unrolled-vs-stacked arms + op-count "
+                         "column only (tier-1 gate, runs on CPU in "
+                         "seconds)")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+
     import jax
 
     import mxnet_tpu as mx
-    from mxnet_tpu.models import GPT, GPTConfig, kv_generate
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.models import GPT, GPTConfig, decode_mode, kv_generate
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -33,12 +103,14 @@ def main():
     B, P, N = (8, 32, 256) if on_tpu else (2, 8, 16)
     prompt = onp.random.RandomState(0).randint(0, cfg.vocab_size, (B, P))
 
-    # KV-cache path: one compiled scan (time incl. sampling)
+    # KV-cache path: one compiled scan (time incl. sampling), default
+    # step mode (stacked where supported)
     kv_generate(net, prompt, max_new_tokens=N, temperature=0.0)  # compile
     t0 = time.perf_counter()
     kv_generate(net, prompt, max_new_tokens=N, temperature=0.0)
     dt = time.perf_counter() - t0
     print(json.dumps({"bench": "decode", "mode": "kv_cache",
+                      "step": decode_mode(net, B, P + N),
                       "tokens_per_sec": round(B * N / dt, 1),
                       "batch": B, "new_tokens": N,
                       "platform": platform}))
@@ -47,27 +119,38 @@ def main():
     # batch-1 latency (interactive serving).  prefill='batched' runs the
     # prompt as ONE causal forward, then N-1 scan decode steps; the timed
     # wall covers prefill + decode, so ms_per_token = wall / N is the
-    # honest serving latency per emitted token.  Four variants: the
-    # per-op scan step vs the fused one-kernel-per-token Pallas step
-    # (ops/decode_fused.py, VERDICT r4 item 2), each bf16 and int8.
+    # honest serving latency per emitted token.  Arms: per-layer
+    # unrolled vs stacked-layer scan (any backend), the Pallas megakernel
+    # where its gate passes (fused='on' raises otherwise), each with the
+    # int8 weight stream where covered.
     p1 = prompt[:1]
-    for wmode in ("native", "int8"):
-        for fmode in ("off", "auto"):
-            kw = dict(max_new_tokens=N, temperature=0.0, weights=wmode,
-                      fused=fmode)
+    arms = [("native", "off", "off", "kv_cache_batch1"),
+            ("native", "off", "on", "kv_cache_batch1_stacked"),
+            ("native", "on", "off", "kv_cache_batch1_fused"),
+            ("int8", "off", "off", "kv_cache_batch1_int8"),
+            ("int8", "on", "off", "kv_cache_batch1_int8_fused")]
+    for wmode, fmode, smode, tag in arms:
+        kw = dict(max_new_tokens=N, temperature=0.0, weights=wmode,
+                  fused=fmode, stacked=smode)
+        try:
             kv_generate(net, p1, **kw)  # compile
-            t0 = time.perf_counter()
-            kv_generate(net, p1, **kw)
-            dt = time.perf_counter() - t0
-            tag = "kv_cache_batch1" + \
-                ("_int8" if wmode == "int8" else "") + \
-                ("_fused" if fmode == "auto" else "")
+        except MXNetError as e:
             print(json.dumps({"bench": "decode", "mode": tag,
-                              "new_tokens_per_sec": round(N / dt, 1),
-                              "ms_per_token": round(dt / N * 1e3, 3),
-                              "batch": 1, "new_tokens": N, "prompt": P,
+                              "skipped": str(e)[:80],
                               "platform": platform}))
             sys.stdout.flush()
+            continue
+        t0 = time.perf_counter()
+        kv_generate(net, p1, **kw)
+        dt = time.perf_counter() - t0
+        ops = _step_ops(net, P + N, wmode, fmode, smode)
+        print(json.dumps({"bench": "decode", "mode": tag,
+                          "new_tokens_per_sec": round(N / dt, 1),
+                          "ms_per_token": round(dt / N * 1e3, 3),
+                          "ops_per_step": ops,
+                          "batch": 1, "new_tokens": N, "prompt": P,
+                          "platform": platform}))
+        sys.stdout.flush()
 
     # full-recompute path (the reference-style loop); fewer tokens — it
     # retraces per length and does O(L^2) work

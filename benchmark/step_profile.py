@@ -310,6 +310,46 @@ def measure_fused_step(n_layers=200, units=64, bs=32, reps=10,
     return n_params, rows
 
 
+def train_step_op_count_smoke():
+    """Tiny-BERT SPMD train-step HLO op count (the tier-1 gate for the
+    static sequencer-overhead metric): builds a 2-layer BERT trainer and
+    prints ``SPMDTrainer.step_hlo_op_count`` — the same counter the full
+    ``bert`` run reports, whose BASELINE.md round-3 anatomy is ~5,300
+    ops x ~1 us of fixed per-op cost (the wall-vs-device MFU gap)."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models import BERTConfig, BERTModel
+
+    mx.random.seed(0)
+    cfg = BERTConfig(vocab_size=512, max_length=32, num_layers=2,
+                     units=64, num_heads=4, hidden_size=128)
+    bert = BERTModel(cfg, use_pooler=False, use_mlm=True)
+
+    class _MLMHeadOnly(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.bert = bert
+
+        def forward(self, tokens):
+            return self.bert(tokens)[-1]
+
+    net = _MLMHeadOnly()
+    net.initialize(mx.init.Normal(0.02))
+    trainer = parallel.SPMDTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-4},
+        mesh=parallel.make_mesh({"dp": len(jax.devices())}))
+    rng = onp.random.RandomState(0)
+    bs = max(8, len(jax.devices()))
+    x = mx.nd.array(rng.randint(0, cfg.vocab_size, (bs, 16)))
+    y = mx.nd.array(rng.randint(0, cfg.vocab_size, (bs, 16)))
+    n = trainer.step_hlo_op_count(x, y)
+    print(f"\ntrain-step HLO op count (tiny BERT, 2L): {n}")
+    return n
+
+
 def profile_fused_step(smoke=False):
     """Fused-step phase rows (imperative Trainer path): ms/step and
     host-dispatch count, phase-by-phase vs one-executable, with the
@@ -440,6 +480,7 @@ def main():
         # timing at toy sizes is noise): every fused row must be exactly
         # one executable dispatch per step
         assert all(d == 1 for m, d, _ in rows if m.startswith("fused"))
+        assert train_step_op_count_smoke() > 0
         return 0
     if args.model is None:
         ap.error("model is required unless --smoke")
@@ -461,6 +502,13 @@ def main():
     loss = run()
     print("warmup loss:", float(onp.asarray(loss.asnumpy()).reshape(-1)[0]))
     run()
+
+    # static sequencer-overhead metric beside the measured trace: the
+    # compiled step's HLO instruction count (BASELINE.md round-3 anatomy
+    # — the BERT step's wall-vs-device MFU gap is ~5,300 ops x ~1 us of
+    # fixed per-op cost; the stacked-scan decode attacks the same class
+    # of overhead on the decode side)
+    print(f"train-step HLO op count: {trainer.step_hlo_op_count(x, y)}")
 
     import tempfile
     td = tempfile.mkdtemp(prefix="mxtpu_step_prof_")

@@ -10,7 +10,7 @@ matmul, big batched GEMMs).  Vision models live in
 """
 from .transformer import (MultiHeadAttention, PositionwiseFFN,
                           TransformerEncoderCell, TransformerDecoderCell)
-from .decoding import kv_generate
+from .decoding import kv_generate, decode_mode, decode_step_program
 from .gpt import GPT, GPTConfig, gpt2_small, gpt2_medium, gpt2_large, \
     gpt2_774m, gpt_tp_rules
 from .bert import BERTModel, BERTConfig, bert_base, bert_large
@@ -27,4 +27,5 @@ __all__ = [
     "CrossAttention", "Seq2SeqEncoder", "Seq2SeqDecoder",
     "Seq2SeqDecoderCell", "TransformerSeq2Seq",
     "Llama", "LlamaConfig", "llama_tp_rules", "llama_tiny", "llama_7b",
+    "kv_generate", "decode_mode", "decode_step_program",
 ]

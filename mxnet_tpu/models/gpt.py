@@ -90,6 +90,16 @@ class GPT(HybridBlock):
                        transpose_b=True)
         return F.reshape(logits, shape=(B, L, self._cfg.vocab_size))
 
+    def stacked_decode_weights(self):
+        """Every layer's decode weights stacked into (num_layers, ...)
+        arrays (one array per slot: qkv/proj/fc1/fc2 weight+bias, the
+        four LayerNorm rows) — the operand set of the stacked-layer
+        ``lax.scan`` decode path in ``models.kv_generate``, which runs
+        ONE layer-body's worth of HLO instead of ``num_layers`` unrolled
+        copies.  See ``ops.decode_fused.stack_decode_weights``."""
+        from ..ops.decode_fused import stack_decode_weights
+        return stack_decode_weights(self.blocks)
+
     def generate(self, prompt_tokens, max_new_tokens=32, temperature=1.0,
                  top_k=0, seed=None):
         """Autoregressive sampling (greedy when ``temperature==0``;

@@ -126,6 +126,25 @@ class LlamaCell(HybridBlock):
         x = x + self.attn(self.rms1(x))
         return x + self.mlp(self.rms2(x))
 
+    def decode_layer_arrays(self):
+        """This layer's decode weights as a flat dict of device arrays
+        (the Llama-family counterpart of
+        ``_TransformerCell.decode_layer_arrays``): split q/k/v/o
+        projections (GQA — k/v rows are KV·D wide), SwiGLU gate/up/down,
+        and the two RMSNorm gammas.  The family contract is bias-free
+        projections, so no bias slots are exported."""
+        return {
+            "q_w": self.attn.q_proj.weight.data()._data,
+            "k_w": self.attn.k_proj.weight.data()._data,
+            "v_w": self.attn.v_proj.weight.data()._data,
+            "o_w": self.attn.o_proj.weight.data()._data,
+            "gate_w": self.mlp.gate.weight.data()._data,
+            "up_w": self.mlp.up.weight.data()._data,
+            "down_w": self.mlp.down.weight.data()._data,
+            "rms1_g": self.rms1.gamma.data()._data,
+            "rms2_g": self.rms2.gamma.data()._data,
+        }
+
 
 class Llama(HybridBlock):
     """tokens (B, L) → logits (B, L, vocab)."""
@@ -152,6 +171,15 @@ class Llama(HybridBlock):
         for blk in self.blocks:
             x = blk(x)
         return self.head(self.ln_f(x))
+
+    def stacked_decode_weights(self):
+        """Every layer's decode weights stacked into (num_layers, ...)
+        arrays — the Llama/GQA operand set of the stacked-layer
+        ``lax.scan`` decode path (``models.kv_generate``).  See
+        ``GPT.stacked_decode_weights`` and
+        ``ops.decode_fused.stack_decode_weights``."""
+        from ..ops.decode_fused import stack_decode_weights
+        return stack_decode_weights(self.blocks)
 
     def generate(self, prompt_tokens, max_new_tokens=32, temperature=1.0,
                  top_k=0, seed=None):

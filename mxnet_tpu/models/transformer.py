@@ -102,6 +102,34 @@ class _TransformerCell(HybridBlock):
             x + self.attn(self.ln1(x))
         return x + self.ffn(self.ln2(x))
 
+    def decode_layer_arrays(self):
+        """This layer's decode weights as a flat dict of device arrays —
+        one slot per projection/bias/norm row, uniform across the GPT
+        family so ``ops.decode_fused.stack_decode_weights`` can stack the
+        whole block list into (NL, ...) arrays for the stacked-layer scan
+        decode (``models.kv_generate``).  Missing biases are exported as
+        zeros so every layer stacks to the same pytree."""
+        import jax.numpy as jnp
+
+        def wb(lyr, tag):
+            w = lyr.weight.data()._data
+            b = lyr.bias.data()._data if getattr(lyr, "bias", None) \
+                is not None else jnp.zeros((w.shape[0],), w.dtype)
+            return {f"{tag}_w": w, f"{tag}_b": b}
+
+        out = {}
+        out.update(wb(self.attn.qkv, "qkv"))
+        out.update(wb(self.attn.proj, "proj"))
+        out.update(wb(self.ffn.fc1, "fc1"))
+        out.update(wb(self.ffn.fc2, "fc2"))
+        out.update({
+            "ln1_g": self.ln1.gamma.data()._data,
+            "ln1_b": self.ln1.beta.data()._data,
+            "ln2_g": self.ln2.gamma.data()._data,
+            "ln2_b": self.ln2.beta.data()._data,
+        })
+        return out
+
 
 class TransformerEncoderCell(_TransformerCell):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,

@@ -60,7 +60,8 @@ from .attention import (_compiler_params, _interpret,
                         _pallas_backend_ok as _on_tpu)
 
 __all__ = ["fused_decode_supported", "pack_gpt_weights",
-           "pack_llama_weights", "decode_step"]
+           "pack_llama_weights", "decode_step",
+           "stack_decode_weights", "stacked_decode_supported"]
 
 _VMEM_BUDGET = 12 * 1024 * 1024
 
@@ -107,6 +108,68 @@ def fused_decode_supported(cfg, batch, total, dtype) -> bool:
     cache_vmem = 4 * batch * kv * total * d * 2
     stream_vmem = 2 * u * cw * 2
     if cache_vmem + stream_vmem + 4 * u * max(f, 3 * u) > _VMEM_BUDGET:
+        return False
+    return True
+
+
+def stack_decode_weights(blocks):
+    """Stack every block's ``decode_layer_arrays`` export into one
+    (NL, ...) array per slot — the operand set of the stacked-layer
+    ``lax.scan`` decode path (``models/decoding.py``).
+
+    This is the XLA-portable sibling of ``pack_gpt_weights`` /
+    ``pack_llama_weights`` (same per-family weight enumeration, no chunk
+    layout): each slot rides the scan's xs axis, so the compiled step
+    contains ONE layer-body's worth of HLO instead of NL unrolled
+    copies.  Callers cache the result pinned on the source arrays (the
+    same invalidation discipline as the Pallas packers: a train step
+    rebinds parameter arrays and triggers restacking)."""
+    per = [blk.decode_layer_arrays() for blk in blocks]
+    keys = list(per[0])
+    if any(list(p) != keys for p in per[1:]):
+        from ..base import MXNetError
+        raise MXNetError("stack_decode_weights: blocks export different "
+                         "decode slot sets — cannot stack")
+    return {k: jnp.stack([p[k] for p in per]) for k in keys}
+
+
+def stacked_decode_supported(model) -> bool:
+    """Gate for the stacked-layer scan decode path (XLA, any backend).
+
+    Requires: a block family that exports ``decode_layer_arrays`` (GPT
+    ``_TransformerCell`` or ``LlamaCell``), uniform geometry / norm
+    epsilons / FFN activation across layers (the scan compiles ONE body
+    for all of them), and materialized parameters.  Anything else falls
+    back to the per-layer unrolled path, which derives its math from the
+    model's own sublayers and so covers arbitrary variants."""
+    blocks = getattr(model, "blocks", None)
+    if not blocks or not hasattr(model, "stacked_decode_weights"):
+        return False
+    if not all(hasattr(b, "decode_layer_arrays") for b in blocks):
+        return False
+    try:
+        if hasattr(blocks[0], "rms1"):            # Llama family
+            eps = {(float(b.rms1._eps), float(b.rms2._eps))
+                   for b in blocks}
+        else:                                     # GPT family
+            eps = {(float(b.ln1._eps), float(b.ln2._eps))
+                   for b in blocks}
+            acts = {getattr(b.ffn.fc1.act, "_act_type", None)
+                    if b.ffn.fc1.act is not None else None
+                    for b in blocks}
+            if len(acts) != 1:
+                return False
+        if len(eps) != 1:
+            return False
+        per0 = blocks[0].decode_layer_arrays()
+        for b in blocks[1:]:
+            p = b.decode_layer_arrays()
+            if list(p) != list(per0) or any(
+                    p[k].shape != per0[k].shape
+                    or p[k].dtype != per0[k].dtype for k in per0):
+                return False
+    except (AttributeError, TypeError):
+        # un-materialized params or a structurally different variant
         return False
     return True
 

@@ -384,6 +384,33 @@ class SPMDTrainer:
             p._data._data = v
         return NDArray(losses)
 
+    def step_hlo_op_count(self, data, label):
+        """Optimized-HLO instruction count of the compiled one-step
+        program (``profiler_xla.hlo_op_count`` convention: fusion bodies
+        collapse to one op, while bodies count once) — the static
+        sequencer-overhead metric behind BASELINE.md's round-3 anatomy
+        (the BERT step's wall-vs-device MFU gap is ~5,300 ops x ~1 us of
+        fixed per-op cost).  Compiles but does not execute; donation is
+        irrelevant at lowering time."""
+        from ..ndarray.ndarray import NDArray
+        from .. import profiler_xla
+
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = label._data if isinstance(label, NDArray) \
+            else jnp.asarray(label)
+        self._ensure_built(NDArray(d), NDArray(l))
+        lr = jnp.asarray(self._opt.learning_rate, jnp.float32)
+        rescale = jnp.asarray(self._rescale, jnp.float32)
+        t = jnp.asarray(max(self._t, 1), jnp.int32)
+        # a CONSTANT key, not random.next_key(): only shapes matter for
+        # lowering, and a diagnostic must not advance the global PRNG
+        # stream (it would silently change dropout/sampling streams of
+        # the surrounding training run)
+        key = jax.random.PRNGKey(0)
+        return profiler_xla.hlo_op_count(
+            self._step_fn, self._train_vals, self._opt_states,
+            self._frozen_vals, key, lr, rescale, t, d, l)
+
     def step(self, data, label, batch_size: Optional[int] = None):
         """Run one fused train step; returns the (device-async) loss as an
         NDArray.  ``batch_size`` defaults to the global batch dim (grad is

@@ -22,11 +22,12 @@ import glob
 import gzip
 import json
 import os
+import re
 import tempfile
 from collections import defaultdict
 
 __all__ = ["parse_trace", "aggregate", "format_table", "profile_fn",
-           "latest_session"]
+           "latest_session", "count_hlo_ops", "hlo_op_count"]
 
 
 def latest_session(trace_dir):
@@ -163,3 +164,77 @@ def profile_fn(fn, *args, trace_dir=None, iters=2, warmup=True):
     for r in records:
         r["dur_us"] /= iters
     return records
+
+
+# ----------------------------------------------------------------------- #
+# static HLO op counting — the sequencer-overhead metric
+# ----------------------------------------------------------------------- #
+# BASELINE.md r4 decode profile: the per-token cost floor is ~230 device
+# ops x ~2.5 us of fixed sequencer cost each, and the BERT train step
+# carries the same ~5,300-op gap.  The trace profiler above measures the
+# overhead after the fact; these helpers measure the CAUSE — how many
+# instructions the compiled program issues per invocation — so a fix
+# (e.g. the stacked-layer scan decode) is assertable in CI on any
+# backend, CPU included.
+
+# instructions that exist in the HLO text but are not dispatched ops:
+# parameters/constants are materialized buffers, tuple plumbing is free,
+# bitcast is a layout annotation
+_NON_EXEC_OPS = frozenset(
+    ("parameter", "constant", "tuple", "get-tuple-element", "bitcast"))
+# computation params and instruction result types may be tuples with
+# internal spaces/parens — "(s32[], f32[2,4]{1,0})" — hence the loose
+# ".*) ->" header match and the explicit tuple-type alternative
+_COMP_HEADER = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s*->")
+_CALLED_COMP = re.compile(r"(?:calls=|to_apply=)%?([\w.\-]+)")
+_INSTR = re.compile(
+    r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s+=\s+(?:\([^)]*\)|\S+)\s+([\w\-]+)\(")
+
+
+def count_hlo_ops(hlo_text):
+    """Count the sequencer-visible instructions in optimized HLO text.
+
+    Convention (matches how the device trace counts executed ops):
+
+    - fusion bodies (``calls=``) and reduce/scatter/sort combinators
+      (``to_apply=``) execute as part of ONE instruction in their caller
+      — their inner instructions are not counted;
+    - ``while`` bodies/conditions ARE counted, ONCE — a body that runs NL
+      times still costs one body's worth of *distinct* program ops, which
+      is exactly the collapse a stacked-layer ``lax.scan`` buys over an
+      unrolled layer stack;
+    - parameters, constants, and tuple/get-tuple-element/bitcast plumbing
+      are free (no dispatched kernel).
+    """
+    excluded = set(_CALLED_COMP.findall(hlo_text))
+    n = 0
+    current = None
+    for line in hlo_text.splitlines():
+        m = _COMP_HEADER.match(line)
+        if m:
+            current = m.group(2)
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        if current is None or current in excluded:
+            continue
+        m = _INSTR.match(line)
+        if m and m.group(1) not in _NON_EXEC_OPS:
+            n += 1
+    return n
+
+
+def hlo_op_count(fn, *args, **kwargs):
+    """Compile ``fn(*args, **kwargs)`` and return its optimized-HLO
+    instruction count (see ``count_hlo_ops`` for the convention).
+
+    ``fn`` may be a ``jax.jit`` object or a plain python callable (jitted
+    here); args may be concrete arrays or ``jax.ShapeDtypeStruct``s — only
+    shapes/dtypes matter, nothing is executed."""
+    import jax
+
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    compiled = fn.lower(*args, **kwargs).compile()
+    return count_hlo_ops(compiled.as_text())

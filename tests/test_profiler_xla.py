@@ -116,3 +116,86 @@ def test_profiler_facade_device_dumps(tmp_path, monkeypatch):
     monkeypatch.setitem(profiler._state, "trace_dir", td)
     out = profiler.device_dumps(by="tf_op")
     assert "jit(f)/mul:" in out
+
+
+# --------------------------------------------------------------------- #
+# static HLO op counting (count_hlo_ops / hlo_op_count)
+# --------------------------------------------------------------------- #
+
+_HLO_SAMPLE = """\
+HloModule jit_f, is_scheduled=true
+
+%region_0.1 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add.1 = f32[] add(f32[] %a, f32[] %b)
+}
+
+%fused_computation (p0: f32[2,4]) -> f32[2,4] {
+  %p0 = f32[2,4]{1,0} parameter(0)
+  %c = f32[] constant(2)
+  %bc = f32[2,4]{1,0} broadcast(f32[] %c), dimensions={}
+  ROOT %mul.0 = f32[2,4]{1,0} multiply(f32[2,4]{1,0} %p0, f32[2,4]{1,0} %bc)
+}
+
+%body.2 (t: (s32[], f32[2,4])) -> (s32[], f32[2,4]) {
+  %t = (s32[], f32[2,4]{1,0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[2,4]{1,0}) %t), index=0
+  %x = f32[2,4]{1,0} get-tuple-element((s32[], f32[2,4]{1,0}) %t), index=1
+  %one = s32[] constant(1)
+  %ip = s32[] add(s32[] %i, s32[] %one)
+  %fus = f32[2,4]{1,0} fusion(f32[2,4]{1,0} %x), kind=kLoop, calls=%fused_computation
+  %z = f32[] constant(0)
+  %red = f32[2]{0} reduce(f32[2,4]{1,0} %fus, f32[] %z), dimensions={1}, to_apply=%region_0.1
+  %bcast.0 = f32[2,4]{1,0} broadcast(f32[2]{0} %red), dimensions={0}
+  ROOT %tup = (s32[], f32[2,4]{1,0}) tuple(s32[] %ip, f32[2,4]{1,0} %bcast.0)
+}
+
+%cond.3 (t: (s32[], f32[2,4])) -> pred[] {
+  %t = (s32[], f32[2,4]{1,0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[2,4]{1,0}) %t), index=0
+  %n = s32[] constant(4)
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %n), direction=LT
+}
+
+ENTRY %main.4 (arg: f32[2,4]) -> f32[2,4] {
+  %arg = f32[2,4]{1,0} parameter(0)
+  %zero = s32[] constant(0)
+  %tup.0 = (s32[], f32[2,4]{1,0}) tuple(s32[] %zero, f32[2,4]{1,0} %arg)
+  %wh = (s32[], f32[2,4]{1,0}) while((s32[], f32[2,4]{1,0}) %tup.0), condition=%cond.3, body=%body.2
+  ROOT %out = f32[2,4]{1,0} get-tuple-element((s32[], f32[2,4]{1,0}) %wh), index=1
+}
+"""
+
+
+def test_count_hlo_ops_convention():
+    """Fusion bodies and reduce combinators are excluded (they execute
+    as ONE op in their caller), while bodies/conds count once, and
+    parameter/constant/tuple plumbing is free.  Sample counts: body.2
+    has add+fusion+reduce+broadcast = 4, cond.3 has compare = 1, entry
+    has while = 1."""
+    assert profiler_xla.count_hlo_ops(_HLO_SAMPLE) == 6
+
+
+def test_hlo_op_count_scan_collapses_unrolled_loop():
+    """The API motivation in miniature: a scanned body compiles to one
+    body's worth of instructions regardless of trip count; the unrolled
+    loop grows with it."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def unrolled(x, w):
+        for i in range(8):
+            x = jnp.tanh(x @ w[i])
+        return x
+
+    def scanned(x, w):
+        return lax.scan(lambda x, wi: (jnp.tanh(x @ wi), None), x, w)[0]
+
+    x = jax.ShapeDtypeStruct((2, 16), jnp.float32)
+    w = jax.ShapeDtypeStruct((8, 16, 16), jnp.float32)
+    n_unrolled = profiler_xla.hlo_op_count(unrolled, x, w)
+    n_scanned = profiler_xla.hlo_op_count(jax.jit(scanned), x, w)
+    assert n_scanned < n_unrolled
+    assert n_unrolled >= 8  # at least one dot per unrolled layer
