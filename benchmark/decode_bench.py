@@ -11,6 +11,12 @@ r4 profile showed decode is sequencer-bound (~230 device ops x ~2.5 us
 of fixed per-op cost, BASELINE.md) — this column is the CAUSE metric the
 stacked-scan path collapses, measurable on any backend.
 
+The full run also carries the **ragged-arrival arm** (shared with
+``serve_bench.py``): one ragged workload served as static padded
+batches vs slot-pool continuous batching (``mxnet_tpu/serve/``) at
+25/50/100% padded-batch occupancy — the serving-shaped comparison the
+static arms can't express.
+
 ``--smoke``: tiny geometry, no TPU — exercises the unrolled and stacked
 arms plus the op-count column and asserts greedy parity between them;
 gated in tier-1 like ``step_profile.py --smoke``.
@@ -53,23 +59,31 @@ def smoke():
     B, P, N = 2, 8, 16
     prompt = onp.random.RandomState(0).randint(0, cfg.vocab_size, (B, P))
     outs, rows = {}, []
-    for arm, skw in (("unrolled", "off"), ("stacked", "on")):
+    for arm, skw, wmode in (("unrolled", "off", "native"),
+                            ("stacked", "on", "native"),
+                            ("int8_unrolled", "off", "int8"),
+                            ("int8_stacked", "on", "int8")):
         kv_generate(net, prompt, max_new_tokens=N, temperature=0.0,
-                    stacked=skw)  # compile
+                    stacked=skw, weights=wmode)  # compile
         t0 = time.perf_counter()
         outs[arm] = kv_generate(net, prompt, max_new_tokens=N,
-                                temperature=0.0, stacked=skw)
+                                temperature=0.0, stacked=skw,
+                                weights=wmode)
         dt = time.perf_counter() - t0
-        ops = _step_ops(net, P + N, "native", "off", skw)
+        ops = _step_ops(net, P + N, wmode, "off", skw)
         rows.append((arm, ops))
         print(json.dumps({"bench": "decode_smoke", "mode": arm,
                           "ops_per_step": ops,
                           "ms_per_token": round(dt / N * 1e3, 3),
                           "batch": B, "new_tokens": N}))
     onp.testing.assert_array_equal(outs["stacked"], outs["unrolled"])
+    onp.testing.assert_array_equal(outs["int8_stacked"],
+                                   outs["int8_unrolled"])
     ops = dict(rows)
     assert ops["stacked"] < ops["unrolled"], rows
-    print(f"# parity OK; ops/step {ops['unrolled']} -> {ops['stacked']}")
+    assert ops["int8_stacked"] < ops["int8_unrolled"], rows
+    print(f"# parity OK; ops/step {ops['unrolled']} -> {ops['stacked']}"
+          f" (int8 {ops['int8_unrolled']} -> {ops['int8_stacked']})")
     return 0
 
 
@@ -128,6 +142,7 @@ def main():
             ("native", "off", "on", "kv_cache_batch1_stacked"),
             ("native", "on", "off", "kv_cache_batch1_fused"),
             ("int8", "off", "off", "kv_cache_batch1_int8"),
+            ("int8", "off", "on", "kv_cache_batch1_int8_stacked"),
             ("int8", "on", "off", "kv_cache_batch1_int8_fused")]
     for wmode, fmode, smode, tag in arms:
         kw = dict(max_new_tokens=N, temperature=0.0, weights=wmode,
@@ -149,6 +164,28 @@ def main():
                           "ms_per_token": round(dt / N * 1e3, 3),
                           "ops_per_step": ops,
                           "batch": 1, "new_tokens": N, "prompt": P,
+                          "platform": platform}))
+        sys.stdout.flush()
+
+    # ragged-arrival arm: the same ragged workload (per 8-request wave
+    # one long request + seven short) served as static padded batches
+    # (every lane decodes to the wave max) vs slot-pool continuous
+    # batching (mxnet_tpu/serve/ — retired slots back-fill mid-flight).
+    # Useful-token throughput at 25/50/100% padded-batch occupancy;
+    # continuous wins at sparse occupancy wherever decode compute
+    # dominates dispatch (TPU, or serve_bench.py --cpu-full on CPU).
+    from benchmark.serve_bench import run_ragged
+    S_r, N_r = 8, N
+    for frac in (0.25, 0.5, 1.0):
+        st, ct, occ = run_ragged(net, cfg, S_r, P, N_r, frac,
+                                 2 * S_r)
+        print(json.dumps({"bench": "decode",
+                          "mode": f"ragged_occ={frac}",
+                          "static_padded_tok_s": round(st, 1),
+                          "continuous_tok_s": round(ct, 1),
+                          "continuous_vs_static": round(ct / st, 3),
+                          "occupancy": round(occ, 3),
+                          "num_slots": S_r, "new_tokens": N_r,
                           "platform": platform}))
         sys.stdout.flush()
 

@@ -56,6 +56,7 @@ def __getattr__(name):
         "recordio": ".recordio",
         "parallel": ".parallel",
         "models": ".models",
+        "serve": ".serve",
         "np": ".numpy",
         "npx": ".numpy_extension",
         "lr_scheduler": ".optimizer.lr_scheduler",

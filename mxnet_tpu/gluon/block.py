@@ -18,10 +18,20 @@ through the op registry so the tape records ONE CachedOp node (exactly like
 the reference records one CachedOp node, §4.2).  Mutable state (BatchNorm
 moving stats) is returned functionally as aux outputs and committed after
 execution — no tracer ever leaks into a Parameter.
+
+Jit-by-default: a NON-hybridized HybridBlock called at inference time
+(positional NDArray inputs, no autograd recording, no enclosing trace)
+routes through the same CachedOp trace cache automatically, so zoo models
+drop into predict loops and the decode server without a manual
+``hybridize()``.  A block whose forward is not trace-safe falls back to
+imperative execution permanently (first failed trace); explicit
+``hybridize(False)`` opts out; ``MXNET_JIT_BY_DEFAULT=0`` restores
+always-imperative.
 """
 from __future__ import annotations
 
 import contextlib
+import os
 import re
 import threading
 from collections import OrderedDict
@@ -399,6 +409,10 @@ class HybridBlock(Block):
         self._cached_op = None
         self._flags = {}
         self._last_input_structs = None
+        # jit-by-default trace cache state: None = untried, True = the
+        # block traces cleanly, False = opted out (explicit
+        # hybridize(False) or a failed trace — stays imperative)
+        self._auto_jit = None
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   **kwargs):
@@ -406,6 +420,9 @@ class HybridBlock(Block):
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
         self._cached_op = None
+        # hybridize(False) is an explicit request for imperative
+        # execution — the jit-by-default path honors it
+        self._auto_jit = None if active else False
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 
@@ -437,7 +454,54 @@ class HybridBlock(Block):
             for hook in self._forward_hooks.values():
                 hook(self, args, out)
             return out
+        if self._auto_jit is not False and not self._active and \
+                self._should_auto_jit(args, kwargs):
+            # hooks run OUTSIDE the try: a hook error is a user error
+            # and must propagate, not masquerade as a trace failure
+            for hook in self._forward_pre_hooks.values():
+                hook(self, args)
+            try:
+                out = self._call_cached_op(*args)
+            except Exception:
+                if self._auto_jit:      # worked before — real failure
+                    raise
+                # re-run imperatively (pre-hooks fire a second time on
+                # this one fallback call).  If the re-run ALSO raises,
+                # the error is real (bad input, user bug): it propagates
+                # with the trace still untried so a corrected call
+                # retries the jit.  Only a CLEAN imperative re-run
+                # proves the forward itself is trace-hostile (value-
+                # dependent Python control flow, host materialization)
+                # and permanently drops the block back to imperative
+                # execution.
+                self._auto_jit = None
+                self._cached_op = None
+                out = super().__call__(*args, **kwargs)
+                self._auto_jit = False
+                return out
+            else:
+                self._auto_jit = True
+                for hook in self._forward_hooks.values():
+                    hook(self, args, out)
+                return out
         return super().__call__(*args, **kwargs)
+
+    def _should_auto_jit(self, args, kwargs):
+        """Jit-by-default gate for non-hybridized INFERENCE calls: the
+        top-level forward of a zoo model dropped into a predict loop (or
+        the decode server) gets the CachedOp trace cache without a
+        manual ``hybridize()``.  Engages only outside autograd
+        recording and outside any active trace, for positional NDArray
+        inputs (the CachedOp calling convention) — the training path
+        and nested calls keep exact imperative semantics.
+        ``MXNET_JIT_BY_DEFAULT=0`` restores always-imperative."""
+        from .. import autograd
+        if kwargs or not args or _trace_state.no_hybrid or \
+                _trace_state.active or autograd.is_recording():
+            return False
+        if not all(isinstance(a, NDArray) for a in args):
+            return False
+        return os.environ.get("MXNET_JIT_BY_DEFAULT", "1") != "0"
 
     def forward(self, x, *args, **kwargs):
         from .. import ndarray as F
@@ -553,12 +617,15 @@ class _CachedOp:
     def _ensure_params(self, args, kwargs):
         if self._param_list is not None:
             return
-        # materialize deferred params with one imperative forward
+        # materialize deferred params with one imperative forward — via
+        # forward(), not __call__(): this warmup is internal, so the
+        # block's own hooks must not fire for it (the caller fires them
+        # exactly once around the real execution)
         params = self._block.collect_params()
         needs_init = any(p._data is None for p in params.values())
         if needs_init:
             with _no_hybrid():
-                self._block(*args, **kwargs)
+                self._block.forward(*args, **kwargs)
             params = self._block.collect_params()
         self._param_list = [(n, p) for n, p in params.items()
                             if p._data is not None]
@@ -601,35 +668,44 @@ class _CachedOp:
     def __call__(self, args, kwargs):
         from .. import autograd, random as mxrandom
         from ..ops.registry import Op, invoke
+        from .parameter import _TRACE_LOCK
 
         if kwargs:
             raise MXNetError(
                 "hybridized blocks accept positional arguments only "
                 "(reference CachedOp semantics); pass extra tensors "
                 "positionally or un-hybridize")
-        self._ensure_params(args, kwargs)
-        training = autograd.is_training()
-        fn = self._get_jitted(training)
-        if training not in self._structure:
-            # prime structure info with an eval_shape trace (no device work)
-            key0 = jax.random.PRNGKey(0)
-            param_vals = [p._data._data for _, p in self._param_list]
-            in_vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
-                       for a in args]
-            jax.eval_shape(fn, key0, *param_vals, *in_vals)
+        # under _TRACE_LOCK: a first call traces with the model's shared
+        # Parameters swapped to tracers, and every call reads p._data —
+        # either racing a concurrent trace (e.g. the serving thread
+        # retracing the same model) would capture a leaked tracer
+        with _TRACE_LOCK:
+            self._ensure_params(args, kwargs)
+            training = autograd.is_training()
+            fn = self._get_jitted(training)
+            if training not in self._structure:
+                # prime structure info with an eval_shape trace (no
+                # device work)
+                key0 = jax.random.PRNGKey(0)
+                param_vals = [p._data._data for _, p in self._param_list]
+                in_vals = [a._data if isinstance(a, NDArray)
+                           else jnp.asarray(a) for a in args]
+                jax.eval_shape(fn, key0, *param_vals, *in_vals)
 
-        key = mxrandom.next_key()
-        input_nds = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
-                     for a in args]
-        # legacy multi-ctx DP: feed the replicas matching the input device
-        # (jax.jit re-specializes per placement, like the reference's
-        # per-ctx GraphInfo cache)
-        in_ctx = input_nds[0].context if input_nds and any(
-            p._replicas is not None for _, p in self._param_list) else None
-        param_nds = [p.data(in_ctx) if p._replicas is not None else p._data
-                     for _, p in self._param_list]
-        opref = Op(name=f"CachedOp_{self._block.name}", fn=fn)
-        result = invoke(opref, [NDArray(key)] + param_nds + input_nds, {})
+            key = mxrandom.next_key()
+            input_nds = [a if isinstance(a, NDArray)
+                         else NDArray(jnp.asarray(a)) for a in args]
+            # legacy multi-ctx DP: feed the replicas matching the input
+            # device (jax.jit re-specializes per placement, like the
+            # reference's per-ctx GraphInfo cache)
+            in_ctx = input_nds[0].context if input_nds and any(
+                p._replicas is not None for _, p in self._param_list) \
+                else None
+            param_nds = [p.data(in_ctx) if p._replicas is not None
+                         else p._data for _, p in self._param_list]
+            opref = Op(name=f"CachedOp_{self._block.name}", fn=fn)
+            result = invoke(opref,
+                            [NDArray(key)] + param_nds + input_nds, {})
         outs = result if isinstance(result, list) else [result]
         n_out, out_is_seq, aux_params = self._structure[training]
         primary, aux_vals = outs[:n_out], outs[n_out:]

@@ -30,6 +30,19 @@ __all__ = ["Parameter", "Constant", "ParameterDict",
 
 
 import contextlib
+import threading
+
+# Serializes TRACE-TIME work across threads: ``params_swapped`` rebinds
+# the model's shared Parameter arrays to tracers while a program traces,
+# so a second thread reading weights (another trace, or an engine
+# collecting operand values) mid-swap would capture a leaked tracer.
+# The serving loop (``mxnet_tpu.serve``) runs on its own thread next to
+# user calls of ``kv_generate`` or jit-by-default ``net(x)`` forwards on
+# the same model — decode engine construction, the traced decode bodies'
+# swap scopes, and ``_CachedOp.__call__`` all acquire this lock.
+# Compiled executions never re-run the Python body, so steady state
+# never contends.
+_TRACE_LOCK = threading.RLock()
 
 
 @contextlib.contextmanager

@@ -18,8 +18,10 @@ Three per-token step implementations share the program skeleton
   profile showed the decode scan is SEQUENCER-bound (~230 device ops ×
   ~2.5 µs/step of fixed per-op cost, BASELINE.md), so collapsing the op
   count is the measured fix, and it is portable XLA — it lands on CPU CI
-  as well as TPU.  ``MXNET_STACKED_DECODE=0`` restores the unrolled path
-  bit-for-bit.
+  as well as TPU.  Covers the ``weights="int8"`` stream too (stacked q8
+  codes ride the scan xs through ``q8_matvec``), and a per-slot variant
+  (``pool_token``) is the serving step of ``mxnet_tpu.serve``.
+  ``MXNET_STACKED_DECODE=0`` restores the unrolled path bit-for-bit.
 - **unrolled**: the r3 generalization path (VERDICT r2 item 8) — the
   per-layer math is DERIVED FROM THE MODEL'S OWN BLOCKS (``ln1``/
   ``attn.qkv``/``ffn``/… invoked as Gluon layers on traced values via
@@ -27,7 +29,8 @@ Three per-token step implementations share the program skeleton
   changes normalization, activation, or bias structure inside those
   sublayers decodes correctly with no decoder change.  Only the
   cache-attention core is decoder-specific math.  This is the fallback
-  for non-uniform layer stacks and the ``weights="int8"`` path.
+  for non-uniform layer stacks (and any block variant the stacked gate
+  rejects), in both native and int8 weight modes.
 - **fused**: the TPU Pallas megakernel (``ops/decode_fused.py``) — ALL
   layers in one kernel launch per token.  Explicit opt-in only
   (``fused="on"``): the kernel is TPU-only and narrowly gated (batch ≤ 4,
@@ -58,6 +61,11 @@ import numpy as onp
 from jax import lax
 
 __all__ = ["kv_generate", "decode_mode", "decode_step_program"]
+
+# Trace-time cross-thread serialization — one lock for every
+# ``params_swapped`` site (kv_generate, the serving loop, _CachedOp);
+# defined next to the swap it guards.
+from ..gluon.parameter import _TRACE_LOCK
 
 
 def _call(layer, *vals):
@@ -132,31 +140,50 @@ def _check_args(prefill, weights, fused, stacked):
                          f"got {stacked!r}")
 
 
+def _family_tables(is_llama):
+    """THE per-family slot maps — projection layers and stacked norm
+    params, keyed by the slot names the scan body reads.  Every consumer
+    (``_layer_weight_srcs`` cache pinning, ``_build_q8`` unrolled codes,
+    ``_build_q8_stacked`` scan xs) derives from these two dicts, so a
+    new projection or a third block family is a one-place edit."""
+    if is_llama:
+        proj = {"q": lambda blk: blk.attn.q_proj,
+                "k": lambda blk: blk.attn.k_proj,
+                "v": lambda blk: blk.attn.v_proj,
+                "o": lambda blk: blk.attn.o_proj,
+                "gate": lambda blk: blk.mlp.gate,
+                "up": lambda blk: blk.mlp.up,
+                "down": lambda blk: blk.mlp.down}
+        norms = {"rms1_g": lambda blk: blk.rms1.gamma,
+                 "rms2_g": lambda blk: blk.rms2.gamma}
+    else:
+        proj = {"qkv": lambda blk: blk.attn.qkv,
+                "proj": lambda blk: blk.attn.proj,
+                "fc1": lambda blk: blk.ffn.fc1,
+                "fc2": lambda blk: blk.ffn.fc2}
+        norms = {"ln1_g": lambda blk: blk.ln1.gamma,
+                 "ln1_b": lambda blk: blk.ln1.beta,
+                 "ln2_g": lambda blk: blk.ln2.gamma,
+                 "ln2_b": lambda blk: blk.ln2.beta}
+    return proj, norms
+
+
 def _layer_weight_srcs(model, is_llama):
     """Pinned strong refs to every per-layer weight/bias/norm array —
     the cache-invalidation key shared by the Pallas pack and the stacked
     export: a train step rebinds parameter arrays, so comparing these by
     ``is`` detects staleness without hashing (and without the recycled-
     ``id()`` hazard documented at the q8 cache)."""
+    proj, norms = _family_tables(is_llama)
     srcs = []
     for blk in model.blocks:
-        if is_llama:
-            lyrs = (blk.attn.q_proj, blk.attn.k_proj,
-                    blk.attn.v_proj, blk.attn.o_proj,
-                    blk.mlp.gate, blk.mlp.up, blk.mlp.down)
-            lnls = (blk.rms1, blk.rms2)
-        else:
-            lyrs = (blk.attn.qkv, blk.attn.proj, blk.ffn.fc1,
-                    blk.ffn.fc2)
-            lnls = (blk.ln1, blk.ln2)
-        for lyr in lyrs:
+        for get in proj.values():
+            lyr = get(blk)
             srcs.append(lyr.weight.data()._data)
             if getattr(lyr, "bias", None) is not None:
                 srcs.append(lyr.bias.data()._data)
-        for lnl in lnls:
-            srcs.append(lnl.gamma.data()._data)
-            if getattr(lnl, "beta", None) is not None:
-                srcs.append(lnl.beta.data()._data)
+        for get in norms.values():
+            srcs.append(get(blk).data()._data)
     return srcs
 
 
@@ -183,8 +210,9 @@ def decode_mode(model, batch=1, total=32, weights="native", fused="auto",
     rejects the config); ``"auto"``/``"off"`` never select it: the
     kernel is TPU-only and shipped unmeasured (VERDICT r5), so it is
     explicit opt-in.  ``stacked="on"`` requires the stacked-layer scan
-    (raises when the model is not stackable or ``weights="int8"``);
-    ``"auto"`` uses it whenever supported; ``"off"`` never.  The
+    (raises when the model is not stackable); ``"auto"`` uses it
+    whenever supported — for both ``weights`` modes (the int8 stream
+    stacks its q8 codes); ``"off"`` never.  The
     ``MXNET_STACKED_DECODE=0`` escape hatch disables the stacked path
     globally — with ``stacked="on"`` that conflict raises rather than
     silently overriding either request."""
@@ -210,11 +238,6 @@ def decode_mode(model, batch=1, total=32, weights="native", fused="auto",
         return "fused"
     env_on = os.environ.get("MXNET_STACKED_DECODE", "1") != "0"
     if stacked == "on":
-        if weights == "int8":
-            raise MXNetError(
-                "stacked='on' does not cover weights='int8' — the q8 "
-                "streaming path runs per-layer (see PARITY.md decode "
-                "support matrix)")
         if not env_on:
             raise MXNetError("stacked='on' but MXNET_STACKED_DECODE=0 "
                              "disables the stacked decode path")
@@ -225,8 +248,7 @@ def decode_mode(model, batch=1, total=32, weights="native", fused="auto",
                 "unrecognized block family — see ops/decode_fused.py "
                 "stacked_decode_supported)")
         return "stacked"
-    if stacked == "auto" and env_on and weights == "native" \
-            and stacked_decode_supported(model):
+    if stacked == "auto" and env_on and stacked_decode_supported(model):
         return "stacked"
     return "unrolled"
 
@@ -240,6 +262,12 @@ class _DecodeEngine:
 
     def __init__(self, model, B, P, total, temperature, top_k, prefill,
                  weights, fused, stacked):
+        with _TRACE_LOCK:
+            self._init(model, B, P, total, temperature, top_k, prefill,
+                       weights, fused, stacked)
+
+    def _init(self, model, B, P, total, temperature, top_k, prefill,
+              weights, fused, stacked):
         cfg = model._cfg
         self.model = model
         self.cfg = cfg
@@ -285,11 +313,19 @@ class _DecodeEngine:
         if self.mode == "fused":
             self.packed = self._build_packed()
         elif self.mode == "stacked":
-            self.sw = _pinned_cache(
-                model, "_stacked_decode_cache",
-                _layer_weight_srcs(model, self.is_llama),
-                model.stacked_decode_weights)
-        if self.use_int8:
+            if self.use_int8:
+                # int8 stacked: the scan streams per-layer q8 codes as
+                # xs; only the LM head rides through the q8v operand
+                sq8 = self._build_q8_stacked()
+                self.sw = {k: v for k, v in sq8.items() if k != "head"}
+                self.q8v = {"head": sq8["head"]}
+                self.head_vocab = self._head_vocab()
+            else:
+                self.sw = _pinned_cache(
+                    model, "_stacked_decode_cache",
+                    _layer_weight_srcs(model, self.is_llama),
+                    model.stacked_decode_weights)
+        if self.use_int8 and self.q8v is None:
             self.q8v = self._build_q8()
 
     # -- weight preparation -------------------------------------------- #
@@ -311,6 +347,20 @@ class _DecodeEngine:
             lambda: pack_gpt_weights(model.blocks, cdtype,
                                      quant=self.use_int8))
 
+    def _head_arrays(self):
+        """(head weight (V, U), head bias or None) — the tied ``wte``
+        weight when the model has no separate head Block."""
+        head = self.head
+        head_w = (head.weight if head is not None
+                  else self.model.wte.weight).data()._data
+        head_b = None
+        if head is not None and getattr(head, "bias", None) is not None:
+            head_b = head.bias.data()._data
+        return head_w, head_b
+
+    def _head_vocab(self):
+        return int(self._head_arrays()[0].shape[0])
+
     def _build_q8(self):
         """int8 weight streaming: quantize the decode matmul weights.
         Codes are cached keyed on the SOURCE ARRAYS THEMSELVES (weights
@@ -320,22 +370,12 @@ class _DecodeEngine:
         (not id() snapshots) is load-bearing: freed buffer addresses get
         recycled by CPython, so an id()-keyed cache can silently serve
         stale codes after an update."""
-        model, head = self.model, self.head
-        head_w = (head.weight if head is not None
-                  else model.wte.weight).data()._data
+        model = self.model
+        head_w, head_b = self._head_arrays()
         self.head_vocab = int(head_w.shape[0])
-        head_b = None
-        if head is not None and getattr(head, "bias", None) is not None:
-            head_b = head.bias.data()._data
-        if self.is_llama:
-            lyr_tabs = [{"q": blk.attn.q_proj, "k": blk.attn.k_proj,
-                         "v": blk.attn.v_proj, "o": blk.attn.o_proj,
-                         "gate": blk.mlp.gate, "up": blk.mlp.up,
-                         "down": blk.mlp.down} for blk in model.blocks]
-        else:
-            lyr_tabs = [{"qkv": blk.attn.qkv, "proj": blk.attn.proj,
-                         "fc1": blk.ffn.fc1, "fc2": blk.ffn.fc2}
-                        for blk in model.blocks]
+        proj, _ = _family_tables(self.is_llama)
+        lyr_tabs = [{k: get(blk) for k, get in proj.items()}
+                    for blk in model.blocks]
         srcs = [l.weight.data()._data for t in lyr_tabs
                 for l in t.values()]
         srcs += [l.bias.data()._data for t in lyr_tabs
@@ -360,6 +400,49 @@ class _DecodeEngine:
                 "head": _quantize_head(head_w, head_b),
             })
 
+    def _build_q8_stacked(self):
+        """int8 codes for the STACKED scan: every projection's per-layer
+        (in, out) codes / (out,) scales / biases stacked to (NL, ...)
+        arrays that ride the layer scan's xs, next to the stacked norm
+        rows (same slot names as the native stack so the scan body
+        shares its norm code).  Missing biases stack as zeros (adding
+        f32 0 is exact, matching the unrolled path's no-bias add) unless
+        the whole family is bias-free (Llama), where the slot is
+        dropped.  Cached pinned on the layer+head source arrays — the
+        same rebind-invalidation discipline as ``_build_q8``."""
+        model = self.model
+        head_w, head_b = self._head_arrays()
+        srcs = _layer_weight_srcs(model, self.is_llama) + [head_w]
+        if head_b is not None:
+            srcs.append(head_b)
+
+        def _build():
+            kinds, norms = _family_tables(self.is_llama)
+            out = {}
+            for kind, get in kinds.items():
+                qs, ss, bs = [], [], []
+                any_bias = any(getattr(get(blk), "bias", None) is not None
+                               for blk in model.blocks)
+                for blk in model.blocks:
+                    lyr = get(blk)
+                    wq, s = _quantize_rows(lyr.weight.data()._data)
+                    qs.append(wq)
+                    ss.append(s)
+                    if any_bias:
+                        b = lyr.bias.data()._data \
+                            if getattr(lyr, "bias", None) is not None \
+                            else jnp.zeros((wq.shape[1],), self.cdtype)
+                        bs.append(b)
+                out[kind] = (jnp.stack(qs), jnp.stack(ss),
+                             jnp.stack(bs) if any_bias else None)
+            for name, get in norms.items():
+                out[name] = jnp.stack(
+                    [get(blk).data()._data for blk in model.blocks])
+            out["head"] = _quantize_head(head_w, head_b)
+            return out
+
+        return _pinned_cache(model, "_q8_stacked_cache", srcs, _build)
+
     # -- step bodies ---------------------------------------------------- #
     def _dense_q8(self, x, ent, act_type=None):
         """Weight-only int8 matvec via the Pallas streaming kernel: int8
@@ -373,16 +456,27 @@ class _DecodeEngine:
             y = get_op("Activation").fn(y, act_type=act_type)
         return y
 
-    def _sample(self, logits, t, key0):
+    def _sample_logits(self, logits):
+        """Shared temperature/top_k logits preparation — ``None`` means
+        greedy (argmax).  The batch sampler and the serving per-slot
+        sampler (``serve.engine.PoolPrograms._sample_slots``) both draw
+        from THIS prep, so a sampler tweak (e.g. top_p) lands in the
+        offline and served streams together — the parity contract."""
         temperature, top_k = self.temperature, self.top_k
         if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return None
         # temperature is a python-scalar closure capture, not an operand:
         # tracelint: disable=TL001 -- scalar cast folds at trace time
         lg = logits / max(float(temperature), 1e-6)
         if top_k and top_k < lg.shape[-1]:
             kth = jax.lax.top_k(lg, top_k)[0][:, -1]
             lg = jnp.where(lg < kth[:, None], -jnp.inf, lg)
+        return lg
+
+    def _sample(self, logits, t, key0):
+        lg = self._sample_logits(logits)
+        if lg is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return jax.random.categorical(
             jax.random.fold_in(key0, t), lg, axis=-1).astype(jnp.int32)
 
@@ -484,7 +578,7 @@ class _DecodeEngine:
         xl = _call(model.ln_f, x)
         return self._head_logits(xl, q8), ck, cv
 
-    def stacked_token(self, x_tok, pos, ck, cv, sw):
+    def stacked_token(self, x_tok, pos, ck, cv, sw, q8=None):
         """one_token's stacked twin — THE op-count collapse: the layer
         loop is ONE ``lax.scan`` over the (NL, ...) stacked weights
         (``sw``), with the per-layer K/V cache slices riding the scan's
@@ -493,9 +587,30 @@ class _DecodeEngine:
         body dispatches the IDENTICAL op functions the model's sublayers
         dispatch (FullyConnected / LayerNorm / RMSNorm / Activation /
         rope, same arguments), so greedy and sampled token streams match
-        the unrolled path.  Compiled cost: one layer-body of HLO + the
-        embed/head/sample tail, ~5x under the unrolled step's op count
-        at GPT-2-small depth (benchmark/decode_bench.py ops/step)."""
+        the unrolled path.  With ``weights='int8'`` the xs carry stacked
+        q8 codes/scales instead and every projection runs ``q8_matvec``
+        (the same kernel and cast order as the unrolled q8 path, so int8
+        stacked matches int8 unrolled token-for-token).  Compiled cost:
+        one layer-body of HLO + the embed/head/sample tail, ~5x under
+        the unrolled step's op count at GPT-2-small depth
+        (benchmark/decode_bench.py ops/step)."""
+        return self._scan_token(x_tok, pos, ck, cv, sw, q8,
+                                per_slot=False)
+
+    def pool_token(self, x_tok, pos, ck, cv, sw, q8=None):
+        """stacked_token with PER-ROW positions — the slot-pool serving
+        step (``mxnet_tpu.serve``): every batch row is an independent
+        sequence at its own depth ``pos[b]``, so the attention mask,
+        rotary angles and cache-column writes are per-slot (the writes
+        are scatters at ``(b, pos[b])`` instead of one
+        dynamic_update_slice).  Retired slots keep computing (masked by
+        the caller) — their cache writes land at their stale position
+        and are overwritten on admission, so no branch, no retrace, no
+        host sync."""
+        return self._scan_token(x_tok, pos, ck, cv, sw, q8,
+                                per_slot=True)
+
+    def _scan_token(self, x_tok, pos, ck, cv, sw, q8, per_slot):
         from ..ops.attention import rope as _rope
         from ..ops.registry import get_op
 
@@ -505,48 +620,81 @@ class _DecodeEngine:
         _act = get_op("Activation").fn
         B, U, H, KV, D = self.B, self.U, self.H, self.KV, self.D
         llama, cdtype = self.is_llama, self.cdtype
+        int8 = self.use_int8
         eps1, eps2 = self.norm_eps
         act_t, scale, rope_base = self.act_t, self.scale, self.rope_base
+        # the unrolled q8 path's matvec+cast+activation body, verbatim —
+        # stacked int8 matches unrolled int8 token-for-token through it
+        _q8l = self._dense_q8
+
+        def _ropeq(t):
+            # pos is a traced scalar (stacked) or (B,) per-slot vector
+            # (pool) — rope's position_offset handles both, so the pool
+            # rows share the batch path's rotary math exactly
+            return _rope.__wrapped__(t, base=rope_base,
+                                     position_offset=pos)
 
         x = self._embed(x_tok, pos)
         idx = lax.broadcasted_iota(jnp.int32, (1, 1, self.total), 2)
+        # (1,1,1,T) <= scalar pos, or <= (B,1,1,1) per-slot positions
+        pos_b = pos[:, None, None, None] if per_slot else pos
+        iB = jnp.arange(B)
 
         def body(x, xs):
             w, kc, vc = xs                    # per-layer slices
             if llama:
                 h = _rms(x, w["rms1_g"], eps=eps1)
-                q = _fc(h, w["q_w"], None, no_bias=True,
-                        flatten=False).reshape(B, H, 1, D)
-                k = _fc(h, w["k_w"], None, no_bias=True,
-                        flatten=False).reshape(B, KV, 1, D)
-                v = _fc(h, w["v_w"], None, no_bias=True,
-                        flatten=False).reshape(B, KV, 1, D)
-                q = _rope.__wrapped__(q, base=rope_base,
-                                      position_offset=pos)
-                k = _rope.__wrapped__(k, base=rope_base,
-                                      position_offset=pos)
+                if int8:
+                    q = _q8l(h, w["q"]).reshape(B, H, 1, D)
+                    k = _q8l(h, w["k"]).reshape(B, KV, 1, D)
+                    v = _q8l(h, w["v"]).reshape(B, KV, 1, D)
+                else:
+                    q = _fc(h, w["q_w"], None, no_bias=True,
+                            flatten=False).reshape(B, H, 1, D)
+                    k = _fc(h, w["k_w"], None, no_bias=True,
+                            flatten=False).reshape(B, KV, 1, D)
+                    v = _fc(h, w["v_w"], None, no_bias=True,
+                            flatten=False).reshape(B, KV, 1, D)
+                q = _ropeq(q)
+                k = _ropeq(k)
             else:
                 h = _ln(x, w["ln1_g"], w["ln1_b"], eps=eps1)
-                qkv = _fc(h, w["qkv_w"], w["qkv_b"], flatten=False)
+                qkv = _q8l(h, w["qkv"]) if int8 else \
+                    _fc(h, w["qkv_w"], w["qkv_b"], flatten=False)
                 q, k, v = (qkv[:, j * U:(j + 1) * U].reshape(B, H, 1, D)
                            for j in range(3))
-            kc = lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
-            vc = lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+            if per_slot:
+                kc = kc.at[iB, :, pos, :].set(k[:, :, 0, :])
+                vc = vc.at[iB, :, pos, :].set(v[:, :, 0, :])
+            else:
+                kc = lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+                vc = lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
             qg = q.reshape(B, KV, H // KV, D)
             s = jnp.einsum("bkgd,bktd->bkgt", qg, kc,
                            preferred_element_type=jnp.float32) * scale
-            s = jnp.where(idx[:, :, None] <= pos, s, -1e30)
+            s = jnp.where(idx[:, :, None] <= pos_b, s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(cdtype)
             o = jnp.einsum("bkgt,bktd->bkgd", p, vc).reshape(B, U)
             if llama:
-                x = x + _fc(o, w["o_w"], None, no_bias=True,
-                            flatten=False)
+                x = x + (_q8l(o, w["o"]) if int8 else
+                         _fc(o, w["o_w"], None, no_bias=True,
+                             flatten=False))
                 h2 = _rms(x, w["rms2_g"], eps=eps2)
-                g = _fc(h2, w["gate_w"], None, no_bias=True,
-                        flatten=False)
-                u = _fc(h2, w["up_w"], None, no_bias=True, flatten=False)
-                x = x + _fc(g * jax.nn.sigmoid(g) * u, w["down_w"], None,
-                            no_bias=True, flatten=False)
+                if int8:
+                    g = _q8l(h2, w["gate"])
+                    u = _q8l(h2, w["up"])
+                    x = x + _q8l(g * jax.nn.sigmoid(g) * u, w["down"])
+                else:
+                    g = _fc(h2, w["gate_w"], None, no_bias=True,
+                            flatten=False)
+                    u = _fc(h2, w["up_w"], None, no_bias=True,
+                            flatten=False)
+                    x = x + _fc(g * jax.nn.sigmoid(g) * u, w["down_w"],
+                                None, no_bias=True, flatten=False)
+            elif int8:
+                x = x + _q8l(o, w["proj"])
+                h2 = _ln(x, w["ln2_g"], w["ln2_b"], eps=eps2)
+                x = x + _q8l(_q8l(h2, w["fc1"], act_t), w["fc2"])
             else:
                 x = x + _fc(o, w["proj_w"], w["proj_b"], flatten=False)
                 h2 = _ln(x, w["ln2_g"], w["ln2_b"], eps=eps2)
@@ -558,11 +706,17 @@ class _DecodeEngine:
 
         x, (knew, vnew) = lax.scan(body, x, (sw, ck, cv))
         # knew/vnew: (NL, B, KV, 1, D) — all layers' new columns land in
-        # the carried caches as ONE update each
-        ck = lax.dynamic_update_slice(ck, knew, (0, 0, 0, pos, 0))
-        cv = lax.dynamic_update_slice(cv, vnew, (0, 0, 0, pos, 0))
+        # the carried caches as ONE update (slice, or per-slot scatter)
+        if per_slot:
+            ck = ck.at[:, iB, :, pos, :].set(
+                jnp.moveaxis(knew[:, :, :, 0, :], 0, 1))
+            cv = cv.at[:, iB, :, pos, :].set(
+                jnp.moveaxis(vnew[:, :, :, 0, :], 0, 1))
+        else:
+            ck = lax.dynamic_update_slice(ck, knew, (0, 0, 0, pos, 0))
+            cv = lax.dynamic_update_slice(cv, vnew, (0, 0, 0, pos, 0))
         xl = _call(self.model.ln_f, x)
-        return self._head_logits(xl, None), ck, cv
+        return self._head_logits(xl, q8), ck, cv
 
     def fused_token(self, x_tok, pos, ck, cv, packed_t, q8=None):
         """one_token's Pallas twin: embeddings and head stay XLA ops;
@@ -583,14 +737,19 @@ class _DecodeEngine:
         if self.mode == "fused":
             return self.fused_token(tok, t, ck, cv, packed_t, q8)
         if self.mode == "stacked":
-            return self.stacked_token(tok, t, ck, cv, sw)
+            return self.stacked_token(tok, t, ck, cv, sw, q8)
         return self.one_token(tok, t, ck, cv, q8)
 
-    def prefill_batch(self, prompt_dev, ck, cv):
+    def prefill_batch(self, prompt_dev, ck, cv, last_index=None):
         """One causal forward over the whole (B, P) prompt: fills cache
-        positions [0, P) and returns the position-P-1 logits.  Exact same
-        math as the per-token path (einsum + f32 softmax), reshaped onto
-        MXU-friendly (B·P, ·) GEMMs."""
+        positions [0, P) and returns the position-P-1 logits (or the
+        position-``last_index`` logits when given — the serving
+        admission path right-pads prompts to a compiled bucket length
+        and reads the logits at the true last token; the padded tail's
+        cache columns are overwritten by decode steps before any step
+        attends to them).  Exact same math as the per-token path
+        (einsum + f32 softmax), reshaped onto MXU-friendly (B·P, ·)
+        GEMMs."""
         from ..ops.attention import rope as _rope
 
         from ..ops.registry import get_op
@@ -643,7 +802,10 @@ class _DecodeEngine:
             else:
                 x = x + _call(blk.attn.proj, o)
                 x = x + _call(blk.ffn, _call(blk.ln2, x))
-        xl = _call(model.ln_f, x[:, -1])
+        x_last = x[:, -1] if last_index is None else \
+            lax.dynamic_index_in_dim(x, last_index, axis=1,
+                                     keepdims=False)
+        xl = _call(model.ln_f, x_last)
         # the prefill head is always native (q8 covers decode-step
         # matvecs; the prefill runs once)
         return self._head_logits(xl, None), ck, cv
@@ -673,7 +835,7 @@ class _DecodeEngine:
 
         if self.prefill == "batched":
             def run(param_vals, q8, packed_t, sw, prompt_dev, key0):
-                with params_swapped(eng.params, param_vals):
+                with _TRACE_LOCK, params_swapped(eng.params, param_vals):
                     ck, cv = eng.zero_caches()
                     logits, ck, cv = eng.prefill_batch(prompt_dev, ck, cv)
                     first = eng._sample(logits, P - 1, key0)
@@ -691,7 +853,7 @@ class _DecodeEngine:
                     return jnp.concatenate([first[None], toks])  # (N, B)
         else:
             def run(param_vals, q8, packed_t, sw, prompt_dev, key0):
-                with params_swapped(eng.params, param_vals):
+                with _TRACE_LOCK, params_swapped(eng.params, param_vals):
 
                     def scan_body(carry, t):
                         tok, ck, cv = carry
@@ -739,8 +901,10 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
     path — greedy tokens can differ from the exact native path (~0.4%
     weight error); measured r4: the decode step is sequencer-bound at
     GPT-2-small size, so int8's byte savings pay off only on larger
-    models (BASELINE.md decode section).  int8 always runs the
-    per-layer unrolled step (see PARITY.md decode support matrix).
+    models (BASELINE.md decode section).  int8 runs the stacked-layer
+    scan wherever the native path does (stacked q8 codes ride the scan
+    xs; see PARITY.md decode support matrix), falling back to the
+    per-layer unrolled step like native weights.
 
     ``stacked``: ``"auto"`` (default) runs the decode scan step as ONE
     ``lax.scan`` over stacked (NL, ...) layer weights whenever the model
@@ -811,7 +975,7 @@ def decode_step_program(model, batch=1, total=32, temperature=0.0,
     from ..gluon.parameter import params_swapped
 
     def step(param_vals, q8, packed_t, sw, tok, pos, ck, cv, key0):
-        with params_swapped(eng.params, param_vals):
+        with _TRACE_LOCK, params_swapped(eng.params, param_vals):
             logits, ck, cv = eng.token_step(tok, pos, ck, cv, q8,
                                             packed_t, sw)
             nxt = eng._sample(logits, pos, key0)

@@ -916,15 +916,19 @@ def rope(x, *, base=10000.0, position_offset=0):
 
     Rotates consecutive (even, odd) feature pairs by position-dependent
     angles: theta_i = pos / base^(2i/D).  ``position_offset`` supports
-    KV-cache decode (queries at absolute positions offset..offset+L)."""
+    KV-cache decode: a scalar offsets every row uniformly (queries at
+    absolute positions offset..offset+L); a (B,) vector gives each
+    batch row its own absolute depth (the slot-pool serving step, where
+    every row is an independent sequence at its own position)."""
     B, H, L, D = x.shape
     half = D // 2
     inv_freq = 1.0 / (base ** (
         jnp.arange(0, half, dtype=jnp.float32) * 2.0 / D))
-    pos = jnp.arange(L, dtype=jnp.float32) + position_offset
-    angles = pos[:, None] * inv_freq[None, :]           # (L, half)
-    cos = jnp.cos(angles)[None, None]                   # (1,1,L,half)
-    sin = jnp.sin(angles)[None, None]
+    off = jnp.asarray(position_offset, dtype=jnp.float32)
+    pos = jnp.arange(L, dtype=jnp.float32) + off[..., None]  # (L,)|(B,L)
+    angles = pos[..., None] * inv_freq              # (L,half)|(B,L,half)
+    cos = jnp.expand_dims(jnp.cos(angles), -3)      # (1,L,h)|(B,1,L,h)
+    sin = jnp.expand_dims(jnp.sin(angles), -3)
     x32 = x.astype(jnp.float32)
     x1 = x32[..., 0::2]
     x2 = x32[..., 1::2]

@@ -1,0 +1,19 @@
+"""Continuous-batching decode serving (``mx.serve``).
+
+The "millions of users" workload on top of the KV-cache decode stack:
+a request queue + scheduler where ragged requests join the running
+compiled decode step at step boundaries, sharing ONE resident slot-pool
+K/V cache (``docs/SERVING.md``).
+
+    server = mx.serve.DecodeServer(net, max_total_len=256)
+    stream = server.submit(prompt_ids, max_new_tokens=64)
+    for tok in stream:          # tokens as they decode
+        ...
+    server.close()
+"""
+from .server import (DecodeServer, TokenStream, serve_counters,
+                     reset_serve_counters)
+from .engine import PoolPrograms
+
+__all__ = ["DecodeServer", "TokenStream", "PoolPrograms",
+           "serve_counters", "reset_serve_counters"]

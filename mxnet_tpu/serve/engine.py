@@ -1,0 +1,221 @@
+"""Slot-pool decode programs — the device side of the continuous-
+batching server (``mxnet_tpu.serve.server``).
+
+One resident ``(NL, S, KV, T, D)`` K/V-cache pair is shared by all
+in-flight sequences; per-slot position / last-token / active / stop /
+sampling-key state rides as TRACED OPERANDS next to it, so admission and
+retirement are device-side masked updates — no recompile, no host sync
+in the step.  Three compiled units per pool size ``S``:
+
+- **step** — ``_DecodeEngine.pool_token`` (the stacked-layer scan with
+  per-slot positions) + per-slot sampling + retirement flags, jitted
+  with the caches donated: ONE executable dispatch per decode step, the
+  same one-executable discipline as ``kv_generate``'s scan
+  (``tests/test_serve.py`` pins the dispatch count).
+- **admit(P_bucket)** — one causal prefill over a right-padded prompt
+  (compiled per bucket length, so admission cost is pinned to a handful
+  of programs), its K/V written into the admitted slot, the first token
+  sampled at the true last prompt position.  The padded tail's cache
+  columns are garbage but UNREACHABLE: a decode step at position ``q``
+  writes its own column before attending, so every attended column was
+  produced by this sequence.
+- **sampling** — per-slot ``fold_in(key_slot, pos_slot)`` +
+  ``categorical`` on that slot's row, matching ``kv_generate``'s
+  batch-1 stream for the same seed token-for-token (greedy is argmax).
+
+Retired slots keep computing (their lanes are masked in the outputs);
+their cache writes land at the stale position and are overwritten on
+the next admission.  That wasted lane is the occupancy cost the
+benchmark measures — the alternative (reshaping the batch) retraces.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..models.decoding import _DecodeEngine, _TRACE_LOCK
+
+__all__ = ["PoolPrograms", "pool_state_init", "pool_state_grow"]
+
+
+def pool_state_init(eng, device=None):
+    """Fresh all-idle pool state for a ``PoolPrograms``' engine:
+    ``(ck, cv, pos, tok, active, stop, keys)`` — the traced-operand set
+    every step/admit executable threads through.
+
+    Every array is COMMITTED to ``device`` (default: the backend's
+    first device).  jit keys its executable cache on each argument's
+    committed placement, so an uncommitted ``jnp.zeros`` init state
+    would compile one signature for the first step and a SECOND
+    (identical-aval) signature once the state is jit outputs — a
+    silent ~seconds retrace on the serving hot path at steady state."""
+    S = eng.B
+    if device is None:
+        device = jax.devices()[0]
+    ck, cv = eng.zero_caches()
+    state = (ck, cv,
+             jnp.zeros((S,), jnp.int32),          # pos: next write index
+             jnp.zeros((S,), jnp.int32),          # tok: last sampled
+             jnp.zeros((S,), jnp.bool_),          # active
+             jnp.zeros((S,), jnp.int32),          # stop: retire position
+             jnp.zeros((S, 2), jnp.uint32))       # per-slot PRNG keys
+    return jax.device_put(state, device)
+
+
+def pool_state_grow(state, new_s):
+    """Pad every slot-axis array of ``state`` up to ``new_s`` slots (the
+    new lanes come up idle).  Runs eagerly — pool growth happens at a
+    step boundary, a handful of times per server lifetime."""
+    ck, cv, pos, tok, active, stop, keys = state
+    grow = new_s - ck.shape[1]
+    if grow <= 0:
+        raise MXNetError(f"pool can only grow: {ck.shape[1]} -> {new_s}")
+    pad = lambda a, axis: jnp.pad(
+        a, [(0, grow) if i == axis else (0, 0) for i in range(a.ndim)])
+    grown = (pad(ck, 1), pad(cv, 1), pad(pos, 0), pad(tok, 0),
+             pad(active, 0), pad(stop, 0), pad(keys, 0))
+    # committed placement, same contract as pool_state_init
+    return jax.device_put(grown, list(ck.devices())[0])
+
+
+class PoolPrograms:
+    """Compiled decode-step + per-bucket admission executables for ONE
+    pool size (slot count) ``num_slots`` against a ``max_total``-column
+    cache.  ``temperature``/``top_k``/``eos_id`` are server-level static
+    config (they shape the compiled sampler); per-request variation
+    rides in the operands (seed key, stop position)."""
+
+    def __init__(self, model, num_slots, max_total, temperature=0.0,
+                 top_k=0, eos_id=None, weights="native"):
+        self.model = model
+        self.S, self.T = int(num_slots), int(max_total)
+        self.temperature, self.top_k = float(temperature), int(top_k)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.weights = weights
+        self.eng = _DecodeEngine(model, self.S, 1, self.T, temperature,
+                                 top_k, "batched", weights, "off",
+                                 "auto")
+        if self.eng.mode != "stacked":
+            raise MXNetError(
+                "slot-pool serving needs the stacked-layer scan decode "
+                "step (uniform GPT/Llama stack — see ops/decode_fused."
+                "stacked_decode_supported); this model resolved to "
+                f"{self.eng.mode!r}.  MXNET_SERVE_SYNC=1 serves it "
+                "through the synchronous kv_generate fallback instead.")
+        # the server owns the weight operands (engine refs dropped so
+        # the cached executables' closures can't pin stale arrays)
+        param_vals, q8, _packed, sw = self.eng.take_operands()
+        self.operands = (param_vals, q8, sw)
+        self._step = None
+        self._admits = {}                  # bucket length -> jitted fn
+
+    # -- sampling ------------------------------------------------------- #
+    def _sample_slots(self, keys, logits, pos):
+        """Per-slot next token: slot ``i`` draws with
+        ``fold_in(keys[i], pos[i])`` over its own logits row — the exact
+        key/categorical stream ``kv_generate(seed=...)`` runs at batch 1,
+        so a served request reproduces the offline stream.  The
+        temperature/top_k prep is ``_DecodeEngine._sample_logits``, the
+        SAME prep the offline sampler draws from."""
+        lg = self.eng._sample_logits(logits)
+        if lg is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def draw(key, row, p):
+            return jax.random.categorical(
+                jax.random.fold_in(key, p), row[None, :], axis=-1)[0]
+
+        return jax.vmap(draw)(keys, lg, pos).astype(jnp.int32)
+
+    def _retire_flags(self, active, nxt, newpos, stop):
+        done = active & (newpos >= stop)
+        if self.eos_id is not None:
+            done = done | (active & (nxt == self.eos_id))
+        return done
+
+    # -- the decode step ------------------------------------------------ #
+    def step_fn(self):
+        """The jitted pool step (cached): ``step(param_vals, q8, sw,
+        ck, cv, pos, tok, active, stop, keys)`` → new state +
+        ``(emit_tok, emitted, done)`` readback arrays.  Caches are
+        donated — steady-state serving is one donated-buffer executable
+        dispatch per emitted token wave."""
+        if self._step is not None:
+            return self._step
+        from ..gluon.parameter import params_swapped
+
+        eng = self.eng
+
+        def step(param_vals, q8, sw, ck, cv, pos, tok, active, stop,
+                 keys):
+            with _TRACE_LOCK, params_swapped(eng.params, param_vals):
+                logits, ck, cv = eng.pool_token(tok, pos, ck, cv, sw,
+                                                q8)
+                nxt = self._sample_slots(keys, logits, pos)
+            nxt = jnp.where(active, nxt, tok)
+            newpos = jnp.where(active, pos + 1, pos)
+            done = self._retire_flags(active, nxt, newpos, stop)
+            emitted = active
+            new_state = (ck, cv, newpos, nxt, active & ~done, stop,
+                         keys)
+            return new_state, (nxt, emitted, done)
+
+        self._step = jax.jit(step, donate_argnums=(3, 4))
+        return self._step
+
+    # -- admission ------------------------------------------------------ #
+    def admit_fn(self, bucket):
+        """The jitted admission program for prompts padded to
+        ``bucket`` tokens (cached per bucket): ``admit(param_vals,
+        prompt (1, bucket), meta (4,) int32 = [true_len, slot,
+        stop_pos, seed], ck, cv, pos, tok, active, stop, keys)`` →
+        new state + ``(first_tok, done)``.  One causal prefill fills
+        the slot's cache columns [0, bucket) and the first continuation
+        token is sampled at ``true_len - 1``; a request whose budget is
+        a single token (or whose first token is EOS) comes back
+        ``done`` and never occupies a step lane.  The per-request
+        scalars ride in ONE packed vector and the PRNG key is derived
+        on device — admission cost is one H2D of the prompt + meta,
+        not a fan of scalar puts."""
+        fn = self._admits.get(bucket)
+        if fn is not None:
+            return fn
+        if not 0 < bucket <= self.T:
+            raise MXNetError(f"prompt bucket {bucket} outside cache "
+                             f"length {self.T}")
+        from ..gluon.parameter import params_swapped
+
+        peng = _DecodeEngine(self.model, 1, bucket, self.T,
+                             self.temperature, self.top_k, "batched",
+                             self.weights, "off", "auto")
+        peng.take_operands()    # server-held operands are the only refs
+
+        def admit(param_vals, prompt, meta, ck, cv, pos, tok, active,
+                  stop, keys):
+            true_len, slot, stop_pos, seed = (meta[0], meta[1], meta[2],
+                                              meta[3])
+            key = jax.random.PRNGKey(seed)
+            with _TRACE_LOCK, params_swapped(peng.params, param_vals):
+                ck1, cv1 = peng.zero_caches()
+                logits, ck1, cv1 = peng.prefill_batch(
+                    prompt, ck1, cv1, last_index=true_len - 1)
+                first = self._sample_slots(
+                    key[None], logits, (true_len - 1)[None])[0]
+            ck = lax.dynamic_update_slice(ck, ck1, (0, slot, 0, 0, 0))
+            cv = lax.dynamic_update_slice(cv, cv1, (0, slot, 0, 0, 0))
+            done = stop_pos <= true_len
+            if self.eos_id is not None:
+                done = done | (first == self.eos_id)
+            pos = pos.at[slot].set(true_len)
+            tok = tok.at[slot].set(first)
+            active = active.at[slot].set(~done)
+            stop = stop.at[slot].set(stop_pos)
+            keys = keys.at[slot].set(key)
+            new_state = (ck, cv, pos, tok, active, stop, keys)
+            return new_state, (first, done)
+
+        fn = jax.jit(admit, donate_argnums=(3, 4))
+        self._admits[bucket] = fn
+        return fn

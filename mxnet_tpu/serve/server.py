@@ -1,0 +1,626 @@
+"""Continuous-batching decode server.
+
+``DecodeServer`` turns the one-shot ``kv_generate`` decode stack into a
+request-serving loop: callers ``submit()`` ragged requests at any time
+and new sequences JOIN THE RUNNING COMPILED STEP at step boundaries
+instead of waiting for a static batch to drain (the Orca / vLLM
+continuous-batching design, rebuilt on this repo's trace discipline).
+
+Scheduler shape (one ``pump()`` = one step boundary):
+
+1. **admit** — while a slot is free and a request is pending, dispatch
+   the per-bucket admission executable (prefill + first token into the
+   slot's cache columns).  Pool sizes are pinned to the
+   ``MXNET_SERVE_POOL_SIZES`` set; when the backlog outgrows the pool
+   the state is padded up to the next pinned size (a handful of
+   retraces per server lifetime, never per request).
+2. **step** — if any slot is live, dispatch ONE decode-step executable
+   (``serve.engine.PoolPrograms.step_fn``): every active slot advances
+   one token, retired slots are masked.  The dispatch is async — the
+   host never blocks here.
+3. **drain** — read back the PREVIOUS dispatches' small
+   ``(token, emitted, done)`` arrays (they are ready or nearly ready
+   while the device runs the just-dispatched step), route tokens to the
+   per-request ``TokenStream``s, free retired slots.  This is the ONE
+   host readback per step, batched and off the hot path: the device
+   queue already holds the next step when the host touches data.
+
+EOS (``eos_id``) and per-request ``max_new_tokens`` retirement are
+computed ON DEVICE by the step itself; the host only learns about them
+in drain.  Backpressure: ``submit`` blocks (or raises with
+``nowait=True``) once ``max_pending`` requests are queued.
+
+``MXNET_SERVE_SYNC=1`` — or a model the slot-pool gate rejects — serves
+each request through one ``kv_generate`` call instead (no continuous
+batching, same token streams); the server API is unchanged.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["DecodeServer", "TokenStream", "serve_counters",
+           "reset_serve_counters"]
+
+# process-wide AGGREGATE dispatch accounting — every DecodeServer in
+# the process increments it, so with several servers the numbers
+# interleave.  Per-server truth lives in ``DecodeServer.counters``
+# (tests/test_serve.py pins 1 step dispatch per decode step at steady
+# state against it; benchmark/serve_bench.py reports it).
+serve_counters = {"step_dispatches": 0, "admit_dispatches": 0,
+                  "sync_requests": 0, "pool_grows": 0}
+
+
+def reset_serve_counters():
+    for k in serve_counters:
+        serve_counters[k] = 0
+
+
+def _pool_sizes_from_env():
+    raw = os.environ.get("MXNET_SERVE_POOL_SIZES", "1,2,4,8")
+    try:
+        sizes = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        raise MXNetError(f"MXNET_SERVE_POOL_SIZES={raw!r}: expected a "
+                         "comma-separated list of slot counts")
+    if not sizes or sizes[0] < 1:
+        raise MXNetError(f"MXNET_SERVE_POOL_SIZES={raw!r}: slot counts "
+                         "must be positive")
+    return tuple(sizes)
+
+
+def _next_pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class TokenStream:
+    """Streaming view of one request's continuation.
+
+    Iterate it for token ids as they decode (blocking; ends at
+    retirement), or call :meth:`tokens` to wait for completion.  Every
+    iteration replays from the first token, so a finished stream can be
+    re-iterated and concurrent consumers each see the full stream.
+    Each token's host-arrival wall time is kept in :attr:`times` (the
+    latency source for ``benchmark/serve_bench.py``).  ``detokenize``
+    (a ``token_id -> str`` callable) enables :meth:`text` /
+    :meth:`text_iter` streaming detokenization."""
+
+    def __init__(self, request_id, detokenize=None, on_token=None):
+        self.request_id = request_id
+        self.submit_time = time.perf_counter()
+        self.times = []
+        self._detok = detokenize
+        self._on_token = on_token
+        self._cv = threading.Condition()
+        self._toks = []
+        self._done = threading.Event()
+        self._error = None
+
+    # -- producer side (server loop) ------------------------------------ #
+    def _push(self, tok):
+        self.times.append(time.perf_counter())
+        with self._cv:
+            self._toks.append(tok)
+            self._cv.notify_all()
+        if self._on_token is not None:
+            try:
+                self._on_token(self.request_id, tok)
+            except Exception as e:
+                # a buggy per-request callback fails ITS stream only —
+                # the scheduler thread (and every other client's
+                # stream) must survive it
+                self._on_token = None
+                self._finish(e)
+
+    def _finish(self, error=None):
+        with self._cv:
+            if self._error is None:   # first error wins (a callback
+                self._error = error   # failure isn't erased by the
+            self._done.set()          # slot's later clean retirement)
+            self._cv.notify_all()
+
+    # -- consumer side --------------------------------------------------- #
+    def __iter__(self):
+        i = 0
+        while True:
+            with self._cv:
+                while i >= len(self._toks) and not self._done.is_set():
+                    self._cv.wait()
+                if i >= len(self._toks):
+                    if self._error is not None:
+                        raise self._error
+                    return
+                tok = self._toks[i]
+            yield tok
+            i += 1
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def tokens(self, timeout=None):
+        """Block until the request retires; return the full token list."""
+        if not self._done.wait(timeout):
+            raise MXNetError(f"request {self.request_id} not finished "
+                             f"within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return list(self._toks)
+
+    def text_iter(self):
+        """Streaming detokenization: yield text piece per token."""
+        if self._detok is None:
+            raise MXNetError("TokenStream has no detokenize callable")
+        for tok in self:
+            yield self._detok(tok)
+
+    def text(self, timeout=None):
+        if self._detok is None:
+            raise MXNetError("TokenStream has no detokenize callable")
+        return "".join(self._detok(t) for t in self.tokens(timeout))
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "seed", "stream")
+
+    def __init__(self, prompt, max_new, seed, stream):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.seed = seed
+        self.stream = stream
+
+
+class DecodeServer:
+    """Continuous-batching decode server over a slot-pool KV cache.
+
+    ``submit()`` never waits for other requests: a free slot is filled
+    at the next step boundary and the request's tokens stream out as
+    they decode.  ``temperature``/``top_k``/``eos_id`` are server-level
+    (they shape the compiled sampler); ``seed`` is per-request — a
+    served stream reproduces ``kv_generate(model, prompt[None],
+    max_new_tokens, temperature, top_k, seed)`` token-for-token.
+
+    ``autostart=True`` runs the scheduler on a background thread.  With
+    ``autostart=False`` the owner calls :meth:`pump` — one admission +
+    step + drain round per call — which the scheduler tests and the
+    benchmark use to drive the loop deterministically.
+    """
+
+    def __init__(self, model, *, max_total_len=None, pool_sizes=None,
+                 temperature=0.0, top_k=0, eos_id=None,
+                 weights="native", max_pending=256, detokenize=None,
+                 autostart=True):
+        from .engine import PoolPrograms, pool_state_init
+
+        self.model = model
+        self.T = int(max_total_len if max_total_len is not None
+                     else model._cfg.max_length)
+        self.pool_sizes = tuple(pool_sizes) if pool_sizes is not None \
+            else _pool_sizes_from_env()
+        if not self.pool_sizes \
+                or list(self.pool_sizes) != sorted(set(self.pool_sizes)) \
+                or self.pool_sizes[0] < 1:
+            raise MXNetError(f"pool_sizes {self.pool_sizes} must be "
+                             "strictly increasing positive slot counts")
+        self.temperature, self.top_k = temperature, top_k
+        self.eos_id = eos_id
+        self.weights = weights
+        self.max_pending = int(max_pending)
+        self._detok = detokenize
+
+        self.sync_mode = os.environ.get("MXNET_SERVE_SYNC", "0") == "1"
+        self.sync_reason = "MXNET_SERVE_SYNC=1" if self.sync_mode \
+            else None
+        self._progs = None
+        if not self.sync_mode:
+            try:
+                self._progs = PoolPrograms(
+                    model, self.pool_sizes[0], self.T, temperature,
+                    top_k, eos_id, weights)
+            except MXNetError as e:
+                # models the slot-pool gate rejects still serve, one
+                # request at a time, through the kv_generate fallback
+                self.sync_mode = True
+                self.sync_reason = str(e)
+        self._state = None if self.sync_mode \
+            else pool_state_init(self._progs.eng)
+
+        # scheduler bookkeeping (single scheduler thread; submit() is
+        # the only cross-thread writer and it only touches _pending)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._pending = deque()
+        self._stopping = False
+        self._slots = [None] * self.pool_sizes[0]   # slot -> _Request
+        self._inflight = deque()   # (kind, arrays, slot_snapshot/req)
+        self._next_id = 0
+        self._steps = 0
+        self._occupied_lane_steps = 0
+        self._capacity_lane_steps = 0   # sums len(_slots) per step, so
+        # occupancy stays honest across pool growth (S changes mid-run)
+        # per-server dispatch accounting (the module-level
+        # serve_counters aggregate is also incremented)
+        self.counters = {"step_dispatches": 0, "admit_dispatches": 0,
+                         "sync_requests": 0, "pool_grows": 0}
+        self._thread = None
+        if autostart:
+            self._thread = threading.Thread(
+                target=self._loop, name="mxnet-serve", daemon=True)
+            self._thread.start()
+
+    # -- public API ------------------------------------------------------ #
+    def submit(self, prompt_tokens, max_new_tokens=32, seed=0,
+               nowait=False, on_token=None):
+        """Queue one request; returns its :class:`TokenStream`.
+
+        Blocks while ``max_pending`` requests are already queued
+        (``nowait=True`` raises instead — pool-full backpressure is a
+        visible error, not an unbounded queue)."""
+        prompt = onp.asarray(
+            prompt_tokens.asnumpy() if hasattr(prompt_tokens, "asnumpy")
+            else prompt_tokens, dtype=onp.int32).reshape(-1)
+        if prompt.size == 0:
+            raise MXNetError("empty prompt")
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.T:
+            raise MXNetError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the pool cache length "
+                f"{self.T}")
+        seed = int(seed)
+        if not -2 ** 31 <= seed < 2 ** 31:
+            # the slot pool carries the seed as a traced int32 operand;
+            # rejecting it HERE keeps an oversized seed a caller error
+            # instead of an OverflowError on the scheduler thread
+            raise MXNetError(
+                f"seed {seed} does not fit int32 — fold larger seeds "
+                "on the host before submitting")
+        with self._work:
+            if self._stopping:
+                raise MXNetError("server is closed")
+            while len(self._pending) >= self.max_pending:
+                if nowait:
+                    raise MXNetError(
+                        f"backpressure: {len(self._pending)} requests "
+                        f"pending (max_pending={self.max_pending})")
+                if self._thread is None:
+                    # no scheduler thread to drain the queue — blocking
+                    # here would deadlock the pump()-driving thread
+                    raise MXNetError(
+                        f"backpressure: {len(self._pending)} requests "
+                        f"pending (max_pending={self.max_pending}) and "
+                        "no scheduler thread (autostart=False) — call "
+                        "pump() to drain, or submit(nowait=True)")
+                self._work.wait(0.05)
+                if self._stopping:
+                    raise MXNetError("server is closed")
+            stream = TokenStream(self._next_id, self._detok, on_token)
+            self._next_id += 1
+            self._pending.append(
+                _Request(prompt, int(max_new_tokens), int(seed),
+                         stream))
+            self._work.notify_all()
+        return stream
+
+    def _count(self, key):
+        self.counters[key] += 1
+        serve_counters[key] += 1
+
+    def reset_counters(self):
+        for k in self.counters:
+            self.counters[k] = 0
+
+    def stats(self):
+        """Scheduler/occupancy counters for benchmarks."""
+        S = len(self._slots)
+        return {
+            "num_slots": S,
+            "steps": self._steps,
+            "occupancy": (self._occupied_lane_steps /
+                          self._capacity_lane_steps
+                          if self._capacity_lane_steps else 0.0),
+            "pending": len(self._pending),
+            "in_flight": sum(r is not None for r in self._slots),
+            "sync_mode": self.sync_mode,
+        }
+
+    def close(self, drain=True, timeout=60.0):
+        """Stop the scheduler.  ``drain=True`` serves everything already
+        submitted first; otherwise queued/in-flight requests fail with
+        a server-closed error."""
+        deadline = time.time() + timeout
+        if drain:
+            while (self._pending or
+                   any(r is not None for r in self._slots) or
+                   self._inflight):
+                if self._thread is None or not self._thread.is_alive():
+                    # no scheduler left to drain the backlog — either
+                    # autostart=False, or a PRIOR close() timed out and
+                    # the thread has since exited at its _stopping
+                    # check with work outstanding; pump from here so
+                    # "call close() again" actually finishes the drain
+                    if not self.pump():
+                        break
+                elif time.time() > deadline:
+                    raise MXNetError("close(drain=True) timed out")
+                else:
+                    time.sleep(0.002)
+        with self._work:
+            self._stopping = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=max(deadline - time.time(), 0.1))
+            if self._thread.is_alive():
+                # the scheduler is mid-pump (e.g. a pool-growth retrace
+                # compiling) and owns _slots/_inflight — tearing them
+                # down under it would double-route token waves.  It
+                # exits at its next _stopping check; call close() again
+                # to finish teardown.
+                raise MXNetError(
+                    "close() timed out waiting for the scheduler "
+                    "thread (still inside a dispatch/retrace); it "
+                    "stops at the next step boundary — call close() "
+                    "again to finish teardown")
+        self._flush_drain(final=True)
+        self._teardown(MXNetError("server closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc == (None, None, None))
+
+    # -- scheduler ------------------------------------------------------- #
+    def pump(self):
+        """One scheduler round: admissions, one step dispatch, drain.
+        Returns True if any work happened (False = fully idle: nothing
+        pending, nothing in flight — the loop thread sleeps on that)."""
+        if self.sync_mode:
+            return self._pump_sync()
+        worked = self._admit_pending()
+        stepped = False
+        if any(r is not None for r in self._slots):
+            self._dispatch_step()
+            worked = stepped = True
+        # drain PREVIOUS dispatches' readbacks: while stepping, the
+        # newest dispatch stays in flight so the device computes it
+        # while the host routes the older (S,)-sized arrays; once the
+        # loop stops stepping, everything drains so streams finish
+        worked |= self._flush_drain(keep=1 if stepped else 0)
+        return worked
+
+    def _loop(self):
+        while True:
+            with self._work:
+                if self._stopping:
+                    return
+            try:
+                worked = self.pump()
+            except Exception as e:
+                # a runtime dispatch failure (device OOM, XLA error, a
+                # growth retrace) must not silently kill the scheduler
+                # thread and hang every consumer: fail all outstanding
+                # streams with the error and stop serving
+                self._fail_all(e)
+                return
+            if not worked:
+                with self._work:
+                    if self._stopping:
+                        return
+                    if not self._pending and not self._inflight:
+                        self._work.wait(0.05)
+
+    def _fail_all(self, exc):
+        err = exc if isinstance(exc, MXNetError) else \
+            MXNetError(f"serving loop failed: {exc!r}")
+        with self._work:
+            self._stopping = True
+            self._work.notify_all()
+        self._inflight.clear()   # readbacks are dropped, not routed
+        self._teardown(err)
+
+    def _teardown(self, err):
+        """Fail every queued and in-flight request with ``err``.  The
+        snapshot-and-clear runs under the lock; streams are finished
+        OUTSIDE it — _finish wakes consumer threads (and on_token
+        callers) that may immediately re-enter submit()/stats()."""
+        with self._lock:
+            dropped = list(self._pending)
+            self._pending.clear()
+            leftover = [r for r in self._slots if r is not None]
+            self._slots = [None] * len(self._slots)
+            self._work.notify_all()
+        for req in dropped + leftover:
+            req.stream._finish(err)
+
+    # admissions --------------------------------------------------------- #
+    def _take_pending(self):
+        with self._lock:
+            if not self._pending:
+                return None
+            req = self._pending.popleft()
+            self._work.notify_all()
+            return req
+
+    def _free_slot(self):
+        for i, r in enumerate(self._slots):
+            if r is None:
+                return i
+        return None
+
+    def _maybe_grow(self):
+        """Grow the pool to the next pinned size when the backlog wants
+        more lanes than exist (retrace happens at most
+        ``len(pool_sizes) - 1`` times, never per request)."""
+        from .engine import PoolPrograms, pool_state_grow
+
+        S = len(self._slots)
+        busy = sum(r is not None for r in self._slots)
+        want = busy + len(self._pending)
+        bigger = [s for s in self.pool_sizes if s > S]
+        if not bigger or want <= S:
+            return
+        new_s = S
+        for s in bigger:
+            new_s = s
+            if s >= want:
+                break
+        progs = PoolPrograms(self.model, new_s, self.T,
+                             self.temperature, self.top_k, self.eos_id,
+                             self.weights)
+        # the old pool's in-flight readbacks refer to old slot indices;
+        # they stay valid — slots only ever grow
+        self._progs = progs
+        self._state = pool_state_grow(self._state, new_s)
+        with self._lock:
+            self._slots.extend([None] * (new_s - S))
+        self._count("pool_grows")
+
+    def _admit_pending(self):
+        admitted = may_retire = False
+        self._maybe_grow()
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            # pop + record into the slot table ATOMICALLY: a request
+            # must never be invisible to close(drain=True)'s "anything
+            # outstanding?" predicate (or to _fail_all) while its
+            # admission dispatch is still being built
+            with self._lock:
+                if not self._pending:
+                    break
+                req = self._pending.popleft()
+                self._slots[slot] = req
+                self._work.notify_all()
+            self._dispatch_admit(req, slot)
+            admitted = True
+            may_retire |= req.max_new == 1
+        if may_retire:
+            # a 1-token budget retires INSIDE the admission executable;
+            # read the (first_tok, done) flags back now so its slot
+            # frees before the step-dispatch decision — no wasted
+            # dispatch.  Every other admission drains lazily with the
+            # step readbacks, off the hot path (an EOS on the very
+            # first token costs at most one masked-lane step).
+            self._drain_admits()
+        return admitted
+
+    def _dispatch_admit(self, req, slot):
+        P = req.prompt.size
+        bucket = min(_next_pow2(max(P, 8)), self.T)
+        fn = self._progs.admit_fn(bucket)
+        padded = onp.zeros((1, bucket), onp.int32)
+        padded[0, :P] = req.prompt
+        meta = onp.array([P, slot, P + req.max_new - 1, req.seed],
+                         onp.int32)
+        param_vals, q8, sw = self._progs.operands
+        new_state, (first, done) = fn(param_vals, padded, meta,
+                                      *self._state)
+        self._state = new_state
+        self._count("admit_dispatches")
+        self._inflight.append(("admit", (first, done), (slot, req)))
+
+    # the step ------------------------------------------------------------ #
+    def _dispatch_step(self):
+        param_vals, q8, sw = self._progs.operands
+        new_state, out = self._progs.step_fn()(
+            param_vals, q8, sw, *self._state)
+        self._state = new_state
+        self._count("step_dispatches")
+        self._steps += 1
+        self._occupied_lane_steps += sum(
+            r is not None for r in self._slots)
+        self._capacity_lane_steps += len(self._slots)
+        self._inflight.append(("step", out, list(self._slots)))
+
+    # drain ---------------------------------------------------------------- #
+    def _drain_admits(self):
+        """Route every in-flight ADMIT readback (selective drain is
+        stream-order-safe: an admit is always a request's first entry,
+        and step entries only touch other, older requests)."""
+        rest = deque()
+        while self._inflight:
+            kind, arrays, meta = self._inflight.popleft()
+            if kind != "admit":
+                rest.append((kind, arrays, meta))
+                continue
+            self._route_admit(arrays, meta)
+        self._inflight = rest
+
+    def _route_admit(self, arrays, meta):
+        slot, req = meta
+        first = int(onp.asarray(arrays[0]))
+        done = bool(onp.asarray(arrays[1]))
+        req.stream._push(first)
+        if done:
+            req.stream._finish()
+            with self._lock:
+                self._slots[slot] = None
+
+    def _flush_drain(self, keep=0, final=False):
+        """Route in-flight dispatches' readback arrays to their streams
+        and free retired slots, oldest-first (the device stream is
+        FIFO, so only the newest entries can still be computing).
+        ``keep`` leaves that many newest entries in flight — the
+        host/device overlap while the loop is actively stepping."""
+        if final:
+            keep = 0
+        worked = False
+        while len(self._inflight) > keep:
+            kind, arrays, meta = self._inflight.popleft()
+            worked = True
+            if kind == "admit":
+                self._route_admit(arrays, meta)
+            else:
+                toks, emitted, done = (onp.asarray(a) for a in arrays)
+                snapshot = meta
+                for slot, req in enumerate(snapshot):
+                    if req is None or not emitted[slot]:
+                        continue
+                    req.stream._push(int(toks[slot]))
+                    if done[slot]:
+                        req.stream._finish()
+                        with self._lock:
+                            if self._slots[slot] is req:
+                                self._slots[slot] = None
+        return worked
+
+    # sync fallback -------------------------------------------------------- #
+    def _pump_sync(self):
+        from ..models.decoding import kv_generate
+
+        req = self._take_pending()
+        if req is None:
+            return False
+        self._count("sync_requests")
+        try:
+            out = kv_generate(self.model, req.prompt[None],
+                              max_new_tokens=req.max_new,
+                              temperature=self.temperature,
+                              top_k=self.top_k, seed=req.seed,
+                              weights=self.weights)
+            new = out[0, req.prompt.size:]
+            if self.eos_id is not None:
+                for t in new:
+                    req.stream._push(int(t))
+                    if int(t) == self.eos_id:
+                        break
+                req.stream._finish()
+            else:
+                for t in new:
+                    req.stream._push(int(t))
+                req.stream._finish()
+        except Exception as e:                 # surface, don't hang
+            req.stream._finish(e)
+        return True

@@ -236,3 +236,174 @@ def test_grad_clipping_pattern():
     trainer.update(batch_size=1)
     w = net.weight.data().asnumpy()
     assert onp.linalg.norm(onp.ones((1, 2)) - w) <= 1.0 + 1e-4
+
+
+# --------------------------------------------------------------------------- #
+# jit-by-default trace cache (non-hybridized inference loops)
+# --------------------------------------------------------------------------- #
+
+def _jit_default_net(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize(mx.init.Normal(0.1))
+    return net
+
+
+def test_jit_by_default_inference_uses_trace_cache():
+    """A non-hybridized HybridBlock in a predict loop routes through the
+    CachedOp trace cache automatically — and matches the imperative
+    result exactly."""
+    net = _jit_default_net()
+    x = mx.nd.array(onp.random.RandomState(0).rand(4, 12)
+                    .astype("float32"))
+    y = net(x)
+    assert net._cached_op is not None          # trace cache engaged
+    assert net._auto_jit is True
+    # second call reuses the same jitted executable (no retrace)
+    op = net._cached_op
+    y2 = net(x)
+    assert net._cached_op is op
+    assert op._get_jitted(False)._cache_size() == 1
+    onp.testing.assert_array_equal(y.asnumpy(), y2.asnumpy())
+
+
+def test_jit_by_default_parity_with_env_hatch(monkeypatch):
+    net = _jit_default_net()
+    x = mx.nd.array(onp.random.RandomState(1).rand(3, 12)
+                    .astype("float32"))
+    y_jit = net(x).asnumpy()
+    monkeypatch.setenv("MXNET_JIT_BY_DEFAULT", "0")
+    net2 = _jit_default_net()
+    for p, q in zip(net.collect_params().values(),
+                    net2.collect_params().values()):
+        q.set_data(p.data())
+    y_imp = net2(x).asnumpy()
+    assert net2._cached_op is None             # hatch keeps it imperative
+    onp.testing.assert_allclose(y_jit, y_imp, rtol=1e-6, atol=1e-6)
+
+
+def test_jit_by_default_skips_autograd_recording():
+    """The training path keeps exact imperative semantics — recording a
+    non-hybridized forward must not engage the trace cache."""
+    net = _jit_default_net()
+    x = mx.nd.array(onp.ones((2, 12), onp.float32))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert net._cached_op is None
+    assert all(onp.isfinite(p.grad().asnumpy()).all()
+               for p in net.collect_params().values()
+               if p.grad_req != "null")
+
+
+def test_jit_by_default_hybridize_false_opts_out():
+    net = _jit_default_net()
+    net.hybridize(False)
+    x = mx.nd.array(onp.ones((2, 12), onp.float32))
+    net(x)
+    assert net._cached_op is None
+    assert net._auto_jit is False
+
+
+def test_jit_by_default_trace_hostile_falls_back():
+    """A forward with value-dependent Python control flow cannot trace;
+    it must fall back to imperative execution once and never retry."""
+    class Hostile(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.traces = 0
+
+        def hybrid_forward(self, F, x):
+            self.traces += 1
+            if float(x.sum().asnumpy().item()) > -1e30:  # host sync
+                return x * 2
+            return x
+
+    h = Hostile()
+    h.initialize()
+    x = mx.nd.array(onp.ones((2, 3), onp.float32))
+    y = h(x)
+    onp.testing.assert_allclose(y.asnumpy(), 2 * onp.ones((2, 3)))
+    assert h._auto_jit is False
+    runs_after_fallback = h.traces
+    h(x)                                      # imperative, no retrace try
+    assert h._auto_jit is False
+    assert h.traces == runs_after_fallback + 1
+
+
+def test_jit_by_default_hook_error_propagates():
+    """A raising forward hook is a USER error: it must propagate, not be
+    swallowed as a trace failure (which would re-run the whole forward
+    imperatively and permanently disable the jit)."""
+    net = _jit_default_net()
+    x = mx.nd.array(onp.ones((2, 12), onp.float32))
+    calls = []
+    net.register_forward_hook(lambda blk, args, out: calls.append(1))
+    net(x)
+    assert net._auto_jit is True and calls == [1]
+
+    boom = RuntimeError("hook boom")
+
+    def bad_hook(blk, args, out):
+        raise boom
+
+    net2 = _jit_default_net()
+    net2.register_forward_hook(bad_hook)
+    with pytest.raises(RuntimeError, match="hook boom"):
+        net2(x)
+    # the trace itself succeeded — the hook error must not flip the
+    # block back to permanent imperative execution
+    assert net2._auto_jit is True
+
+    net3 = _jit_default_net()
+    net3.register_forward_pre_hook(lambda blk, args: (_ for _ in ()).throw(boom))
+    with pytest.raises(RuntimeError, match="hook boom"):
+        net3(x)
+    assert net3._auto_jit is None             # untried, retries next call
+    net3._forward_pre_hooks.clear()
+    net3(x)
+    assert net3._auto_jit is True
+
+
+def test_jit_by_default_real_error_does_not_pin_imperative():
+    """A genuinely bad input raises in the trace AND the imperative
+    re-run: the error must propagate without permanently disabling the
+    jit — a corrected call retries (and gets) the trace cache."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=12))
+    net.initialize(mx.init.Normal(0.1))
+    bad = mx.nd.array(onp.ones((2, 7), onp.float32))   # wrong feature dim
+    with pytest.raises(Exception):
+        net(bad)
+    assert net._auto_jit is None              # untried, not pinned off
+    good = mx.nd.array(onp.ones((2, 12), onp.float32))
+    net(good)
+    assert net._auto_jit is True
+    assert net._cached_op is not None
+
+
+def test_cached_op_trace_serialized_by_trace_lock():
+    """_CachedOp.__call__ must hold the shared trace lock: a concurrent
+    trace (e.g. the decode server retracing the same model on its own
+    thread) swaps shared Parameters to tracers, so an unlocked forward
+    would capture a leaked tracer."""
+    import threading
+    from mxnet_tpu.gluon.parameter import _TRACE_LOCK
+
+    net = _jit_default_net()
+    x = mx.nd.array(onp.ones((2, 12), onp.float32))
+    done = threading.Event()
+    out = []
+
+    def fwd():
+        out.append(net(x).asnumpy())
+        done.set()
+
+    with _TRACE_LOCK:
+        t = threading.Thread(target=fwd, daemon=True)
+        t.start()
+        assert not done.wait(0.5)             # first call (trace) blocks
+    assert done.wait(30)                      # released -> completes
+    t.join(30)
+    assert net._auto_jit is True and out[0].shape == (2, 8)

@@ -137,14 +137,17 @@ class TestStackedGating:
         ref = kv_generate(net, prompt, max_new_tokens=3, temperature=0.0)
         onp.testing.assert_array_equal(out, ref)
 
-    def test_int8_runs_unrolled(self):
-        from mxnet_tpu.base import MXNetError
-        from mxnet_tpu.models import decode_mode, kv_generate
+    def test_int8_runs_stacked_where_supported(self):
+        """The q8 stream rides the stacked scan by default (ROADMAP PR 5
+        remainder); the unrolled fallback still covers it when the stack
+        gate rejects the model."""
+        from mxnet_tpu.models import decode_mode
         net = _gpt()
+        assert decode_mode(net, weights="int8") == "stacked"
+        assert decode_mode(net, weights="int8", stacked="off") \
+            == "unrolled"
+        net.blocks[1].ln1._eps = 1e-3          # non-uniform stack
         assert decode_mode(net, weights="int8") == "unrolled"
-        with pytest.raises(MXNetError, match="int8"):
-            kv_generate(net, onp.zeros((1, 4), onp.int32),
-                        max_new_tokens=2, weights="int8", stacked="on")
 
     def test_fused_requires_explicit_opt_in(self):
         """VERDICT r5: fused='auto' must NOT select the unmeasured
@@ -234,6 +237,71 @@ class TestStackedGating:
         gsw = gnet.stacked_decode_weights()
         assert gsw["qkv_w"].shape == (3, 96, 32)
         assert gsw["fc1_b"].shape == (3, 64)
+
+
+class TestInt8StackedParity:
+    """The q8 weight stream through the stacked scan (stacked codes ride
+    the xs through q8_matvec) must match the per-layer unrolled q8 path
+    token-for-token — same codes, same kernel, same cast order."""
+
+    def test_gpt_int8_stacked_matches_unrolled(self):
+        from mxnet_tpu.models import kv_generate
+        net = _gpt()
+        prompt = onp.random.RandomState(10).randint(0, 97, (2, 5))
+        kw = dict(max_new_tokens=10, temperature=0.0, weights="int8")
+        onp.testing.assert_array_equal(
+            kv_generate(net, prompt, stacked="on", **kw),
+            kv_generate(net, prompt, stacked="off", **kw))
+        kw = dict(max_new_tokens=6, temperature=0.8, top_k=5, seed=13,
+                  weights="int8")
+        onp.testing.assert_array_equal(
+            kv_generate(net, prompt, stacked="on", **kw),
+            kv_generate(net, prompt, stacked="off", **kw))
+
+    def test_llama_gqa_int8_stacked_matches_unrolled(self):
+        from mxnet_tpu.models import kv_generate
+        net, cfg = _llama()
+        assert cfg.num_kv_heads < cfg.num_heads
+        prompt = onp.random.RandomState(11).randint(0, cfg.vocab_size,
+                                                    (2, 4))
+        kw = dict(max_new_tokens=8, temperature=0.0, weights="int8")
+        onp.testing.assert_array_equal(
+            kv_generate(net, prompt, stacked="on", **kw),
+            kv_generate(net, prompt, stacked="off", **kw))
+
+    def test_int8_stack_requantizes_on_rebind(self):
+        """A weight rebind must invalidate the stacked q8 codes (the
+        pinned-source discipline shared with the per-layer q8 cache)."""
+        from mxnet_tpu.models import kv_generate
+        net = _gpt(init=0.15)
+        prompt = onp.random.RandomState(12).randint(0, 97, (1, 4))
+        kw = dict(max_new_tokens=4, temperature=0.0, weights="int8")
+        out1 = kv_generate(net, prompt, stacked="on", **kw)
+        w = net.blocks[0].attn.qkv.weight
+        w.set_data(mx.nd.from_jax(-w.data()._data))
+        out2 = kv_generate(net, prompt, stacked="on", **kw)
+        ref2 = kv_generate(net, prompt, stacked="off", **kw)
+        onp.testing.assert_array_equal(out2, ref2)
+        assert (out1 != out2).any()
+
+    def test_int8_op_count_collapse_and_layer_invariance(self):
+        """The int8 stacked step carries one layer-body of HLO too:
+        deepening the stack must not grow the op count, and the stacked
+        count stays under the unrolled one."""
+        from mxnet_tpu import profiler_xla
+        from mxnet_tpu.models import decode_step_program
+        counts = {}
+        for layers in (2, 4):
+            net = _gpt(layers=layers)
+            for smode in ("on", "off"):
+                fn, args = decode_step_program(net, batch=1, total=16,
+                                               weights="int8",
+                                               stacked=smode)
+                counts[(smode, layers)] = profiler_xla.hlo_op_count(
+                    fn, *args)
+        assert counts[("on", 4)] == counts[("on", 2)]
+        assert counts[("off", 4)] > counts[("off", 2)]
+        assert counts[("on", 2)] < counts[("off", 2)]
 
 
 class TestOpCountCeiling:
