@@ -747,7 +747,17 @@ class _DecodeEngine:
         admission path right-pads prompts to a compiled bucket length
         and reads the logits at the true last token; the padded tail's
         cache columns are overwritten by decode steps before any step
-        attends to them).  Exact same math as the per-token path
+        attends to them).  ``last_index`` may be a scalar (every row
+        ends at the same position) or a per-row ``(B,)`` vector — the
+        RAGGED-ROW case batched admission dispatches: each row is an
+        independent right-padded prompt with its own true length, and
+        its logits are gathered at its own last real token.  Because
+        every row starts at position 0, the rows share one causal mask
+        and one rope phase (``position_offset=0``); a row's padding
+        positions attend only backward into its own real tokens, and
+        their outputs are never read — per-row raggedness surfaces
+        only in the last-index gather here and in the caller's masked
+        cache scatter.  Exact same math as the per-token path
         (einsum + f32 softmax), reshaped onto MXU-friendly (B·P, ·)
         GEMMs."""
         from ..ops.attention import rope as _rope
@@ -802,9 +812,19 @@ class _DecodeEngine:
             else:
                 x = x + _call(blk.attn.proj, o)
                 x = x + _call(blk.ffn, _call(blk.ln2, x))
-        x_last = x[:, -1] if last_index is None else \
-            lax.dynamic_index_in_dim(x, last_index, axis=1,
-                                     keepdims=False)
+        if last_index is None:
+            x_last = x[:, -1]
+        else:
+            li = jnp.asarray(last_index)
+            if li.ndim == 0:
+                x_last = lax.dynamic_index_in_dim(x, li, axis=1,
+                                                  keepdims=False)
+            else:
+                # ragged rows: gather row b's hidden state at its own
+                # last real token li[b]
+                x_last = jnp.take_along_axis(
+                    x, li.astype(jnp.int32)[:, None, None],
+                    axis=1)[:, 0]
         xl = _call(model.ln_f, x_last)
         # the prefill head is always native (q8 covers decode-step
         # matvecs; the prefill runs once)

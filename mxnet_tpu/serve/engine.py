@@ -12,13 +12,18 @@ in the step.  Three compiled units per pool size ``S``:
   with the caches donated: ONE executable dispatch per decode step, the
   same one-executable discipline as ``kv_generate``'s scan
   (``tests/test_serve.py`` pins the dispatch count).
-- **admit(P_bucket)** — one causal prefill over a right-padded prompt
-  (compiled per bucket length, so admission cost is pinned to a handful
-  of programs), its K/V written into the admitted slot, the first token
-  sampled at the true last prompt position.  The padded tail's cache
-  columns are garbage but UNREACHABLE: a decode step at position ``q``
-  writes its own column before attending, so every attended column was
-  produced by this sequence.
+- **admit(A_bucket, P_bucket)** — ONE causal prefill over an ``(A, P)``
+  block of right-padded prompts (compiled per bucket PAIR from pinned
+  ladders, so admission cost stays a handful of programs): up to ``A``
+  pending requests' K/V streams are written into their assigned pool
+  slots in one masked device-side scatter, and the ``A`` first tokens +
+  done flags come back in one readback.  Rows beyond the wave are
+  masked no-ops (their scatter target is out of bounds and DROPPED), so
+  a partially full wave reuses the same program — admitting an arrival
+  wave of k requests is O(1) dispatches, not O(k).  Each padded tail's
+  cache columns are garbage but UNREACHABLE: a decode step at position
+  ``q`` writes its own column before attending, so every attended
+  column was produced by this sequence.
 - **sampling** — per-slot ``fold_in(key_slot, pos_slot)`` +
   ``categorical`` on that slot's row, matching ``kv_generate``'s
   batch-1 stream for the same seed token-for-token (greedy is argmax).
@@ -32,7 +37,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from ..base import MXNetError
 from ..models.decoding import _DecodeEngine, _TRACE_LOCK
@@ -109,7 +113,7 @@ class PoolPrograms:
         param_vals, q8, _packed, sw = self.eng.take_operands()
         self.operands = (param_vals, q8, sw)
         self._step = None
-        self._admits = {}                  # bucket length -> jitted fn
+        self._admits = {}          # (A, P) bucket pair -> jitted fn
 
     # -- sampling ------------------------------------------------------- #
     def _sample_slots(self, keys, logits, pos):
@@ -166,56 +170,74 @@ class PoolPrograms:
         return self._step
 
     # -- admission ------------------------------------------------------ #
-    def admit_fn(self, bucket):
-        """The jitted admission program for prompts padded to
-        ``bucket`` tokens (cached per bucket): ``admit(param_vals,
-        prompt (1, bucket), meta (4,) int32 = [true_len, slot,
+    def admit_fn(self, a_bucket, p_bucket):
+        """The jitted BATCHED admission program for a wave of up to
+        ``a_bucket`` prompts right-padded to ``p_bucket`` tokens (cached
+        per ``(A, P)`` bucket pair): ``admit(param_vals, prompts
+        (A, P) int32, meta (A, 5) int32 rows = [valid, true_len, slot,
         stop_pos, seed], ck, cv, pos, tok, active, stop, keys)`` →
-        new state + ``(first_tok, done)``.  One causal prefill fills
-        the slot's cache columns [0, bucket) and the first continuation
-        token is sampled at ``true_len - 1``; a request whose budget is
-        a single token (or whose first token is EOS) comes back
-        ``done`` and never occupies a step lane.  The per-request
-        scalars ride in ONE packed vector and the PRNG key is derived
-        on device — admission cost is one H2D of the prompt + meta,
-        not a fan of scalar puts."""
-        fn = self._admits.get(bucket)
+        new state + ``(first_tok (A,), done (A,))``.
+
+        ONE causal prefill over the whole block fills every admitted
+        slot's cache columns [0, P) via a masked device-side scatter
+        (row ``i`` lands in pool slot ``meta[i, 2]``; rows with
+        ``valid == 0`` aim at slot index ``S`` — out of bounds — and
+        are DROPPED, so a half-full wave is a no-op on the idle rows
+        and reuses the same compiled program).  The first continuation
+        token of each row is sampled at its own ``true_len - 1``
+        (per-row last index through ``prefill_batch``); a request whose
+        budget is a single token (or whose first token is EOS) comes
+        back ``done`` and never occupies a step lane.  Per-request
+        scalars ride in ONE packed ``(A, 5)`` block and the per-row
+        PRNG keys are derived on device — admitting a wave of k
+        requests is one H2D of the prompt block + meta and ONE
+        executable dispatch, not k of either."""
+        key2 = (int(a_bucket), int(p_bucket))
+        fn = self._admits.get(key2)
         if fn is not None:
             return fn
-        if not 0 < bucket <= self.T:
-            raise MXNetError(f"prompt bucket {bucket} outside cache "
+        A, P = key2
+        if not 0 < P <= self.T:
+            raise MXNetError(f"prompt bucket {P} outside cache "
                              f"length {self.T}")
+        if A < 1:
+            raise MXNetError(f"admission bucket {A} must be >= 1")
         from ..gluon.parameter import params_swapped
 
-        peng = _DecodeEngine(self.model, 1, bucket, self.T,
+        peng = _DecodeEngine(self.model, A, P, self.T,
                              self.temperature, self.top_k, "batched",
                              self.weights, "off", "auto")
         peng.take_operands()    # server-held operands are the only refs
 
-        def admit(param_vals, prompt, meta, ck, cv, pos, tok, active,
+        def admit(param_vals, prompts, meta, ck, cv, pos, tok, active,
                   stop, keys):
-            true_len, slot, stop_pos, seed = (meta[0], meta[1], meta[2],
-                                              meta[3])
-            key = jax.random.PRNGKey(seed)
+            valid = meta[:, 0] != 0
+            true_len, slot, stop_pos, seed = (meta[:, 1], meta[:, 2],
+                                              meta[:, 3], meta[:, 4])
+            keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
             with _TRACE_LOCK, params_swapped(peng.params, param_vals):
                 ck1, cv1 = peng.zero_caches()
                 logits, ck1, cv1 = peng.prefill_batch(
-                    prompt, ck1, cv1, last_index=true_len - 1)
-                first = self._sample_slots(
-                    key[None], logits, (true_len - 1)[None])[0]
-            ck = lax.dynamic_update_slice(ck, ck1, (0, slot, 0, 0, 0))
-            cv = lax.dynamic_update_slice(cv, cv1, (0, slot, 0, 0, 0))
+                    prompts, ck1, cv1, last_index=true_len - 1)
+                first = self._sample_slots(keys_a, logits,
+                                           true_len - 1)
             done = stop_pos <= true_len
             if self.eos_id is not None:
                 done = done | (first == self.eos_id)
-            pos = pos.at[slot].set(true_len)
-            tok = tok.at[slot].set(first)
-            active = active.at[slot].set(~done)
-            stop = stop.at[slot].set(stop_pos)
-            keys = keys.at[slot].set(key)
+            # masked scatter: invalid rows target slot S (out of
+            # bounds) and drop; valid rows carry distinct host-assigned
+            # slots, so the whole wave lands in one scatter per array
+            tgt = jnp.where(valid, slot, self.S)
+            ck = ck.at[:, tgt].set(ck1, mode="drop")
+            cv = cv.at[:, tgt].set(cv1, mode="drop")
+            pos = pos.at[tgt].set(true_len, mode="drop")
+            tok = tok.at[tgt].set(first, mode="drop")
+            active = active.at[tgt].set(~done, mode="drop")
+            stop = stop.at[tgt].set(stop_pos, mode="drop")
+            keys = keys.at[tgt].set(keys_a, mode="drop")
             new_state = (ck, cv, pos, tok, active, stop, keys)
             return new_state, (first, done)
 
         fn = jax.jit(admit, donate_argnums=(3, 4))
-        self._admits[bucket] = fn
+        self._admits[key2] = fn
         return fn

@@ -8,11 +8,18 @@ continuous-batching design, rebuilt on this repo's trace discipline).
 
 Scheduler shape (one ``pump()`` = one step boundary):
 
-1. **admit** — while a slot is free and a request is pending, dispatch
-   the per-bucket admission executable (prefill + first token into the
-   slot's cache columns).  Pool sizes are pinned to the
-   ``MXNET_SERVE_POOL_SIZES`` set; when the backlog outgrows the pool
-   the state is padded up to the next pinned size (a handful of
+1. **admit** — gather EVERY currently pending request the free slots
+   can take into one wave and dispatch ONE bucketed ``(A, P)``
+   admission executable for it (batched prefill + first tokens into
+   all the admitted slots' cache columns): a burst of k arrivals at a
+   step boundary costs 1 admit dispatch, not k.  Wave/bucket sizes are
+   pinned to the ``MXNET_SERVE_ADMIT_SIZES`` /
+   ``MXNET_SERVE_PREFILL_BUCKETS`` ladders (defaults derived from the
+   pool sizes / cache length), so compile count is bounded by the
+   ladder product; a wave larger than the biggest ``A`` bucket spills
+   to a second dispatch in the same pump.  Pool sizes are pinned to
+   the ``MXNET_SERVE_POOL_SIZES`` set; when the backlog outgrows the
+   pool the state is padded up to the next pinned size (a handful of
    retraces per server lifetime, never per request).
 2. **step** — if any slot is live, dispatch ONE decode-step executable
    (``serve.engine.PoolPrograms.step_fn``): every active slot advances
@@ -62,24 +69,72 @@ def reset_serve_counters():
         serve_counters[k] = 0
 
 
-def _pool_sizes_from_env():
-    raw = os.environ.get("MXNET_SERVE_POOL_SIZES", "1,2,4,8")
+def _parse_sizes(var, raw, what):
     try:
         sizes = sorted({int(x) for x in raw.split(",") if x.strip()})
     except ValueError:
-        raise MXNetError(f"MXNET_SERVE_POOL_SIZES={raw!r}: expected a "
-                         "comma-separated list of slot counts")
+        raise MXNetError(f"{var}={raw!r}: expected a "
+                         f"comma-separated list of {what}")
     if not sizes or sizes[0] < 1:
-        raise MXNetError(f"MXNET_SERVE_POOL_SIZES={raw!r}: slot counts "
-                         "must be positive")
+        raise MXNetError(f"{var}={raw!r}: {what} must be positive")
     return tuple(sizes)
 
 
-def _next_pow2(n):
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+def _pool_sizes_from_env():
+    return _parse_sizes("MXNET_SERVE_POOL_SIZES",
+                        os.environ.get("MXNET_SERVE_POOL_SIZES",
+                                       "1,2,4,8"), "slot counts")
+
+
+def _pow2_ladder(start, top):
+    """``start``, doubling, until ``top`` caps the ladder."""
+    sizes, a = [], start
+    while a < top:
+        sizes.append(a)
+        a *= 2
+    sizes.append(top)
+    return sizes
+
+
+def _admit_sizes_default(pool_sizes):
+    """Default admission-wave bucket ladder: powers of two up to the
+    largest pinned pool size (a wave can never exceed the free slot
+    count, so bigger buckets would only pad) — bounds a partially full
+    wave's masked-row overcompute to < 2x while keeping single-request
+    trickle admission at bucket 1."""
+    return tuple(_pow2_ladder(1, max(pool_sizes)))
+
+
+def _admit_sizes_from_env(pool_sizes):
+    raw = os.environ.get("MXNET_SERVE_ADMIT_SIZES")
+    if raw is None:
+        return _admit_sizes_default(pool_sizes)
+    return _parse_sizes("MXNET_SERVE_ADMIT_SIZES", raw, "wave sizes")
+
+
+def _prefill_buckets_default(T):
+    """Default prompt-length bucket ladder: powers of two from 8 up to
+    the cache length ``T`` (each clamped to ``T``) — the same shape the
+    per-request admission used, now pinned so compile count stays
+    bounded by the ladder product."""
+    return tuple(sorted({min(b, T) for b in _pow2_ladder(8, T)}))
+
+
+def _prefill_buckets_from_env(T):
+    raw = os.environ.get("MXNET_SERVE_PREFILL_BUCKETS")
+    if raw is None:
+        return _prefill_buckets_default(T)
+    buckets = _parse_sizes("MXNET_SERVE_PREFILL_BUCKETS", raw,
+                           "prompt bucket lengths")
+    return tuple(sorted({min(b, T) for b in buckets}))
+
+
+def _bucket_for(ladder, n):
+    """Smallest ladder entry >= n (the caller guarantees one exists)."""
+    for b in ladder:
+        if b >= n:
+            return b
+    raise MXNetError(f"{n} exceeds the largest bucket {ladder[-1]}")
 
 
 class TokenStream:
@@ -89,10 +144,13 @@ class TokenStream:
     retirement), or call :meth:`tokens` to wait for completion.  Every
     iteration replays from the first token, so a finished stream can be
     re-iterated and concurrent consumers each see the full stream.
-    Each token's host-arrival wall time is kept in :attr:`times` (the
-    latency source for ``benchmark/serve_bench.py``).  ``detokenize``
-    (a ``token_id -> str`` callable) enables :meth:`text` /
-    :meth:`text_iter` streaming detokenization."""
+    Each token's host-arrival wall time is kept in :attr:`times` and
+    the time-to-first-token (first arrival minus submit) separately in
+    :attr:`ttft` — the latency sources for ``benchmark/serve_bench.py``
+    (TTFT is the metric batched admission moves; inter-token gaps come
+    from consecutive :attr:`times`).  ``detokenize`` (a ``token_id ->
+    str`` callable) enables :meth:`text` / :meth:`text_iter` streaming
+    detokenization."""
 
     def __init__(self, request_id, detokenize=None, on_token=None):
         self.request_id = request_id
@@ -106,6 +164,14 @@ class TokenStream:
         self._error = None
 
     # -- producer side (server loop) ------------------------------------ #
+    @property
+    def ttft(self):
+        """Time-to-first-token: first host arrival minus submit
+        (``None`` until the first token lands) — the admission-latency
+        metric, distinct from the inter-token gaps derivable from
+        consecutive :attr:`times`."""
+        return self.times[0] - self.submit_time if self.times else None
+
     def _push(self, tok):
         self.times.append(time.perf_counter())
         with self._cv:
@@ -198,6 +264,7 @@ class DecodeServer:
     def __init__(self, model, *, max_total_len=None, pool_sizes=None,
                  temperature=0.0, top_k=0, eos_id=None,
                  weights="native", max_pending=256, detokenize=None,
+                 admit_sizes=None, prefill_buckets=None,
                  autostart=True):
         from .engine import PoolPrograms, pool_state_init
 
@@ -211,6 +278,29 @@ class DecodeServer:
                 or self.pool_sizes[0] < 1:
             raise MXNetError(f"pool_sizes {self.pool_sizes} must be "
                              "strictly increasing positive slot counts")
+        # bucketed batched-admission ladders: wave sizes (A) and prompt
+        # bucket lengths (P) — compile count per pool size is bounded
+        # by len(admit_sizes) * len(prefill_buckets), lazily filled
+        self.admit_sizes = tuple(admit_sizes) \
+            if admit_sizes is not None \
+            else _admit_sizes_from_env(self.pool_sizes)
+        if not self.admit_sizes \
+                or list(self.admit_sizes) != sorted(set(self.admit_sizes)) \
+                or self.admit_sizes[0] < 1:
+            raise MXNetError(f"admit_sizes {self.admit_sizes} must be "
+                             "strictly increasing positive wave sizes")
+        self.prefill_buckets = tuple(prefill_buckets) \
+            if prefill_buckets is not None \
+            else _prefill_buckets_from_env(self.T)
+        if not self.prefill_buckets \
+                or list(self.prefill_buckets) != \
+                sorted(set(self.prefill_buckets)) \
+                or self.prefill_buckets[0] < 1 \
+                or self.prefill_buckets[-1] > self.T:
+            raise MXNetError(
+                f"prefill_buckets {self.prefill_buckets} must be "
+                "strictly increasing positive prompt lengths within "
+                f"the cache length {self.T}")
         self.temperature, self.top_k = temperature, top_k
         self.eos_id = eos_id
         self.weights = weights
@@ -253,11 +343,24 @@ class DecodeServer:
                          "sync_requests": 0, "pool_grows": 0}
         self._thread = None
         if autostart:
+            self.start()
+
+    # -- public API ------------------------------------------------------ #
+    def start(self):
+        """Start the background scheduler thread (no-op if one is
+        already running).  ``autostart=False`` + a later ``start()``
+        lets the owner warm the compiled programs pump-driven first,
+        then hand the loop to the thread — ``benchmark/serve_bench.py``
+        uses this to keep compiles off the measured clock."""
+        with self._work:
+            if self._stopping:
+                raise MXNetError("server is closed")
+            if self._thread is not None and self._thread.is_alive():
+                return
             self._thread = threading.Thread(
                 target=self._loop, name="mxnet-serve", daemon=True)
             self._thread.start()
 
-    # -- public API ------------------------------------------------------ #
     def submit(self, prompt_tokens, max_new_tokens=32, seed=0,
                nowait=False, on_token=None):
         """Queue one request; returns its :class:`TokenStream`.
@@ -272,6 +375,16 @@ class DecodeServer:
             raise MXNetError("empty prompt")
         if max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
+        if not self.sync_mode \
+                and prompt.size > self.prefill_buckets[-1]:
+            # fail HERE, naming the limit — not later inside the admit
+            # trace as a shape error on the scheduler thread
+            raise MXNetError(
+                f"prompt length {prompt.size} exceeds the largest "
+                f"prefill bucket {self.prefill_buckets[-1]} (pool "
+                f"cache length {self.T}) — widen "
+                "MXNET_SERVE_PREFILL_BUCKETS / prefill_buckets=, or "
+                "raise max_total_len")
         if prompt.size + max_new_tokens > self.T:
             raise MXNetError(
                 f"prompt ({prompt.size}) + max_new_tokens "
@@ -317,8 +430,16 @@ class DecodeServer:
         serve_counters[key] += 1
 
     def reset_counters(self):
+        """Zero the per-server dispatch counters AND the step/occupancy
+        ledger, so a measurement window opened after a warm-up phase
+        (``benchmark/serve_bench.py`` warms the whole admission-bucket
+        ladder) reports the window's own occupancy, undiluted by the
+        warm-up's idle lanes."""
         for k in self.counters:
             self.counters[k] = 0
+        self._steps = 0
+        self._occupied_lane_steps = 0
+        self._capacity_lane_steps = 0
 
     def stats(self):
         """Scheduler/occupancy counters for benchmarks."""
@@ -452,12 +573,6 @@ class DecodeServer:
             self._work.notify_all()
             return req
 
-    def _free_slot(self):
-        for i, r in enumerate(self._slots):
-            if r is None:
-                return i
-        return None
-
     def _maybe_grow(self):
         """Grow the pool to the next pinned size when the backlog wants
         more lanes than exist (retrace happens at most
@@ -487,25 +602,39 @@ class DecodeServer:
         self._count("pool_grows")
 
     def _admit_pending(self):
+        """Wave-building batched admission: gather ALL currently
+        pending requests the free slots can take (capped at the
+        largest pinned ``A`` bucket) and admit each wave with ONE
+        bucketed ``(A, P)`` dispatch — a burst of k arrivals at a step
+        boundary costs 1 admit dispatch, not k.  The outer loop spills
+        a backlog larger than the biggest ``A`` bucket (or than the
+        free slots) into follow-up dispatches in the same pump."""
         admitted = may_retire = False
         self._maybe_grow()
+        cap = self.admit_sizes[-1]
         while True:
-            slot = self._free_slot()
-            if slot is None:
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            if not free:
                 break
             # pop + record into the slot table ATOMICALLY: a request
             # must never be invisible to close(drain=True)'s "anything
             # outstanding?" predicate (or to _fail_all) while its
             # admission dispatch is still being built
+            wave = []
             with self._lock:
-                if not self._pending:
-                    break
-                req = self._pending.popleft()
-                self._slots[slot] = req
-                self._work.notify_all()
-            self._dispatch_admit(req, slot)
+                while self._pending and len(wave) < min(len(free),
+                                                        cap):
+                    req = self._pending.popleft()
+                    slot = free[len(wave)]
+                    self._slots[slot] = req
+                    wave.append((slot, req))
+                if wave:
+                    self._work.notify_all()
+            if not wave:
+                break
+            self._dispatch_admit(wave)
             admitted = True
-            may_retire |= req.max_new == 1
+            may_retire |= any(r.max_new == 1 for _, r in wave)
         if may_retire:
             # a 1-token budget retires INSIDE the admission executable;
             # read the (first_tok, done) flags back now so its slot
@@ -516,20 +645,31 @@ class DecodeServer:
             self._drain_admits()
         return admitted
 
-    def _dispatch_admit(self, req, slot):
-        P = req.prompt.size
-        bucket = min(_next_pow2(max(P, 8)), self.T)
-        fn = self._progs.admit_fn(bucket)
-        padded = onp.zeros((1, bucket), onp.int32)
-        padded[0, :P] = req.prompt
-        meta = onp.array([P, slot, P + req.max_new - 1, req.seed],
-                         onp.int32)
+    def _dispatch_admit(self, wave):
+        """ONE bucketed (A, P) admission dispatch for a wave of
+        ``(slot, request)`` pairs: A = smallest pinned wave bucket that
+        fits the wave, P = smallest pinned prompt bucket that fits the
+        wave's longest prompt (submit() already guaranteed the fit).
+        Rows beyond the wave are masked no-ops on device."""
+        A = _bucket_for(self.admit_sizes, len(wave))
+        P = _bucket_for(self.prefill_buckets,
+                        max(req.prompt.size for _, req in wave))
+        fn = self._progs.admit_fn(A, P)
+        prompts = onp.zeros((A, P), onp.int32)
+        # idle rows: valid=0 (their scatter drops on device); true_len
+        # stays 1 so the per-row last-index gather reads a real column
+        meta = onp.zeros((A, 5), onp.int32)
+        meta[:, 1] = 1
+        for i, (slot, req) in enumerate(wave):
+            n = req.prompt.size
+            prompts[i, :n] = req.prompt
+            meta[i] = (1, n, slot, n + req.max_new - 1, req.seed)
         param_vals, q8, sw = self._progs.operands
-        new_state, (first, done) = fn(param_vals, padded, meta,
+        new_state, (first, done) = fn(param_vals, prompts, meta,
                                       *self._state)
         self._state = new_state
         self._count("admit_dispatches")
-        self._inflight.append(("admit", (first, done), (slot, req)))
+        self._inflight.append(("admit", (first, done), list(wave)))
 
     # the step ------------------------------------------------------------ #
     def _dispatch_step(self):
@@ -558,15 +698,19 @@ class DecodeServer:
             self._route_admit(arrays, meta)
         self._inflight = rest
 
-    def _route_admit(self, arrays, meta):
-        slot, req = meta
-        first = int(onp.asarray(arrays[0]))
-        done = bool(onp.asarray(arrays[1]))
-        req.stream._push(first)
-        if done:
-            req.stream._finish()
-            with self._lock:
-                self._slots[slot] = None
+    def _route_admit(self, arrays, wave):
+        """Route one admission wave's ``(first_tok, done)`` readback to
+        its requests' streams, in wave order — which IS submission
+        order, so per-request stream order is preserved."""
+        first = onp.asarray(arrays[0])
+        done = onp.asarray(arrays[1])
+        for i, (slot, req) in enumerate(wave):
+            req.stream._push(int(first[i]))
+            if done[i]:
+                req.stream._finish()
+                with self._lock:
+                    if self._slots[slot] is req:
+                        self._slots[slot] = None
 
     def _flush_drain(self, keep=0, final=False):
         """Route in-flight dispatches' readback arrays to their streams

@@ -303,10 +303,10 @@ class TestScheduler:
         real = srv._dispatch_admit
         started = threading.Event()
 
-        def slow_admit(req, slot):
+        def slow_admit(wave):
             started.set()
             _time.sleep(0.5)
-            return real(req, slot)
+            return real(wave)
 
         srv._dispatch_admit = slow_admit
         p = _prompt(35, 4)
@@ -362,7 +362,9 @@ class TestDispatchCount:
         _drain(server)
         assert s1.tokens(5) == _ref(net, p1, N)
         assert s2.tokens(5) == _ref(net, p2, N)
-        assert server.counters["admit_dispatches"] == 2
+        # both requests were pending at one step boundary: ONE batched
+        # admission dispatch admits the whole wave
+        assert server.counters["admit_dispatches"] == 1
         assert server.counters["step_dispatches"] == (N - 1) + 1
         # the step executable itself never retraced
         assert server._progs.step_fn()._cache_size() == 1
@@ -410,6 +412,188 @@ class TestCommittedState:
         for bucket, fn in srv._progs._admits.items():
             assert fn._cache_size() == 1, f"bucket {bucket} retraced"
         srv.close()
+
+
+class TestBatchedAdmission:
+    """ISSUE 8 tentpole: one bucketed ``(A, P)`` dispatch admits a
+    whole wave of pending prompts.  ``admit_sizes=(1,)`` reproduces
+    the per-request admission path (every wave capped at one row), so
+    batched-vs-sequential parity is a ladder choice, not a second code
+    path."""
+
+    def test_wave_of_4_costs_one_admit_dispatch(self, net):
+        """THE acceptance regression: k >= 4 pending prompts at one
+        step boundary cost exactly 1 admit dispatch, not k."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(4,),
+                           autostart=False)
+        prompts = [_prompt(50 + i, 3 + i) for i in range(4)]
+        streams = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        _drain(srv)
+        assert srv.counters["admit_dispatches"] == 1
+        for p, s in zip(prompts, streams):
+            assert s.tokens(5) == _ref(net, p, 5)
+        srv.close()
+
+    def test_batched_matches_sequential_greedy(self, net):
+        """Mixed prompt lengths ACROSS prefill buckets in one wave:
+        the batched streams are token-identical to the per-request
+        ladder (and to kv_generate)."""
+        from mxnet_tpu.serve import DecodeServer
+        prompts = [_prompt(55, 3), _prompt(56, 10), _prompt(57, 5),
+                   _prompt(58, 18)]           # buckets 8, 16 and 32
+        budgets = [6, 4, 5, 3]
+        outs = {}
+        for name, ladder in (("batched", None), ("sequential", (1,))):
+            srv = DecodeServer(net, max_total_len=64, pool_sizes=(4,),
+                               admit_sizes=ladder, autostart=False)
+            streams = [srv.submit(p, max_new_tokens=n)
+                       for p, n in zip(prompts, budgets)]
+            _drain(srv)
+            outs[name] = [s.tokens(5) for s in streams]
+            expect = 1 if name == "batched" else len(prompts)
+            assert srv.counters["admit_dispatches"] == expect, name
+            srv.close()
+        assert outs["batched"] == outs["sequential"]
+        for p, n, got in zip(prompts, budgets, outs["batched"]):
+            assert got == _ref(net, p, n)
+
+    def test_batched_matches_sequential_sampled(self, net):
+        """Sampled decoding: every wave row folds ITS request key at
+        its own position — the batched wave reproduces the per-request
+        (and offline batch-1) streams exactly."""
+        from mxnet_tpu.serve import DecodeServer
+        prompts = [_prompt(60 + i, 3 + 2 * i) for i in range(3)]
+        outs = {}
+        for name, ladder in (("batched", None), ("sequential", (1,))):
+            srv = DecodeServer(net, max_total_len=64, pool_sizes=(4,),
+                               temperature=0.7, top_k=7,
+                               admit_sizes=ladder, autostart=False)
+            streams = [srv.submit(p, max_new_tokens=5, seed=90 + i)
+                       for i, p in enumerate(prompts)]
+            _drain(srv)
+            outs[name] = [s.tokens(5) for s in streams]
+            srv.close()
+        assert outs["batched"] == outs["sequential"]
+        kw = dict(temperature=0.7, top_k=7)
+        for i, (p, got) in enumerate(zip(prompts, outs["batched"])):
+            assert got == _ref(net, p, 5, seed=90 + i, **kw)
+
+    def test_wave_of_one(self, net, server):
+        """A single pending request admits through the same batched
+        program path (smallest A bucket; idle rows are masked)."""
+        _drain(server)
+        server.reset_counters()
+        p = _prompt(65, 4)
+        s = server.submit(p, max_new_tokens=4)
+        _drain(server)
+        assert s.tokens(5) == _ref(net, p, 4)
+        assert server.counters["admit_dispatches"] == 1
+
+    def test_wave_larger_than_free_slots(self, net):
+        """5 pending, 2 slots: the first wave admits 2, the rest
+        re-admit in waves as slots retire — parity holds and the
+        dispatch count is the wave count, not the request count."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        prompts = [_prompt(70 + i, 3 + i % 3) for i in range(5)]
+        streams = [srv.submit(p, max_new_tokens=4) for p in prompts]
+        _drain(srv)
+        for p, s in zip(prompts, streams):
+            assert s.tokens(5) == _ref(net, p, 4)
+        # 5 equal-budget requests through a 2-slot pool retire in
+        # lockstep: ceil(5/2) = 3 waves
+        assert srv.counters["admit_dispatches"] == 3
+        srv.close()
+
+    def test_wave_spills_past_largest_admit_bucket(self, net):
+        """A backlog larger than the biggest pinned A bucket spills to
+        a second dispatch in the SAME pump."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(4,),
+                           admit_sizes=(2,), autostart=False)
+        prompts = [_prompt(80 + i, 4) for i in range(4)]
+        streams = [srv.submit(p, max_new_tokens=3) for p in prompts]
+        srv.pump()
+        assert srv.counters["admit_dispatches"] == 2
+        _drain(srv)
+        for p, s in zip(prompts, streams):
+            assert s.tokens(5) == _ref(net, p, 3)
+        srv.close()
+
+    def test_compile_count_bounded_by_ladder_product(self, net):
+        """Executable count stays <= len(admit_sizes) *
+        len(prefill_buckets) whatever the traffic mix, and no program
+        ever retraces."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(4,),
+                           autostart=False)
+        for wave in ([3], [1, 9], [17, 2, 4], [30], [1, 1, 1, 1]):
+            streams = [srv.submit(_prompt(100 + n, n),
+                                  max_new_tokens=2) for n in wave]
+            _drain(srv)
+            for s in streams:
+                assert len(s.tokens(5)) == 2
+        bound = len(srv.admit_sizes) * len(srv.prefill_buckets)
+        assert len(srv._progs._admits) <= bound
+        for fn in srv._progs._admits.values():
+            assert fn._cache_size() == 1
+        srv.close()
+
+    def test_prompt_longer_than_largest_bucket_rejected(self, net):
+        """Satellite: a prompt the prefill ladder cannot hold fails at
+        submit() naming the limit — not later inside the admit trace
+        with a shape error."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           prefill_buckets=(8,), autostart=False)
+        with pytest.raises(MXNetError, match="prefill bucket 8"):
+            srv.submit(_prompt(85, 12), max_new_tokens=4)
+        p = _prompt(86, 6)               # the server still serves
+        s = srv.submit(p, max_new_tokens=3)
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 3)
+        srv.close()
+
+    def test_prompt_longer_than_cache_names_limit(self, server):
+        with pytest.raises(MXNetError, match="prefill bucket"):
+            server.submit(_prompt(87, 70), max_new_tokens=1)
+
+    def test_ttft_recorded_separately(self, net, server):
+        """Satellite: TokenStream.ttft = first-token arrival minus
+        submit, kept separately from the per-token times list."""
+        _drain(server)
+        p = _prompt(88, 4)
+        s = server.submit(p, max_new_tokens=3)
+        assert s.ttft is None            # nothing arrived yet
+        _drain(server)
+        assert s.tokens(5) == _ref(net, p, 3)
+        assert s.ttft is not None and s.ttft > 0
+        assert abs(s.ttft - (s.times[0] - s.submit_time)) < 1e-9
+        assert len(s.times) == 3
+
+    def test_env_ladders(self, net, monkeypatch):
+        """MXNET_SERVE_ADMIT_SIZES / MXNET_SERVE_PREFILL_BUCKETS pin
+        the ladders (prefill buckets clamp to the cache length);
+        malformed values are a caller error at construction."""
+        from mxnet_tpu.serve import DecodeServer
+        monkeypatch.setenv("MXNET_SERVE_ADMIT_SIZES", "1,3")
+        monkeypatch.setenv("MXNET_SERVE_PREFILL_BUCKETS", "4,16,999")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(4,),
+                           autostart=False)
+        assert srv.admit_sizes == (1, 3)
+        assert srv.prefill_buckets == (4, 16, 64)    # clamped to T
+        p = _prompt(89, 6)
+        s = srv.submit(p, max_new_tokens=3)
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 3)
+        assert (1, 16) in srv._progs._admits
+        srv.close()
+        monkeypatch.setenv("MXNET_SERVE_ADMIT_SIZES", "zero")
+        with pytest.raises(MXNetError, match="ADMIT_SIZES"):
+            DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                         autostart=False)
 
 
 class TestSyncFallback:
