@@ -62,6 +62,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as onp
 
 
+def emit_row(row):
+    """One measured row: stdout JSON line (the BENCH_*.json trajectory
+    format) AND the telemetry event stream (kind ``bench``), so a
+    ``MXNET_TELEMETRY_JSONL`` recording carries the bench rows next to
+    the compile/serve events in one schema
+    (``tools/telemetry_report.py`` renders both)."""
+    print(json.dumps(row))
+    sys.stdout.flush()
+    from mxnet_tpu import telemetry
+    telemetry.emit("bench", **row)
+
+
+def phase(name):
+    """Arm boundary marker in the event stream (steady-state retrace
+    accounting in telemetry_report keys off these)."""
+    from mxnet_tpu import telemetry
+    telemetry.emit("phase", name=name)
+
+
 def build_model(profile):
     import mxnet_tpu as mx
     from mxnet_tpu.models import GPT, GPTConfig
@@ -279,14 +298,15 @@ def main():
     N = {"tpu": 128, "cpu": 32, "smoke": 12}[profile]
     n_requests = {"tpu": 32, "cpu": 16, "smoke": 16}[profile]
 
+    phase("static_batch8")
     static_rate = static_batch_rate(net, cfg, S, P, N)
-    print(json.dumps({"bench": "serve", "mode": "static_batch8",
-                      "profile": profile,
-                      "tokens_per_sec": round(static_rate, 1),
-                      "batch": S, "new_tokens": N,
-                      "platform": platform}))
-    sys.stdout.flush()
+    emit_row({"bench": "serve", "mode": "static_batch8",
+              "profile": profile,
+              "tokens_per_sec": round(static_rate, 1),
+              "batch": S, "new_tokens": N,
+              "platform": platform})
 
+    phase("saturated")
     rate, prompts, streams, srv = run_saturated(net, cfg, S, P, N,
                                                 n_requests)
     stats = srv.stats()
@@ -294,18 +314,17 @@ def main():
     steps = srv.counters["step_dispatches"]
     admits = srv.counters["admit_dispatches"]
     sat_ttfts = [s.ttft for s in streams]
-    print(json.dumps({"bench": "serve", "mode": "saturated",
-                      "profile": profile,
-                      "tokens_per_sec": round(rate, 1),
-                      "vs_static_batch8": round(ratio, 3),
-                      "occupancy": round(stats["occupancy"], 3),
-                      "p50_ttft_ms": round(_pct(sat_ttfts, 0.5) * 1e3, 3),
-                      "p99_ttft_ms": round(_pct(sat_ttfts, 0.99) * 1e3, 3),
-                      "num_slots": S, "requests": n_requests,
-                      "new_tokens": N, "step_dispatches": steps,
-                      "admit_dispatches": admits,
-                      "platform": platform}))
-    sys.stdout.flush()
+    emit_row({"bench": "serve", "mode": "saturated",
+              "profile": profile,
+              "tokens_per_sec": round(rate, 1),
+              "vs_static_batch8": round(ratio, 3),
+              "occupancy": round(stats["occupancy"], 3),
+              "p50_ttft_ms": round(_pct(sat_ttfts, 0.5) * 1e3, 3),
+              "p99_ttft_ms": round(_pct(sat_ttfts, 0.99) * 1e3, 3),
+              "num_slots": S, "requests": n_requests,
+              "new_tokens": N, "step_dispatches": steps,
+              "admit_dispatches": admits,
+              "platform": platform})
 
     if args.smoke:
         # parity: every served stream reproduces the offline decode
@@ -322,24 +341,57 @@ def main():
         floor = (n_requests * (N - 1)) // S
         assert steps >= floor, (steps, floor)
         assert steps <= floor + n_requests + 4, (steps, floor)
+        # ISSUE 9 telemetry invariants, from the registry/event stream
+        # alone: warm_server compiled the whole usable (A, P) admission
+        # ladder (every pinned A ≤ pool size × the single 16-token
+        # prompt bucket) and ONE step program; the measured run added
+        # ZERO compiles (steady state, no retraces); step dispatches in
+        # the registry == decode steps (1 executable dispatch/step).
+        from mxnet_tpu import telemetry
+        if telemetry.telemetry_enabled():
+            label = srv.telemetry_label
+            adm_comp = [e for e in telemetry.events("compile")
+                        if e.get("site") == "serve.admit"
+                        and e.get("server") == label]
+            pairs = {(e["pool"], e["a_bucket"], e["p_bucket"])
+                     for e in adm_comp}
+            ladder = len([a for a in srv.admit_sizes if a <= S])
+            assert len(adm_comp) == ladder == len(pairs), \
+                (ladder, adm_comp)
+            assert ladder <= (len(srv.admit_sizes)
+                              * len(srv.prefill_buckets)
+                              * len(srv.pool_sizes))
+            step_comp = [e for e in telemetry.events("compile")
+                         if e.get("site") == "serve.step"
+                         and e.get("server") == label]
+            assert len(step_comp) == 1, step_comp
+            assert not any(e.get("retrace")
+                           for e in adm_comp + step_comp)
+            reg_steps = telemetry.counter(
+                "serve_step_dispatches_total", server=label).value
+            assert reg_steps == steps == srv.stats()["steps"], \
+                (reg_steps, steps)
+            print("# telemetry OK: admission-ladder compiles "
+                  f"{len(adm_comp)}, 1 step compile, 0 retraces, "
+                  f"{reg_steps} step dispatches == steps")
     srv.close()
 
     ragged = {}
     for frac in (0.25, 0.5, 1.0):
+        phase(f"ragged_occ={frac}")
         st, ct, occ, rt = run_ragged(net, cfg, S, P, N, frac,
                                      n_requests)
         ragged[frac] = (st, ct)
-        print(json.dumps({"bench": "serve",
-                          "mode": f"ragged_occ={frac}",
-                          "profile": profile,
-                          "static_padded_tok_s": round(st, 1),
-                          "continuous_tok_s": round(ct, 1),
-                          "continuous_vs_static": round(ct / st, 3),
-                          "occupancy": round(occ, 3),
-                          "p50_ttft_ms": round(_pct(rt, 0.5) * 1e3, 3),
-                          "p99_ttft_ms": round(_pct(rt, 0.99) * 1e3, 3),
-                          "platform": platform}))
-        sys.stdout.flush()
+        emit_row({"bench": "serve",
+                  "mode": f"ragged_occ={frac}",
+                  "profile": profile,
+                  "static_padded_tok_s": round(st, 1),
+                  "continuous_tok_s": round(ct, 1),
+                  "continuous_vs_static": round(ct / st, 3),
+                  "occupancy": round(occ, 3),
+                  "p50_ttft_ms": round(_pct(rt, 0.5) * 1e3, 3),
+                  "p99_ttft_ms": round(_pct(rt, 0.99) * 1e3, 3),
+                  "platform": platform})
 
     # admission-heavy arms (ISSUE 8): short decode budgets, Poisson
     # bursts at idle step boundaries — sequential (admit_sizes=(1,),
@@ -349,10 +401,11 @@ def main():
     n_bursts = {"tpu": 8, "cpu": 6, "smoke": 4}[profile]
     adm = {}
     for name, sequential in (("sequential", True), ("batched", False)):
+        phase(f"admit_{name}")
         tps, ttfts, apr, bursts = run_admission(net, cfg, S, P, N_adm,
                                                 n_bursts, sequential)
         adm[name] = (tps, ttfts, apr, bursts)
-        print(json.dumps({
+        emit_row({
             "bench": "serve", "mode": f"admit_{name}",
             "profile": profile,
             "tokens_per_sec": round(tps, 1),
@@ -361,17 +414,15 @@ def main():
             "admit_dispatches_per_request": round(apr, 3),
             "bursts": [list(b) for b in bursts],
             "new_tokens": N_adm,
-            "platform": platform}))
-        sys.stdout.flush()
+            "platform": platform})
     tps_x = adm["batched"][0] / adm["sequential"][0]
     p99_x = _pct(adm["sequential"][1], 0.99) / \
         max(_pct(adm["batched"][1], 0.99), 1e-9)
-    print(json.dumps({"bench": "serve", "mode": "admit_ratio",
-                      "profile": profile,
-                      "batched_vs_sequential_tok_s": round(tps_x, 3),
-                      "batched_p99_ttft_speedup": round(p99_x, 3),
-                      "platform": platform}))
-    sys.stdout.flush()
+    emit_row({"bench": "serve", "mode": "admit_ratio",
+              "profile": profile,
+              "batched_vs_sequential_tok_s": round(tps_x, 3),
+              "batched_p99_ttft_speedup": round(p99_x, 3),
+              "platform": platform})
     # k pending prompts at a step boundary cost 1 admit dispatch in
     # the batched arm — and k in the sequential baseline (every
     # profile, tier-1 via --smoke)
@@ -396,15 +447,15 @@ def main():
         # 2-core host land 0.2-0.45x
         assert ratio >= 0.12, f"saturated ratio {ratio:.3f} < 0.12 floor"
         st, ct = ragged[0.25]
-        print(json.dumps({"bench": "serve_smoke",
-                          "saturated_ratio": round(ratio, 3),
-                          "ragged_25_continuous_vs_static":
-                              round(ct / st, 3),
-                          "admit_batched_vs_sequential":
-                              round(tps_x, 3),
-                          "admit_p99_ttft_speedup": round(p99_x, 3),
-                          "step_dispatches": steps,
-                          "platform": platform}))
+        emit_row({"bench": "serve_smoke",
+                  "saturated_ratio": round(ratio, 3),
+                  "ragged_25_continuous_vs_static":
+                      round(ct / st, 3),
+                  "admit_batched_vs_sequential":
+                      round(tps_x, 3),
+                  "admit_p99_ttft_speedup": round(p99_x, 3),
+                  "step_dispatches": steps,
+                  "platform": platform})
         print(f"# serve OK: parity x{n_requests}, {steps} step "
               f"dispatches, saturated {ratio:.2f}x static, "
               f"ragged@25% continuous {ct / st:.2f}x padded, "
@@ -424,18 +475,21 @@ def main():
     # offered-QPS sweep: fractions of the saturated request rate
     sat_req_rate = rate / N
     for frac in (0.25, 0.5, 0.9):
+        phase(f"qps_{frac}")
         qps = max(sat_req_rate * frac, 1e-3)
-        tps, lats, occ = run_qps(net, cfg, S, P, N, qps, n_requests)
-        print(json.dumps({
+        tps, ttfts, gaps, occ = run_qps(net, cfg, S, P, N, qps,
+                                        n_requests)
+        emit_row({
             "bench": "serve", "mode": f"qps_{frac}",
             "profile": profile,
             "offered_qps": round(qps, 3),
             "tokens_per_sec": round(tps, 1),
-            "p50_token_latency_ms": round(_pct(lats, 0.5) * 1e3, 3),
-            "p99_token_latency_ms": round(_pct(lats, 0.99) * 1e3, 3),
+            "p50_ttft_ms": round(_pct(ttfts, 0.5) * 1e3, 3),
+            "p99_ttft_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
+            "p50_token_latency_ms": round(_pct(gaps, 0.5) * 1e3, 3),
+            "p99_token_latency_ms": round(_pct(gaps, 0.99) * 1e3, 3),
             "occupancy": round(occ, 3),
-            "platform": platform}))
-        sys.stdout.flush()
+            "platform": platform})
     return 0
 
 

@@ -22,6 +22,15 @@ import numpy as onp
 PEAK_TFLOPS = 197.0
 
 
+def emit_row(row):
+    """Measured row into the telemetry event stream (kind ``bench``) —
+    a ``MXNET_TELEMETRY_JSONL`` recording carries the phase rows next
+    to the compile events in one schema (``tools/telemetry_report.py``
+    renders both; the printed human tables stay as-is)."""
+    from mxnet_tpu import telemetry
+    telemetry.emit("bench", **row)
+
+
 def build_resnet(bs):
     import jax
 
@@ -347,6 +356,8 @@ def train_step_op_count_smoke():
     y = mx.nd.array(rng.randint(0, cfg.vocab_size, (bs, 16)))
     n = trainer.step_hlo_op_count(x, y)
     print(f"\ntrain-step HLO op count (tiny BERT, 2L): {n}")
+    emit_row({"bench": "step_profile", "mode": "train_step_op_count",
+              "model": "tiny-bert-2l", "hlo_ops": n})
     return n
 
 
@@ -362,6 +373,11 @@ def profile_fused_step(smoke=False):
     for mode, disp, dt in rows:
         print(f"  {mode:18s}: {disp:6.0f} host dispatches/step   "
               f"{dt:8.2f} ms/step")
+        emit_row({"bench": "step_profile", "mode": "fused_step_phase",
+                  "arm": mode, "n_params": n,
+                  "workload": "smoke" if smoke else "baseline",
+                  "dispatches_per_step": round(disp, 2),
+                  "ms_per_step": round(dt, 3)})
     return rows
 
 
@@ -378,6 +394,11 @@ def profile_optimizer_apply(trainer, iters=10):
     for mode, disp, dt in rows:
         print(f"  {mode:7s}: {disp:6.0f} optimizer-apply dispatches/step   "
               f"{dt:8.2f} ms/step")
+        emit_row({"bench": "step_profile",
+                  "mode": "optimizer_apply_phase", "arm": mode,
+                  "n_params": n,
+                  "dispatches_per_step": round(disp, 2),
+                  "ms_per_step": round(dt, 3)})
 
 
 def profile_input_overlap(trainer, x, y, steps=8, depth=2):

@@ -44,6 +44,7 @@ def __getattr__(name):
         "kvstore": ".kvstore",
         "kv": ".kvstore",
         "profiler": ".profiler",
+        "telemetry": ".telemetry",
         "runtime": ".runtime",
         "rtc": ".rtc",
         "checkpoint": ".checkpoint",

@@ -661,8 +661,13 @@ class _CachedOp:
 
     def _get_jitted(self, training):
         if training not in self._jitted:
+            from .. import telemetry
             raw = self._make_fn(training)
-            self._jitted[training] = jax.jit(raw)
+            self._jitted[training] = telemetry.instrument_jit(
+                jax.jit(raw), "gluon.cached_op",
+                key=(self._block.name, "train" if training else "eval"),
+                fields={"block": self._block.name,
+                        "training": bool(training)})
         return self._jitted[training]
 
     def __call__(self, args, kwargs):

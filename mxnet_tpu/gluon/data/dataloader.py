@@ -30,11 +30,13 @@ from __future__ import annotations
 import os
 import queue as _queue
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor, TimeoutError as _FutTimeout
 
 import numpy as onp
 
+from ... import telemetry
 from ...base import MXNetError
 from ... import ndarray as nd
 from ...ndarray import NDArray
@@ -201,6 +203,24 @@ class _MultiWorkerIter:
 
 _END = object()  # device-prefetch producer's end-of-stream marker
 
+# process-wide ring telemetry (ISSUE 9 train/data-pipeline wiring):
+# depth gauge + consumer-stall counters.  A stall = the consumer asked
+# for a batch the ring didn't have ready — the step is input-bound at
+# that moment.  Lazy so importing the module never touches the registry.
+_ring_tele_cache = None
+
+
+def _ring_tele():
+    global _ring_tele_cache
+    if _ring_tele_cache is None:
+        _ring_tele_cache = {
+            "depth": telemetry.gauge("data_prefetch_ring_depth"),
+            "stalls": telemetry.counter("data_prefetch_stalls_total"),
+            "stall_s": telemetry.histogram(
+                "data_prefetch_stall_seconds"),
+        }
+    return _ring_tele_cache
+
 
 class DevicePrefetchIter:
     """Depth-``N`` device-resident prefetch ring over any batch iterator.
@@ -295,7 +315,15 @@ class DevicePrefetchIter:
             # blocking get is safe: the producer always delivers _END
             # (even on error), and close() injects one after the join
             # so a consumer parked here wakes instead of hanging
-            item = self._queue.get()
+            tele = _ring_tele()
+            tele["depth"].set(self._queue.qsize())
+            if self._queue.empty():
+                tele["stalls"].inc()
+                t0 = time.perf_counter()
+                item = self._queue.get()
+                tele["stall_s"].observe(time.perf_counter() - t0)
+            else:
+                item = self._queue.get()
             if item is _END:
                 with self._lock:
                     self._done = True
@@ -316,6 +344,10 @@ class DevicePrefetchIter:
             return self._place(next(self._source))
         # threadless ring over an already-asynchronous source; the pull
         # (which may block on the wrapped pool) stays outside the lock
+        tele = _ring_tele()
+        with self._lock:
+            if not self._ring and not self._exhausted:
+                tele["stalls"].inc()   # transfers not ahead of consume
         while True:
             with self._lock:
                 if len(self._ring) >= self._depth or self._exhausted:
@@ -329,6 +361,7 @@ class DevicePrefetchIter:
             with self._lock:
                 self._ring.append(item)
         with self._lock:
+            tele["depth"].set(len(self._ring))
             if not self._ring:
                 raise StopIteration
             return self._ring.popleft()

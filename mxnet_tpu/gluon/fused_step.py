@@ -30,10 +30,12 @@ SGLD) restores today's phase-by-phase path — record → tape backward →
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..base import MXNetError
 
 __all__ = ["FusedStep", "fused_step_enabled", "step_counters",
@@ -55,6 +57,31 @@ step_counters = {"dispatches": 0, "micro_dispatches": 0,
 def reset_step_counters():
     for k in step_counters:
         step_counters[k] = 0
+
+
+# registry instruments mirroring the dict above (plus step latency /
+# accumulation-window phase), created on first use — module import must
+# not touch the registry
+_tele = None
+
+
+def _instruments():
+    global _tele
+    if _tele is None:
+        _tele = {
+            "lat_micro": telemetry.histogram("fused_step_seconds",
+                                             phase="micro"),
+            "lat_apply": telemetry.histogram("fused_step_seconds",
+                                             phase="apply"),
+            "d_micro": telemetry.counter("fused_step_dispatches_total",
+                                         phase="micro"),
+            "d_apply": telemetry.counter("fused_step_dispatches_total",
+                                         phase="apply"),
+            "d_legacy": telemetry.counter("fused_step_dispatches_total",
+                                          phase="legacy"),
+            "window": telemetry.gauge("fused_step_window_pos"),
+        }
+    return _tele
 
 
 def fused_step_enabled() -> bool:
@@ -177,7 +204,9 @@ class FusedStep:
                opt.clip_gradient is not None)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._compile(phase)
+            fn = telemetry.instrument_jit(
+                self._compile(phase), "gluon.fused_step",
+                key=(phase, sig), fields={"phase": phase})
             self._cache[key] = fn
             step_counters["compiles"] += 1
         return fn
@@ -251,11 +280,17 @@ class FusedStep:
                 jnp.zeros(v.shape, _grad_dtype(v.dtype))
                 for v in train_vals]
 
+        tele = _instruments()
         tr._window_pos += 1
         if tr._window_pos < N:
             fn = self._get_fn("micro", sig)
-            outs, self._accum, new_frozen = fn(
-                train_vals, frozen_vals, self._accum, key, *args)
+            t0 = time.perf_counter()
+            with telemetry.annotation("mx:fused_step:micro"):
+                outs, self._accum, new_frozen = fn(
+                    train_vals, frozen_vals, self._accum, key, *args)
+            tele["lat_micro"].observe(time.perf_counter() - t0)
+            tele["d_micro"].inc()
+            tele["window"].set(tr._window_pos)
             step_counters["dispatches"] += 1
             step_counters["micro_dispatches"] += 1
             for p, v in zip(self._frozen_params, new_frozen):
@@ -274,11 +309,17 @@ class FusedStep:
         rescale = jnp.float32(tr._scale / (float(batch_size) * N))
         states = [tr._states[i] for i in self._train_idx]
         fn = self._get_fn("apply", sig)
-        outs, new_ws, new_ss, new_frozen, new_accum = fn(
-            train_vals, states, frozen_vals,
-            self._accum if N > 1 else [], key,
-            jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
-            jnp.asarray(ts, jnp.int32), rescale, *args)
+        t0 = time.perf_counter()
+        with telemetry.annotation("mx:fused_step:apply"):
+            outs, new_ws, new_ss, new_frozen, new_accum = fn(
+                train_vals, states, frozen_vals,
+                self._accum if N > 1 else [], key,
+                jnp.asarray(lrs, jnp.float32),
+                jnp.asarray(wds, jnp.float32),
+                jnp.asarray(ts, jnp.int32), rescale, *args)
+        tele["lat_apply"].observe(time.perf_counter() - t0)
+        tele["d_apply"].inc()
+        tele["window"].set(tr._window_pos)
         step_counters["dispatches"] += 1
         step_counters["apply_dispatches"] += 1
         for p, w in zip(self._train_params, new_ws):
@@ -310,6 +351,7 @@ class FusedStep:
 
         tr = self._trainer
         step_counters["legacy_steps"] += 1
+        _instruments()["d_legacy"].inc()
         with autograd.record(train_mode=self._train_mode):
             out = self._loss_fn(*nd_batch)
         loss = out[0] if isinstance(out, (tuple, list)) else out

@@ -9,10 +9,12 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time as _time
 from collections import namedtuple
 
 import numpy as onp
 
+from .. import telemetry
 from ..base import MXNetError
 from .. import ndarray as nd
 from ..ndarray import NDArray
@@ -237,6 +239,23 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+_prefetch_tele_cache = None
+
+
+def _prefetch_tele():
+    """Lazy shared stall instruments (hot-path callers hold the
+    instrument instead of re-looking it up per batch — the
+    ``_ring_tele`` pattern from gluon/data/dataloader.py)."""
+    global _prefetch_tele_cache
+    if _prefetch_tele_cache is None:
+        _prefetch_tele_cache = {
+            "stalls": telemetry.counter("io_prefetch_stalls_total"),
+            "stall_s": telemetry.histogram(
+                "io_prefetch_stall_seconds"),
+        }
+    return _prefetch_tele_cache
+
+
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference
     ``PrefetchingIter`` ≈ ``dmlc::ThreadedIter`` double buffering).
@@ -342,7 +361,16 @@ class PrefetchingIter(DataIter):
         if self._sync:
             batches = self._pull()  # StopIteration propagates
         else:
-            batches = self._queue.get()
+            # ISSUE 9 pipeline telemetry: a consumer-side stall means
+            # the producer thread wasn't a batch ahead — input-bound
+            if self._queue.empty():
+                tele = _prefetch_tele()
+                tele["stalls"].inc()
+                t0 = _time.perf_counter()
+                batches = self._queue.get()
+                tele["stall_s"].observe(_time.perf_counter() - t0)
+            else:
+                batches = self._queue.get()
             if batches is None:
                 if self._err is not None:
                     err, self._err = self._err, None

@@ -963,7 +963,12 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
                  str(eng.cdtype), prefill, weights, eng.mode)
     cache = model.__dict__.setdefault("_kv_decode_cache", {})
     if cache_key not in cache:
-        cache[cache_key] = jax.jit(eng.build_run())
+        from .. import telemetry
+        cache[cache_key] = telemetry.instrument_jit(
+            jax.jit(eng.build_run()), "models.kv_generate",
+            key=cache_key, fields={"mode": eng.mode, "batch": B,
+                                   "prompt_len": P,
+                                   "new_tokens": max_new_tokens})
 
     # the weight operands must not stay pinned on the engine: the cached
     # jitted run closes over it for the model's lifetime, and a train
@@ -1009,4 +1014,9 @@ def decode_step_program(model, batch=1, total=32, temperature=0.0,
             jnp.zeros((batch,), jnp.int32),
             jnp.asarray(max(total - 2, 0), jnp.int32), ck, cv,
             jax.random.PRNGKey(seed))
-    return jax.jit(step), args
+    from .. import telemetry
+    fn = telemetry.instrument_jit(
+        jax.jit(step), "models.decode_step",
+        key=(batch, total, weights, eng.mode),
+        fields={"mode": eng.mode, "batch": batch})
+    return fn, args

@@ -46,7 +46,17 @@ _state = {"running": False, "trace_dir": None, "op_stats": None,
 def set_config(**kwargs):
     """``mx.profiler.set_config(profile_all=True, filename='prof')`` —
     ``filename`` names the trace output directory (TensorBoard/Perfetto
-    format rather than the reference's single chrome-tracing JSON)."""
+    format rather than the reference's single chrome-tracing JSON).
+
+    Unknown keys raise ``MXNetError`` naming the offender — a typoed
+    ``profile_imperativ=`` must not silently configure nothing."""
+    from .base import MXNetError
+
+    unknown = sorted(set(kwargs) - set(_config))
+    if unknown:
+        raise MXNetError(
+            f"profiler.set_config: unknown config key(s) {unknown}; "
+            f"known keys: {sorted(_config)}")
     _config.update(kwargs)
 
 
@@ -77,9 +87,13 @@ class _OpStats:
 
 
 def _hook(name, dt):
-    st = _state["op_stats"]
-    if st is not None:
-        st.record(name, dt)
+    # under _lock: ``dumps(reset=True)`` swaps op_stats while dispatch
+    # threads record — an unlocked read-then-record here could land a
+    # row in the already-rendered stats object (a lost count)
+    with _lock:
+        st = _state["op_stats"]
+        if st is not None:
+            st.record(name, dt)
 
 
 def start():
@@ -235,23 +249,43 @@ Frame = Task
 
 
 class Counter:
-    """Numeric counter (reference ``ProfileCounter``); values are logged to
-    the host stats table."""
+    """Numeric counter (reference ``ProfileCounter``), delegated to the
+    process-wide telemetry registry: the value lives in a
+    ``profiler_counter{counter=}`` gauge (counters may decrement, so the
+    backing instrument is a gauge), visible in ``mx.telemetry.
+    snapshot()`` / ``render_prometheus()`` next to the runtime's own
+    metrics.  The reference API (``set_value``/``increment``/
+    ``decrement``/``+=``) is unchanged."""
 
     def __init__(self, domain=None, name="counter", value=None):
-        self.name = name
-        self.value = 0
+        from . import telemetry
+        self.name = getattr(domain, "name", "") + name \
+            if domain is not None else name
+        # same (domain+)name = same backing gauge, so two Counter
+        # objects over one name share a value (registry identity); a
+        # fresh gauge starts at 0 and an existing one is NOT reset here
+        self._gauge = telemetry.gauge("profiler_counter",
+                                      counter=self.name)
         if value is not None:
             self.set_value(value)
 
+    @property
+    def value(self):
+        return self._gauge.value
+
+    @value.setter
+    def value(self, v):
+        # the reference API allowed plain ``c.value = n`` assignment
+        self._gauge.set(v)
+
     def set_value(self, value):
-        self.value = value
+        self._gauge.set(value)
 
     def increment(self, delta=1):
-        self.value += delta
+        self._gauge.add(delta)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self._gauge.add(-delta)
 
     def __iadd__(self, v):
         self.increment(v)
@@ -263,12 +297,16 @@ class Counter:
 
 
 class Marker:
-    """Instant event (reference ``ProfileMarker``)."""
+    """Instant event (reference ``ProfileMarker``), delegated to the
+    telemetry event log (kind ``marker``) AND the device timeline."""
 
     def __init__(self, domain=None, name="marker"):
-        self.name = name
+        self.name = getattr(domain, "name", "") + name \
+            if domain is not None else name
 
     def mark(self, scope="process"):
+        from . import telemetry
+        telemetry.emit("marker", name=self.name, scope=scope)
         with jax.profiler.TraceAnnotation(f"marker:{self.name}"):
             pass
 

@@ -38,6 +38,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..base import MXNetError
 from ..models.decoding import _DecodeEngine, _TRACE_LOCK
 
@@ -92,8 +93,10 @@ class PoolPrograms:
     rides in the operands (seed key, stop position)."""
 
     def __init__(self, model, num_slots, max_total, temperature=0.0,
-                 top_k=0, eos_id=None, weights="native"):
+                 top_k=0, eos_id=None, weights="native",
+                 telemetry_label=None):
         self.model = model
+        self.telemetry_label = telemetry_label
         self.S, self.T = int(num_slots), int(max_total)
         self.temperature, self.top_k = float(temperature), int(top_k)
         self.eos_id = None if eos_id is None else int(eos_id)
@@ -166,7 +169,10 @@ class PoolPrograms:
                          keys)
             return new_state, (nxt, emitted, done)
 
-        self._step = jax.jit(step, donate_argnums=(3, 4))
+        self._step = telemetry.instrument_jit(
+            jax.jit(step, donate_argnums=(3, 4)), "serve.step",
+            key=(self.telemetry_label, self.S),
+            fields={"server": self.telemetry_label, "pool": self.S})
         return self._step
 
     # -- admission ------------------------------------------------------ #
@@ -238,6 +244,10 @@ class PoolPrograms:
             new_state = (ck, cv, pos, tok, active, stop, keys)
             return new_state, (first, done)
 
-        fn = jax.jit(admit, donate_argnums=(3, 4))
+        fn = telemetry.instrument_jit(
+            jax.jit(admit, donate_argnums=(3, 4)), "serve.admit",
+            key=(self.telemetry_label, self.S, A, P),
+            fields={"server": self.telemetry_label, "pool": self.S,
+                    "a_bucket": A, "p_bucket": P})
         self._admits[key2] = fn
         return fn

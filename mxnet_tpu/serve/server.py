@@ -43,13 +43,16 @@ batching, same token streams); the server API is unchanged.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
 from collections import deque
+from collections.abc import MutableMapping
 
 import numpy as onp
 
+from .. import telemetry
 from ..base import MXNetError
 
 __all__ = ["DecodeServer", "TokenStream", "serve_counters",
@@ -59,14 +62,63 @@ __all__ = ["DecodeServer", "TokenStream", "serve_counters",
 # the process increments it, so with several servers the numbers
 # interleave.  Per-server truth lives in ``DecodeServer.counters``
 # (tests/test_serve.py pins 1 step dispatch per decode step at steady
-# state against it; benchmark/serve_bench.py reports it).
+# state against it; benchmark/serve_bench.py reports it).  Mutations go
+# through ``_bump`` / ``reset_serve_counters`` — both take
+# ``_counters_lock``, so a reset racing a live scheduler thread's
+# increments can't lose counts (read-modify-write vs. reassign).
 serve_counters = {"step_dispatches": 0, "admit_dispatches": 0,
                   "sync_requests": 0, "pool_grows": 0}
+_counters_lock = threading.Lock()
+_server_seq = itertools.count()
+
+
+def _bump(key, n=1):
+    with _counters_lock:
+        serve_counters[key] += n
 
 
 def reset_serve_counters():
-    for k in serve_counters:
-        serve_counters[k] = 0
+    with _counters_lock:
+        for k in serve_counters:
+            serve_counters[k] = 0
+
+
+class _CounterView(MutableMapping):
+    """The historical ``DecodeServer.counters`` dict API as a live view
+    over per-server registry counters (``serve_<key>_total{server=}``),
+    so benchmarks/tests keep reading ``srv.counters["step_dispatches"]``
+    while exporters see the same numbers in ``telemetry.snapshot()`` /
+    ``render_prometheus()``.  Assignment (the reset path) writes the
+    backing counter; iteration order is the historical key order."""
+
+    _KEYS = ("step_dispatches", "admit_dispatches", "sync_requests",
+             "pool_grows")
+
+    def __init__(self, server_label):
+        self._c = {k: telemetry.counter(f"serve_{k}_total",
+                                        server=server_label)
+                   for k in self._KEYS}
+
+    def inc(self, key, n=1):
+        self._c[key].inc(n)
+
+    def __getitem__(self, key):
+        return self._c[key].value
+
+    def __setitem__(self, key, value):
+        self._c[key]._assign(int(value))
+
+    def __delitem__(self, key):
+        raise MXNetError("DecodeServer.counters keys are fixed")
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return repr(dict(self))
 
 
 def _parse_sizes(var, raw, what):
@@ -236,13 +288,16 @@ class TokenStream:
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "seed", "stream")
+    __slots__ = ("prompt", "max_new", "seed", "stream", "span")
 
     def __init__(self, prompt, max_new, seed, stream):
         self.prompt = prompt
         self.max_new = max_new
         self.seed = seed
         self.stream = stream
+        # request-span telemetry, filled in at admission and emitted as
+        # one ``serve_request`` event at retirement (docs/TELEMETRY.md)
+        self.span = {}
 
 
 class DecodeServer:
@@ -306,6 +361,19 @@ class DecodeServer:
         self.weights = weights
         self.max_pending = int(max_pending)
         self._detok = detokenize
+        # per-server telemetry identity: labels this server's registry
+        # counters/histograms and its compile / serve_* events
+        self.telemetry_label = f"srv{next(_server_seq)}"
+        self._tele = {
+            "ttft": telemetry.histogram("serve_ttft_seconds",
+                                        server=self.telemetry_label),
+            "gap": telemetry.histogram("serve_token_gap_seconds",
+                                       server=self.telemetry_label),
+            "wait": telemetry.histogram("serve_queue_wait_seconds",
+                                        server=self.telemetry_label),
+            "occ": telemetry.gauge("serve_occupancy",
+                                   server=self.telemetry_label),
+        }
 
         self.sync_mode = os.environ.get("MXNET_SERVE_SYNC", "0") == "1"
         self.sync_reason = "MXNET_SERVE_SYNC=1" if self.sync_mode \
@@ -315,7 +383,8 @@ class DecodeServer:
             try:
                 self._progs = PoolPrograms(
                     model, self.pool_sizes[0], self.T, temperature,
-                    top_k, eos_id, weights)
+                    top_k, eos_id, weights,
+                    telemetry_label=self.telemetry_label)
             except MXNetError as e:
                 # models the slot-pool gate rejects still serve, one
                 # request at a time, through the kv_generate fallback
@@ -337,11 +406,19 @@ class DecodeServer:
         self._occupied_lane_steps = 0
         self._capacity_lane_steps = 0   # sums len(_slots) per step, so
         # occupancy stays honest across pool growth (S changes mid-run)
-        # per-server dispatch accounting (the module-level
-        # serve_counters aggregate is also incremented)
-        self.counters = {"step_dispatches": 0, "admit_dispatches": 0,
-                         "sync_requests": 0, "pool_grows": 0}
+        # per-server dispatch accounting: a dict-API view over the
+        # telemetry registry (the module-level serve_counters aggregate
+        # is also incremented, under its shared lock)
+        self.counters = _CounterView(self.telemetry_label)
+        self._stats_emitted = False
         self._thread = None
+        telemetry.emit(
+            "serve_config", server=self.telemetry_label,
+            pool_sizes=list(self.pool_sizes),
+            admit_sizes=list(self.admit_sizes),
+            prefill_buckets=list(self.prefill_buckets),
+            max_total_len=self.T, sync_mode=self.sync_mode,
+            sync_reason=self.sync_reason)
         if autostart:
             self.start()
 
@@ -426,8 +503,8 @@ class DecodeServer:
         return stream
 
     def _count(self, key):
-        self.counters[key] += 1
-        serve_counters[key] += 1
+        self.counters.inc(key)
+        _bump(key)
 
     def reset_counters(self):
         """Zero the per-server dispatch counters AND the step/occupancy
@@ -442,9 +519,14 @@ class DecodeServer:
         self._capacity_lane_steps = 0
 
     def stats(self):
-        """Scheduler/occupancy counters for benchmarks."""
+        """Structured scheduler/occupancy/latency snapshot: the
+        historical counters plus the per-server registry instruments
+        (dispatch counters, TTFT / inter-token-gap / queue-wait
+        histogram summaries) — the serving face of
+        ``telemetry.snapshot()``."""
         S = len(self._slots)
         return {
+            "server": self.telemetry_label,
             "num_slots": S,
             "steps": self._steps,
             "occupancy": (self._occupied_lane_steps /
@@ -453,6 +535,10 @@ class DecodeServer:
             "pending": len(self._pending),
             "in_flight": sum(r is not None for r in self._slots),
             "sync_mode": self.sync_mode,
+            "counters": dict(self.counters),
+            "ttft": self._tele["ttft"].summary(),
+            "token_gap": self._tele["gap"].summary(),
+            "queue_wait": self._tele["wait"].summary(),
         }
 
     def close(self, drain=True, timeout=60.0):
@@ -493,7 +579,18 @@ class DecodeServer:
                     "stops at the next step boundary — call close() "
                     "again to finish teardown")
         self._flush_drain(final=True)
-        self._teardown(MXNetError("server closed"))
+        self._emit_stats()
+        self._teardown(MXNetError("server closed"), reason="closed")
+
+    def _emit_stats(self):
+        """One ``serve_stats`` event per server lifetime (at close):
+        the final counters + occupancy + latency summaries, so a
+        recorded JSONL alone can re-check the one-dispatch-per-step
+        discipline (``tools/telemetry_report.py --check-serve``)."""
+        if self._stats_emitted:
+            return
+        self._stats_emitted = True
+        telemetry.emit("serve_stats", **self.stats())
 
     def __enter__(self):
         return self
@@ -550,7 +647,7 @@ class DecodeServer:
         self._inflight.clear()   # readbacks are dropped, not routed
         self._teardown(err)
 
-    def _teardown(self, err):
+    def _teardown(self, err, reason="error"):
         """Fail every queued and in-flight request with ``err``.  The
         snapshot-and-clear runs under the lock; streams are finished
         OUTSIDE it — _finish wakes consumer threads (and on_token
@@ -563,6 +660,7 @@ class DecodeServer:
             self._work.notify_all()
         for req in dropped + leftover:
             req.stream._finish(err)
+            self._observe_retire(req, reason)
 
     # admissions --------------------------------------------------------- #
     def _take_pending(self):
@@ -592,7 +690,8 @@ class DecodeServer:
                 break
         progs = PoolPrograms(self.model, new_s, self.T,
                              self.temperature, self.top_k, self.eos_id,
-                             self.weights)
+                             self.weights,
+                             telemetry_label=self.telemetry_label)
         # the old pool's in-flight readbacks refer to old slot indices;
         # they stay valid — slots only ever grow
         self._progs = progs
@@ -664,9 +763,25 @@ class DecodeServer:
             n = req.prompt.size
             prompts[i, :n] = req.prompt
             meta[i] = (1, n, slot, n + req.max_new - 1, req.seed)
+        # request-span admission fields + one serve_admit event per
+        # dispatch (waves are step-boundary-rare, not per-token)
+        now = time.perf_counter()
+        S = len(self._slots)
+        busy = sum(r is not None for r in self._slots)
+        occ = busy / S if S else 0.0
+        for _slot, req in wave:
+            wait = now - req.stream.submit_time
+            req.span.update(queue_wait_s=wait, wave=len(wave),
+                            a_bucket=A, p_bucket=P,
+                            occupancy_at_admit=occ)
+            self._tele["wait"].observe(wait)
+        telemetry.emit("serve_admit", server=self.telemetry_label,
+                       wave=len(wave), a_bucket=A, p_bucket=P,
+                       pool=S, occupancy=round(occ, 4))
         param_vals, q8, sw = self._progs.operands
-        new_state, (first, done) = fn(param_vals, prompts, meta,
-                                      *self._state)
+        with telemetry.annotation("mx:serve:admit"):
+            new_state, (first, done) = fn(param_vals, prompts, meta,
+                                          *self._state)
         self._state = new_state
         self._count("admit_dispatches")
         self._inflight.append(("admit", (first, done), list(wave)))
@@ -674,14 +789,16 @@ class DecodeServer:
     # the step ------------------------------------------------------------ #
     def _dispatch_step(self):
         param_vals, q8, sw = self._progs.operands
-        new_state, out = self._progs.step_fn()(
-            param_vals, q8, sw, *self._state)
+        with telemetry.annotation("mx:serve:step"):
+            new_state, out = self._progs.step_fn()(
+                param_vals, q8, sw, *self._state)
         self._state = new_state
         self._count("step_dispatches")
         self._steps += 1
-        self._occupied_lane_steps += sum(
-            r is not None for r in self._slots)
+        busy = sum(r is not None for r in self._slots)
+        self._occupied_lane_steps += busy
         self._capacity_lane_steps += len(self._slots)
+        self._tele["occ"].set(busy / len(self._slots))
         self._inflight.append(("step", out, list(self._slots)))
 
     # drain ---------------------------------------------------------------- #
@@ -705,9 +822,11 @@ class DecodeServer:
         first = onp.asarray(arrays[0])
         done = onp.asarray(arrays[1])
         for i, (slot, req) in enumerate(wave):
-            req.stream._push(int(first[i]))
+            tok = int(first[i])
+            req.stream._push(tok)
             if done[i]:
                 req.stream._finish()
+                self._observe_retire(req, self._retire_reason(tok))
                 with self._lock:
                     if self._slots[slot] is req:
                         self._slots[slot] = None
@@ -732,13 +851,52 @@ class DecodeServer:
                 for slot, req in enumerate(snapshot):
                     if req is None or not emitted[slot]:
                         continue
-                    req.stream._push(int(toks[slot]))
+                    tok = int(toks[slot])
+                    req.stream._push(tok)
                     if done[slot]:
                         req.stream._finish()
+                        self._observe_retire(req,
+                                             self._retire_reason(tok))
                         with self._lock:
                             if self._slots[slot] is req:
                                 self._slots[slot] = None
         return worked
+
+    # request-span telemetry ------------------------------------------------ #
+    def _retire_reason(self, last_tok):
+        """The step/admit executables fold EOS and budget exhaustion
+        into one ``done`` flag; the host recovers which fired from the
+        final token (EOS wins when both land on the same token)."""
+        return "eos" if self.eos_id is not None \
+            and last_tok == self.eos_id else "max_len"
+
+    def _observe_retire(self, req, reason):
+        """Close a request's span: registry observations (TTFT,
+        inter-token gaps, requests-by-reason) + one ``serve_request``
+        event.  Runs on the drain path at retirement only — never per
+        token, never under ``_lock``."""
+        st = req.stream
+        sp = req.span
+        ttft = st.ttft
+        if ttft is not None:
+            self._tele["ttft"].observe(ttft)
+        gap = self._tele["gap"]
+        times = st.times
+        for a, b in zip(times, times[1:]):
+            gap.observe(b - a)
+        telemetry.counter("serve_requests_total",
+                          server=self.telemetry_label,
+                          reason=reason).inc()
+        telemetry.emit(
+            "serve_request", server=self.telemetry_label,
+            request_id=st.request_id, reason=reason,
+            tokens=len(times),
+            ttft_s=None if ttft is None else round(ttft, 6),
+            queue_wait_s=None if "queue_wait_s" not in sp
+            else round(sp["queue_wait_s"], 6),
+            wave=sp.get("wave"), a_bucket=sp.get("a_bucket"),
+            p_bucket=sp.get("p_bucket"),
+            occupancy_at_admit=sp.get("occupancy_at_admit"))
 
     # sync fallback -------------------------------------------------------- #
     def _pump_sync(self):
@@ -748,6 +906,9 @@ class DecodeServer:
         if req is None:
             return False
         self._count("sync_requests")
+        wait = time.perf_counter() - req.stream.submit_time
+        req.span["queue_wait_s"] = wait
+        self._tele["wait"].observe(wait)
         try:
             out = kv_generate(self.model, req.prompt[None],
                               max_new_tokens=req.max_new,
@@ -755,16 +916,23 @@ class DecodeServer:
                               top_k=self.top_k, seed=req.seed,
                               weights=self.weights)
             new = out[0, req.prompt.size:]
+            last = None
             if self.eos_id is not None:
                 for t in new:
-                    req.stream._push(int(t))
-                    if int(t) == self.eos_id:
+                    last = int(t)
+                    req.stream._push(last)
+                    if last == self.eos_id:
                         break
                 req.stream._finish()
             else:
                 for t in new:
-                    req.stream._push(int(t))
+                    last = int(t)
+                    req.stream._push(last)
                 req.stream._finish()
+            self._observe_retire(
+                req, "max_len" if last is None
+                else self._retire_reason(last))
         except Exception as e:                 # surface, don't hang
             req.stream._finish(e)
+            self._observe_retire(req, "error")
         return True

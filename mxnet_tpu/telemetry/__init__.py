@@ -1,0 +1,74 @@
+"""``mx.telemetry`` — unified runtime telemetry (ISSUE 9).
+
+One process-wide layer replaces the per-benchmark instruments the perf
+claims used to rest on (module-global counter dicts, ad-hoc stopwatch
+code, hand-called ``profiler_xla.hlo_op_count``):
+
+- **metrics registry** (:mod:`.registry`): thread-safe counters /
+  gauges / fixed-bucket histograms, near-zero cost to record, exported
+  on demand via :func:`snapshot` or :func:`render_prometheus`.
+- **event log** (:mod:`.events`): structured ``compile`` / serve-span /
+  bench events in a bounded ring, fanned out to JSONL sinks
+  (``MXNET_TELEMETRY_JSONL=path`` or :func:`add_jsonl_sink`);
+  ``tools/telemetry_report.py`` summarizes a recorded file and
+  re-checks the dispatch/retrace invariants from it alone.
+- **compile watch** (:func:`instrument_jit`): every ``jax.jit`` trace
+  in the hot subsystems (fused train step, CachedOp, serve pool
+  programs, offline decode) emits a ``compile`` event — retrace
+  regressions become a queryable stream instead of a test-only
+  assertion.
+- **device-timeline bridge** (:func:`annotation` / :func:`span`):
+  serve/train phases appear as ``jax.profiler.TraceAnnotation`` ranges
+  whenever a device trace is being captured, and cost a no-op context
+  otherwise.
+
+``MXNET_TELEMETRY=0`` disables event emission and un-wraps the compile
+watch (the registry itself stays live — ``DecodeServer.counters`` and
+friends are views over it).  See docs/TELEMETRY.md.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .compile import instrument_jit
+from .events import (JsonlSink, add_jsonl_sink, add_sink, clear_events,
+                     emit, events, remove_sink, telemetry_enabled)
+from .registry import (DEFAULT_LATENCY_BUCKETS, REGISTRY, Counter, Gauge,
+                       Histogram, Registry, counter, gauge, histogram,
+                       render_prometheus, reset_metrics, snapshot)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "counter", "gauge", "histogram", "snapshot", "render_prometheus",
+    "reset_metrics", "DEFAULT_LATENCY_BUCKETS",
+    "emit", "events", "clear_events", "add_sink", "remove_sink",
+    "add_jsonl_sink", "JsonlSink", "telemetry_enabled",
+    "instrument_jit", "annotation", "span",
+]
+
+
+def annotation(name):
+    """A ``jax.profiler.TraceAnnotation`` context while a device trace
+    is being captured (``mx.profiler.start()``), else a free no-op — so
+    serve/train phases land in the device timeline exactly when someone
+    is looking at one."""
+    from .. import profiler
+
+    if profiler._state["running"]:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name, hist=None, **labels):
+    """Time a phase into histogram ``hist`` (default
+    ``f"{name}_seconds"``) and bridge it to the device timeline via
+    :func:`annotation`."""
+    h = REGISTRY.histogram(hist or f"{name}_seconds", **labels)
+    t0 = time.perf_counter()
+    with annotation(name):
+        yield h
+    h.observe(time.perf_counter() - t0)

@@ -677,14 +677,23 @@ class TestServeBenchSmoke:
         lens = ragged_lengths(4, 8, 0.25, 8)
         assert len(lens) == 8 and max(lens) == 8 and min(lens) >= 1
 
-    def test_serve_bench_smoke(self):
+    def test_serve_bench_smoke(self, tmp_path):
         """benchmark/serve_bench.py --smoke: saturated slot-pool serving
         on a tiny geometry — parity with kv_generate, dispatch
         accounting and a throughput floor asserted inside, plus the
         ragged-arrival continuous-vs-static rows printed (the tier-1
         gate; the 0.8x/ragged-win acceptance bars are asserted by the
-        compute-bound --cpu-full profile, recorded in BASELINE.md)."""
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        compute-bound --cpu-full profile, recorded in BASELINE.md).
+
+        The run records its telemetry stream to a JSONL
+        (``MXNET_TELEMETRY_JSONL``), and ``tools/telemetry_report.py
+        --check-serve`` must then reproduce the pinned serving
+        invariants — ladder-bounded compile count, zero steady-state
+        retraces, one step dispatch per decode step — from the
+        recorded file ALONE (ISSUE 9 acceptance)."""
+        jsonl = str(tmp_path / "serve_telemetry.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXNET_TELEMETRY_JSONL=jsonl)
         r = subprocess.run(
             [sys.executable, "benchmark/serve_bench.py", "--smoke"],
             capture_output=True, text=True, cwd="/root/repo", env=env,
@@ -692,3 +701,17 @@ class TestServeBenchSmoke:
         assert r.returncode == 0, r.stderr[-2000:]
         assert '"bench": "serve_smoke"' in r.stdout
         assert "serve OK" in r.stdout
+        assert "telemetry OK" in r.stdout
+
+        assert os.path.exists(jsonl), "JSONL sink never attached"
+        rep = subprocess.run(
+            [sys.executable, "tools/telemetry_report.py", jsonl,
+             "--check-serve"],
+            capture_output=True, text=True, cwd="/root/repo",
+            timeout=120)
+        assert rep.returncode == 0, \
+            rep.stdout[-2000:] + rep.stderr[-2000:]
+        assert "serve checks OK" in rep.stdout
+        assert "compile events" in rep.stdout
+        assert "serve requests" in rep.stdout
+        assert "bench rows" in rep.stdout

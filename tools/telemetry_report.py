@@ -1,0 +1,293 @@
+#!/usr/bin/env python3
+"""Summarize a telemetry JSONL (``MXNET_TELEMETRY_JSONL`` /
+``mx.telemetry.add_jsonl_sink``) into the BASELINE.md-style tables, and
+re-check the dispatch/retrace invariants from the recorded stream alone.
+
+    python tools/telemetry_report.py run.jsonl
+    python tools/telemetry_report.py run.jsonl --check-serve
+    python tools/telemetry_report.py run.jsonl --json
+
+Sections (each skipped when the file has no events of that kind):
+
+- **compile events** — per site: count, retraces, total/max wall time,
+  HLO op count range (when recorded under ``MXNET_TELEMETRY_HLO=1``).
+- **serve requests** — per server: request count by retirement reason,
+  token totals, p50/p99 TTFT and queue wait, admission wave stats.
+- **serve stats** — the per-server close() snapshot: steps, dispatch
+  counters, occupancy.
+- **bench rows** — ``kind=bench`` events (serve_bench / step_profile
+  measured rows) passed through as a table.
+
+``--check-serve`` re-derives the test-pinned serving invariants from
+the stream (no process state needed):
+
+1. compile count per server ≤ the pinned ladder product
+   (``len(admit_sizes) × len(prefill_buckets) × len(pool_sizes)`` from
+   its ``serve_config`` event) and ≤ 1 step compile per pool size;
+2. zero RETRACES: every serve compile event is a distinct program
+   (first-trace), never a second signature of one;
+3. one step-executable dispatch per decode step
+   (``serve_stats.counters.step_dispatches == serve_stats.steps``).
+
+Exit status 1 when a check fails (the tier-1 serve smoke shells this
+against the JSONL ``benchmark/serve_bench.py --smoke`` records).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load(path):
+    events = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"# {path}:{i}: skipping unparseable line ({e})",
+                      file=sys.stderr)
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+    return events
+
+
+def _pct(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _ms(v):
+    """Render an already-milliseconds value (None = no samples)."""
+    return "-" if v is None else f"{v:.3f}"
+
+
+def _to_ms(v):
+    return None if v is None else round(v * 1e3, 3)
+
+
+# --------------------------------------------------------------------- #
+# sections
+# --------------------------------------------------------------------- #
+
+def compile_summary(events):
+    """Per-site compile rows: count/retraces/wall/hlo."""
+    rows = []
+    by_site = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "compile":
+            by_site[e.get("site", "?")].append(e)
+    for site in sorted(by_site):
+        evs = by_site[site]
+        walls = [e.get("wall_s", 0.0) for e in evs]
+        hlo = [e["hlo_ops"] for e in evs if "hlo_ops" in e]
+        rows.append({
+            "site": site,
+            "compiles": len(evs),
+            "retraces": sum(1 for e in evs if e.get("retrace")),
+            "wall_s_total": round(sum(walls), 3),
+            "wall_s_max": round(max(walls), 3) if walls else 0.0,
+            "hlo_ops_min": min(hlo) if hlo else None,
+            "hlo_ops_max": max(hlo) if hlo else None,
+        })
+    return rows
+
+
+def serve_summary(events):
+    """Per-server request-span rows."""
+    by_srv = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "serve_request":
+            by_srv[e.get("server", "?")].append(e)
+    rows = []
+    for srv in sorted(by_srv):
+        evs = by_srv[srv]
+        reasons = defaultdict(int)
+        for e in evs:
+            reasons[e.get("reason", "?")] += 1
+        ttfts = [e["ttft_s"] for e in evs if e.get("ttft_s") is not None]
+        waits = [e["queue_wait_s"] for e in evs
+                 if e.get("queue_wait_s") is not None]
+        waves = [e["wave"] for e in evs if e.get("wave") is not None]
+        rows.append({
+            "server": srv,
+            "requests": len(evs),
+            "reasons": dict(sorted(reasons.items())),
+            "tokens": sum(e.get("tokens", 0) for e in evs),
+            "p50_ttft_ms": _to_ms(_pct(ttfts, 0.5)),
+            "p99_ttft_ms": _to_ms(_pct(ttfts, 0.99)),
+            "p50_queue_wait_ms": _to_ms(_pct(waits, 0.5)),
+            "p99_queue_wait_ms": _to_ms(_pct(waits, 0.99)),
+            "mean_admit_wave": (round(sum(waves) / len(waves), 2)
+                                if waves else None),
+        })
+    return rows
+
+
+def check_serve(events):
+    """Re-derive the serving invariants from the stream; returns a list
+    of failure strings (empty = all good)."""
+    failures = []
+    configs = {e["server"]: e for e in events
+               if e.get("kind") == "serve_config" and "server" in e}
+    compiles = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "compile" and \
+                e.get("site") in ("serve.step", "serve.admit"):
+            compiles[e.get("server")].append(e)
+    stats = [e for e in events if e.get("kind") == "serve_stats"]
+
+    for srv, cfg in sorted(configs.items()):
+        if cfg.get("sync_mode"):
+            continue
+        evs = compiles.get(srv, [])
+        admits = [e for e in evs if e["site"] == "serve.admit"]
+        steps = [e for e in evs if e["site"] == "serve.step"]
+        ladder = (len(cfg.get("admit_sizes", [])) *
+                  len(cfg.get("prefill_buckets", [])) *
+                  len(cfg.get("pool_sizes", [])) or None)
+        if ladder is not None and len(admits) > ladder:
+            failures.append(
+                f"{srv}: {len(admits)} admit compiles exceed the "
+                f"pinned ladder product {ladder}")
+        if len(steps) > len(cfg.get("pool_sizes", [1])):
+            failures.append(
+                f"{srv}: {len(steps)} step compiles for "
+                f"{len(cfg['pool_sizes'])} pinned pool sizes")
+        # distinct-program check: a repeated (pool, A, P) or a
+        # cache_size > 1 event is a RETRACE of an existing program
+        seen = set()
+        for e in admits + steps:
+            key = (e["site"], e.get("pool"), e.get("a_bucket"),
+                   e.get("p_bucket"))
+            if key in seen or e.get("retrace"):
+                failures.append(f"{srv}: retrace of {key}")
+            seen.add(key)
+
+    for st in stats:
+        counters = st.get("counters", {})
+        n_steps = st.get("steps")
+        disp = counters.get("step_dispatches")
+        if n_steps is not None and disp is not None and disp != n_steps:
+            failures.append(
+                f"{st.get('server', '?')}: {disp} step dispatches for "
+                f"{n_steps} decode steps (expected exactly 1/step)")
+    if not configs and not stats:
+        failures.append("no serve_config/serve_stats events in the "
+                        "stream — nothing to check")
+    return failures
+
+
+# --------------------------------------------------------------------- #
+# rendering
+# --------------------------------------------------------------------- #
+
+def render(events):
+    lines = []
+    comp = compile_summary(events)
+    if comp:
+        lines.append("compile events")
+        lines.append(f"  {'site':<24}{'compiles':>9}{'retraces':>9}"
+                     f"{'wall(s)':>9}{'max(s)':>8}  hlo ops")
+        for r in comp:
+            hlo = "-" if r["hlo_ops_min"] is None else (
+                f"{r['hlo_ops_min']}"
+                if r["hlo_ops_min"] == r["hlo_ops_max"]
+                else f"{r['hlo_ops_min']}..{r['hlo_ops_max']}")
+            lines.append(
+                f"  {r['site']:<24}{r['compiles']:>9}{r['retraces']:>9}"
+                f"{r['wall_s_total']:>9.2f}{r['wall_s_max']:>8.2f}  "
+                f"{hlo}")
+    srv = serve_summary(events)
+    if srv:
+        lines.append("")
+        lines.append("serve requests")
+        lines.append(f"  {'server':<8}{'requests':>9}{'tokens':>8}"
+                     f"{'p50 ttft(ms)':>13}{'p99 ttft(ms)':>13}"
+                     f"{'p50 wait(ms)':>13}{'wave':>6}  reasons")
+        for r in srv:
+            wave = "-" if r["mean_admit_wave"] is None \
+                else f"{r['mean_admit_wave']:.1f}"
+            lines.append(
+                f"  {r['server']:<8}{r['requests']:>9}{r['tokens']:>8}"
+                f"{_ms(r['p50_ttft_ms']):>13}{_ms(r['p99_ttft_ms']):>13}"
+                f"{_ms(r['p50_queue_wait_ms']):>13}{wave:>6}  "
+                f"{r['reasons']}")
+    stats = [e for e in events if e.get("kind") == "serve_stats"]
+    if stats:
+        lines.append("")
+        lines.append("serve stats (at close)")
+        for st in stats:
+            c = st.get("counters", {})
+            lines.append(
+                f"  {st.get('server', '?'):<8}steps={st.get('steps')} "
+                f"occupancy={st.get('occupancy', 0):.3f} "
+                f"step_dispatches={c.get('step_dispatches')} "
+                f"admit_dispatches={c.get('admit_dispatches')} "
+                f"pool_grows={c.get('pool_grows')} "
+                f"sync_requests={c.get('sync_requests')}")
+    bench = [e for e in events if e.get("kind") == "bench"]
+    if bench:
+        lines.append("")
+        lines.append("bench rows")
+        for e in bench:
+            row = {k: v for k, v in e.items() if k not in ("ts", "kind")}
+            lines.append("  " + json.dumps(row, sort_keys=True))
+    markers = [e for e in events if e.get("kind") in ("marker", "phase")]
+    if markers:
+        lines.append("")
+        lines.append("markers/phases: " + ", ".join(
+            str(e.get("name", "?")) for e in markers))
+    if not lines:
+        lines.append("(no recognized telemetry events)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a telemetry JSONL and re-check the "
+                    "serving dispatch/retrace invariants from it.")
+    ap.add_argument("path", help="JSONL file recorded via "
+                                 "MXNET_TELEMETRY_JSONL or "
+                                 "mx.telemetry.add_jsonl_sink")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of tables")
+    ap.add_argument("--check-serve", action="store_true",
+                    help="verify serving invariants (ladder-bounded "
+                         "compiles, zero retraces, 1 dispatch/step); "
+                         "exit 1 on violation")
+    args = ap.parse_args(argv)
+
+    events = load(args.path)
+    if args.json:
+        print(json.dumps({
+            "events": len(events),
+            "compile": compile_summary(events),
+            "serve": serve_summary(events),
+            "bench": [e for e in events if e.get("kind") == "bench"],
+        }, indent=2, sort_keys=True))
+    else:
+        print(f"# {args.path}: {len(events)} events")
+        print(render(events))
+
+    if args.check_serve:
+        failures = check_serve(events)
+        if failures:
+            for f in failures:
+                print(f"CHECK FAILED: {f}", file=sys.stderr)
+            return 1
+        print("serve checks OK: ladder-bounded compiles, zero "
+              "retraces, 1 dispatch/step")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
