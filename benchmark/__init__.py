@@ -1,0 +1,31 @@
+"""Shared benchmark helpers.
+
+``mem_fields`` is the one implementation of the "memory columns from
+the newest ``mem_*``-carrying compile event" lookup both
+``serve_bench.py`` and ``step_profile.py`` attach to their measured
+rows — one place to keep the field names / MB rounding in sync.
+"""
+
+
+def mem_fields(site, server=None):
+    """Peak/temp memory columns for a measured row, sourced from the
+    newest compile event of ``site`` that carries the
+    ``MXNET_TELEMETRY_MEM=1`` analysis (optionally filtered to one
+    server's label).  Empty when none was recorded.  The numbers are
+    buffer sizes on the platform the compile ran on — a CPU-profile
+    row reports CPU bytes, not TPU HBM; rows label that via their
+    ``platform`` field."""
+    from mxnet_tpu import telemetry
+
+    for e in reversed(telemetry.events("compile")):
+        if e.get("site") != site:
+            continue
+        if server is not None and e.get("server") != server:
+            continue
+        if "mem_peak_bytes" in e:
+            return {
+                "mem_temp_mb": round(e.get("mem_temp_bytes", 0)
+                                     / 2 ** 20, 3),
+                "mem_peak_mb": round(e["mem_peak_bytes"] / 2 ** 20, 3),
+            }
+    return {}

@@ -38,7 +38,12 @@ Arms (one JSON line each):
   ``admit_dispatches_per_request``.
 
 Every arm that serves streams reports p50/p99 TTFT
-(``TokenStream.ttft``) next to its throughput.
+(``TokenStream.ttft``) next to its throughput.  Full profiles also
+record ``MXNET_TELEMETRY_MEM=1`` compile events and attach
+``mem_temp_mb`` / ``mem_peak_mb`` columns (XLA ``memory_analysis()``
+of the arm's executable) to the measured rows — sized for the row's
+``platform``, so CPU-profile numbers are CPU buffer sizes, not TPU
+HBM.
 
 ``--smoke``: tiny geometry, no TPU — saturated arm with token-stream
 parity against ``kv_generate`` asserted, dispatch accounting checked
@@ -60,6 +65,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as onp
+
+from benchmark import mem_fields
 
 
 def emit_row(row):
@@ -288,6 +295,13 @@ def main():
                          "0.8 saturated bar applies)")
     args = ap.parse_args()
 
+    if not args.smoke:
+        # memory columns for the measured rows: compile events carry
+        # memory_analysis fields (one extra AOT compile per program —
+        # warm-up cost only, off the measured clock; the smoke skips it
+        # to stay inside the tier-1 time budget)
+        os.environ.setdefault("MXNET_TELEMETRY_MEM", "1")
+
     import jax
 
     platform = jax.devices()[0].platform
@@ -304,7 +318,8 @@ def main():
               "profile": profile,
               "tokens_per_sec": round(static_rate, 1),
               "batch": S, "new_tokens": N,
-              "platform": platform})
+              "platform": platform,
+              **mem_fields("models.kv_generate")})
 
     phase("saturated")
     rate, prompts, streams, srv = run_saturated(net, cfg, S, P, N,
@@ -324,7 +339,9 @@ def main():
               "num_slots": S, "requests": n_requests,
               "new_tokens": N, "step_dispatches": steps,
               "admit_dispatches": admits,
-              "platform": platform})
+              "pool_bytes": stats["pool_bytes"],
+              "platform": platform,
+              **mem_fields("serve.step", srv.telemetry_label)})
 
     if args.smoke:
         # parity: every served stream reproduces the offline decode
@@ -414,7 +431,8 @@ def main():
             "admit_dispatches_per_request": round(apr, 3),
             "bursts": [list(b) for b in bursts],
             "new_tokens": N_adm,
-            "platform": platform})
+            "platform": platform,
+            **mem_fields("serve.admit")})
     tps_x = adm["batched"][0] / adm["sequential"][0]
     p99_x = _pct(adm["sequential"][1], 0.99) / \
         max(_pct(adm["batched"][1], 0.99), 1e-9)

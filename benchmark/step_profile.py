@@ -19,6 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as onp
 
+from benchmark import mem_fields
+
 PEAK_TFLOPS = 197.0
 
 
@@ -368,8 +370,13 @@ def profile_fused_step(smoke=False):
     kw = dict(n_layers=8, units=8, bs=4, reps=3, intervals=(1, 2),
               warm=2) if smoke else {}
     n, rows = measure_fused_step(**kw)
+    mem = mem_fields("gluon.fused_step")
     print(f"\nfused-step phase (imperative Trainer, {n}-param chain, "
           f"{'smoke' if smoke else 'baseline'} workload):")
+    if mem:
+        print(f"  executable memory (CPU-profile buffer sizes): "
+              f"temp {mem['mem_temp_mb']} MB, "
+              f"peak {mem['mem_peak_mb']} MB")
     for mode, disp, dt in rows:
         print(f"  {mode:18s}: {disp:6.0f} host dispatches/step   "
               f"{dt:8.2f} ms/step")
@@ -377,7 +384,7 @@ def profile_fused_step(smoke=False):
                   "arm": mode, "n_params": n,
                   "workload": "smoke" if smoke else "baseline",
                   "dispatches_per_step": round(disp, 2),
-                  "ms_per_step": round(dt, 3)})
+                  "ms_per_step": round(dt, 3), **mem})
     return rows
 
 
@@ -494,6 +501,11 @@ def main():
                          "no model build, no trace, runs on CPU in "
                          "seconds)")
     args = ap.parse_args()
+
+    # memory columns for the phase rows: every compile event this run
+    # triggers carries memory_analysis fields (one extra AOT compile
+    # per program, warm-up only — cheap at the smoke's toy sizes too)
+    os.environ.setdefault("MXNET_TELEMETRY_MEM", "1")
 
     if args.smoke:
         rows = profile_fused_step(smoke=True)

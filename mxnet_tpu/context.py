@@ -195,19 +195,12 @@ def gpu_memory_info(device_id: int = 0):
         total = stats["bytes_limit"]
         used = stats.get("bytes_in_use", 0)
         return (max(total - used, 0), total)
-    used = 0
-    try:
-        for a in jax.live_arrays():
-            try:
-                # per-device shard bytes — charging the full global
-                # nbytes would overcount sharded arrays mesh-wide
-                for s in a.addressable_shards:
-                    if s.device == dev and s.data is not None:
-                        used += s.data.nbytes
-            except Exception:
-                continue
-    except Exception:
-        pass
+    # per-device shard bytes over jax.live_arrays() — the same walk the
+    # telemetry memory accountant reconciles against (charging full
+    # global nbytes would overcount sharded arrays mesh-wide)
+    from .telemetry.memory import _devstr, live_device_bytes
+
+    used = live_device_bytes().get(_devstr(dev), 0)
     kind = getattr(dev, "device_kind", "").lower()
     total = next((b for k, b in _HBM_BYTES if k in kind), 0)
     return (max(total - used, 0), total)

@@ -27,6 +27,7 @@ synchronous path (placement happens inline, bit-for-bit identical values).
 """
 from __future__ import annotations
 
+import itertools
 import os
 import queue as _queue
 import threading
@@ -222,6 +223,12 @@ def _ring_tele():
     return _ring_tele_cache
 
 
+# memory-ledger identity for device-prefetch rings (``device_bytes{
+# subsystem="data.prefetch_ring"}``) — monotonic so a closed ring's
+# key is never reused
+_ring_seq = itertools.count()
+
+
 class DevicePrefetchIter:
     """Depth-``N`` device-resident prefetch ring over any batch iterator.
 
@@ -269,6 +276,12 @@ class DevicePrefetchIter:
         # on whatever thread drops the last reference while ``next()``
         # may still be mid-pull on the training thread
         self._lock = threading.RLock()
+        # memory-accountant entry: the ring's device footprint is
+        # registered as depth x per-batch bytes (the full-ring upper
+        # bound) and only re-registered when the batch size actually
+        # changes — steady-state epochs cost one dict compare per batch
+        self._mem_key = f"ring{next(_ring_seq)}"
+        self._batch_pd = None
         self._background = bool(background) and self._depth > 0
         if self._background:
             self._queue = _queue.Queue(maxsize=self._depth)
@@ -280,7 +293,26 @@ class DevicePrefetchIter:
     def _place(self, batch):
         if self._target is None:
             return batch
-        return to_device(batch, self._target)
+        placed = to_device(batch, self._target)
+        self._account(placed)
+        return placed
+
+    def _account(self, placed):
+        """Keep the ``data.prefetch_ring`` ledger entry at depth x
+        per-batch device bytes (the ring's full-depth upper bound —
+        transfers in flight count as resident, which is exactly the
+        budget question).  Runs on whichever thread places (producer or
+        consumer); the last-seen size is compared under ``_lock``."""
+        from ...telemetry.memory import ACCOUNTANT, per_device_bytes
+
+        pd = per_device_bytes(placed)
+        with self._lock:
+            if pd == self._batch_pd:
+                return
+            self._batch_pd = pd
+        depth = max(self._depth, 1)
+        ACCOUNTANT.set("data.prefetch_ring", self._mem_key,
+                       per_device={d: b * depth for d, b in pd.items()})
 
     # -- background producer --------------------------------------------- #
     def _put(self, item):
@@ -402,6 +434,12 @@ class DevicePrefetchIter:
                         pass
         with self._lock:
             self._ring.clear()
+        from ...telemetry.memory import ACCOUNTANT
+
+        # deferred: close() runs from __del__, and a GC-triggered
+        # finalizer may fire inside a thread already holding the
+        # accountant lock — it must never take it synchronously
+        ACCOUNTANT.drop_deferred("data.prefetch_ring", self._mem_key)
         for attr in ("shutdown", "close"):
             fn = getattr(self._source, attr, None)
             if callable(fn):

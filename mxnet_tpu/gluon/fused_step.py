@@ -118,6 +118,7 @@ class FusedStep:
         self._pure = None              # trace_value_and_grad closure
         self._cache: dict = {}         # (phase, sig, ...) -> jitted fn
         self._accum = None             # device grad accumulators (N > 1)
+        self._accum_key = None         # train.grad_accum ledger key
         self._legacy_accum = None      # host-path accumulators (fallback)
         self._static_supported = None  # cached config verdict
 
@@ -173,6 +174,7 @@ class FusedStep:
             train_mode=self._train_mode)
         self._place_params()
         self._built = True
+        self._trainer._account_params()
 
     def _place_params(self):
         """With a data-sharded batch (``data_sharding=``), weights /
@@ -279,6 +281,17 @@ class FusedStep:
             self._accum = [
                 jnp.zeros(v.shape, _grad_dtype(v.dtype))
                 for v in train_vals]
+            # the accumulator ring is a real device-resident cost of
+            # update_interval>1 — one ledger entry PER FusedStep (a
+            # trainer driving several loss_fns owns several rings, so
+            # keying by trainer alone would overwrite), sized once
+            # (the donated ring keeps these shapes every window)
+            from ..telemetry.memory import ACCOUNTANT
+
+            self._accum_key = \
+                f"{self._trainer._mem_key()}:fs{id(self):x}"
+            ACCOUNTANT.set("train.grad_accum", self._accum_key,
+                           self._accum)
 
         tele = _instruments()
         tr._window_pos += 1
@@ -330,6 +343,20 @@ class FusedStep:
             p._data._data = v
         self._accum = new_accum if N > 1 else None
         return self._wrap_outs(outs)
+
+    def release_accounting(self):
+        """Retire this step's ``train.grad_accum`` ledger entry —
+        called when the trainer's FusedStep cache evicts it (its
+        accumulator ring is freed with it; an un-dropped entry would
+        read as a ``reconcile()`` delta<0 leak forever).  Deferred
+        drop: this is also reachable from ``Trainer.__del__``, which
+        may run via GC inside a thread holding the accountant lock."""
+        if self._accum_key is not None:
+            from ..telemetry.memory import ACCOUNTANT
+
+            ACCOUNTANT.drop_deferred("train.grad_accum",
+                                     self._accum_key)
+            self._accum_key = None
 
     def _wrap_outs(self, outs):
         from ..ndarray.ndarray import NDArray

@@ -13,6 +13,7 @@ ported code.
 """
 from __future__ import annotations
 
+import itertools
 import pickle
 
 import jax
@@ -23,6 +24,11 @@ from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+# memory-ledger identity for ``telemetry.memory.ACCOUNTANT`` entries
+# (``train.params`` / ``train.opt_states`` / ``train.grad_accum``) —
+# monotonic, so a freed trainer's key is never reused
+_trainer_seq = itertools.count()
 
 
 class Trainer:
@@ -68,6 +74,66 @@ class Trainer:
         # id(loss_fn) -> FusedStep, strong refs (so ids stay unique),
         # FIFO-capped — see fused_step()
         self._fused_steps = {}
+        # one-shot memory-ledger registration (params + optimizer
+        # states are fixed-size once training starts; re-walking them
+        # per step would be pure overhead)
+        self._mem_label = f"trainer{next(_trainer_seq)}"
+        self._mem_accounted = False
+
+    def _mem_key(self):
+        return self._mem_label
+
+    def _account_params(self):
+        """Register this trainer's device-resident training state with
+        the process-wide memory accountant: parameter arrays under
+        ``train.params`` and optimizer states under
+        ``train.opt_states`` (``device_bytes{subsystem,device}``
+        gauges).  Called from ``FusedStep._build`` on the fused path
+        and from ``_update`` on the imperative path; it becomes a
+        no-op flag check once every parameter is materialized — while
+        deferred-init params remain (``step(ignore_stale_grad=True)``
+        before a branch's first forward), it keeps re-registering so
+        late initializations aren't permanently missing from the
+        ledger."""
+        if self._mem_accounted:
+            return
+        self._mem_accounted = all(p._data is not None
+                                  for p in self._params)
+        from ..telemetry.memory import ACCOUNTANT
+
+        ACCOUNTANT.set(
+            "train.params", self._mem_label,
+            [p._data._data for p in self._params
+             if p._data is not None])
+        states = [s for s, created in zip(self._states,
+                                          self._states_created)
+                  if created]
+        if states:
+            ACCOUNTANT.set("train.opt_states", self._mem_label, states)
+
+    def release_accounting(self):
+        """Retire this trainer's memory-ledger entries (params,
+        optimizer states, every cached FusedStep's accumulator ring).
+        Runs on garbage collection; call it explicitly when discarding
+        a trainer mid-process so ``device_bytes{subsystem="train.*"}``
+        and ``reconcile()`` don't carry the dead trainer's bytes.
+        Uses the accountant's DEFERRED drop: this is reachable from
+        ``__del__``, and a finalizer may run via GC inside a thread
+        already holding the accountant lock — taking it here would
+        self-deadlock."""
+        from ..telemetry.memory import ACCOUNTANT
+
+        ACCOUNTANT.drop_deferred("train.params", self._mem_label)
+        ACCOUNTANT.drop_deferred("train.opt_states", self._mem_label)
+        for fs in self._fused_steps.values():
+            fs.release_accounting()
+        self._mem_accounted = False
+
+    def __del__(self):
+        try:
+            self.release_accounting()
+        except Exception:   # interpreter teardown: imports may be gone
+            pass
 
     def _init_optimizer(self, optimizer, optimizer_params):
         # kvstore keys are strings — register both forms so per-param
@@ -297,7 +363,9 @@ class Trainer:
                 # a fresh lambda per loop iteration would otherwise pin
                 # one compiled step (executables + device accumulators)
                 # per call forever — evict oldest and tell the user once
-                self._fused_steps.pop(next(iter(self._fused_steps)))
+                evicted = self._fused_steps.pop(
+                    next(iter(self._fused_steps)))
+                evicted.release_accounting()
                 if not getattr(self, "_fused_evict_warned", False):
                     import warnings
                     warnings.warn(
@@ -332,6 +400,7 @@ class Trainer:
             ss.append(self._states[i])
         if not idxs:
             return
+        self._account_params()
         new_states = self._optimizer.multi_update(idxs, ws, gs, ss)
         for i, ns in zip(idxs, new_states):
             self._states[i] = ns

@@ -835,6 +835,15 @@ class _DecodeEngine:
         return jnp.zeros(shape, self.cdtype), \
             jnp.zeros(shape, self.cdtype)
 
+    def cache_bytes(self):
+        """Device bytes of the K/V cache pair this engine's programs
+        carry — the dominant in-executable allocation, reported as the
+        ``cache_bytes`` field on the decode sites' compile events so a
+        recording can split "KV cache" from "everything else" inside
+        ``mem_temp_bytes`` without re-deriving the geometry."""
+        return 2 * self.NL * self.B * self.KV * self.total * self.D \
+            * jnp.dtype(self.cdtype).itemsize
+
     def take_operands(self):
         """Hand the weight operands (param values + prepared q8/packed/
         stacked arrays) to the caller and DROP the engine's own refs:
@@ -968,7 +977,8 @@ def kv_generate(model, prompt_tokens, max_new_tokens=32, temperature=1.0,
             jax.jit(eng.build_run()), "models.kv_generate",
             key=cache_key, fields={"mode": eng.mode, "batch": B,
                                    "prompt_len": P,
-                                   "new_tokens": max_new_tokens})
+                                   "new_tokens": max_new_tokens,
+                                   "cache_bytes": eng.cache_bytes()})
 
     # the weight operands must not stay pinned on the engine: the cached
     # jitted run closes over it for the model's lifetime, and a train
@@ -1018,5 +1028,6 @@ def decode_step_program(model, batch=1, total=32, temperature=0.0,
     fn = telemetry.instrument_jit(
         jax.jit(step), "models.decode_step",
         key=(batch, total, weights, eng.mode),
-        fields={"mode": eng.mode, "batch": batch})
+        fields={"mode": eng.mode, "batch": batch,
+                "cache_bytes": eng.cache_bytes()})
     return fn, args

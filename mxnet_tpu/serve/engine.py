@@ -42,7 +42,27 @@ from .. import telemetry
 from ..base import MXNetError
 from ..models.decoding import _DecodeEngine, _TRACE_LOCK
 
-__all__ = ["PoolPrograms", "pool_state_init", "pool_state_grow"]
+__all__ = ["PoolPrograms", "pool_state_init", "pool_state_grow",
+           "pool_state_bytes"]
+
+
+# per-slot scalar state bytes: pos/tok/stop int32 (12) + active bool (1)
+# + PRNG key 2x uint32 (8) — see pool_state_init
+_SLOT_STATE_BYTES = 21
+
+
+def pool_state_bytes(eng, num_slots=None):
+    """Device bytes of the pool state at ``num_slots`` slots (default:
+    the engine's own slot count) — the K/V cache pair plus the
+    per-slot scalar vectors.  Pure arithmetic from the engine's
+    geometry, so the budget check in ``DecodeServer`` can price a
+    growth (or the initial pool) BEFORE allocating it.  The cache term
+    is ``_DecodeEngine.cache_bytes`` rescaled to ``num_slots`` lanes —
+    ONE formula shared with the compile events' ``cache_bytes`` field,
+    so the budget threshold cannot drift from what is reported."""
+    S = eng.B if num_slots is None else int(num_slots)
+    cache = (eng.cache_bytes() // eng.B) * S
+    return cache + S * _SLOT_STATE_BYTES
 
 
 def pool_state_init(eng, device=None):
@@ -172,7 +192,8 @@ class PoolPrograms:
         self._step = telemetry.instrument_jit(
             jax.jit(step, donate_argnums=(3, 4)), "serve.step",
             key=(self.telemetry_label, self.S),
-            fields={"server": self.telemetry_label, "pool": self.S})
+            fields={"server": self.telemetry_label, "pool": self.S,
+                    "cache_bytes": self.eng.cache_bytes()})
         return self._step
 
     # -- admission ------------------------------------------------------ #
@@ -248,6 +269,10 @@ class PoolPrograms:
             jax.jit(admit, donate_argnums=(3, 4)), "serve.admit",
             key=(self.telemetry_label, self.S, A, P),
             fields={"server": self.telemetry_label, "pool": self.S,
-                    "a_bucket": A, "p_bucket": P})
+                    "a_bucket": A, "p_bucket": P,
+                    # the A-lane prefill cache pair — the admit
+                    # program's transient scratch the budget check
+                    # prices (pool_state_bytes(eng, A))
+                    "cache_bytes": peng.cache_bytes()})
         self._admits[key2] = fn
         return fn

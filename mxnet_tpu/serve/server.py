@@ -40,6 +40,15 @@ in drain.  Backpressure: ``submit`` blocks (or raises with
 ``MXNET_SERVE_SYNC=1`` — or a model the slot-pool gate rejects — serves
 each request through one ``kv_generate`` call instead (no continuous
 batching, same token streams); the server API is unchanged.
+
+Memory (ISSUE 10): the resident pool is registered with the process-
+wide ``telemetry.memory.ACCOUNTANT`` (``device_bytes{subsystem=
+"serve.kv_pool"}``), and ``MXNET_SERVE_HBM_BUDGET`` /
+``DecodeServer(hbm_budget=)`` bounds the server's device-resident
+serving state: an over-budget pool growth or admission-scratch
+allocation raises a clean ``MXNetError`` naming requested vs available
+bytes instead of an allocator OOM.  ``stats()`` reports
+``pool_bytes`` next to occupancy.
 """
 from __future__ import annotations
 
@@ -136,6 +145,18 @@ def _pool_sizes_from_env():
     return _parse_sizes("MXNET_SERVE_POOL_SIZES",
                         os.environ.get("MXNET_SERVE_POOL_SIZES",
                                        "1,2,4,8"), "slot counts")
+
+
+def _hbm_budget_from_env():
+    """``MXNET_SERVE_HBM_BUDGET``: bytes (K/M/G suffixes accepted) the
+    server's device-resident serving state may occupy; unset = no
+    limit."""
+    from ..telemetry.memory import parse_bytes
+
+    raw = os.environ.get("MXNET_SERVE_HBM_BUDGET")
+    if raw is None:
+        return None
+    return parse_bytes(raw, "MXNET_SERVE_HBM_BUDGET")
 
 
 def _pow2_ladder(start, top):
@@ -320,7 +341,8 @@ class DecodeServer:
                  temperature=0.0, top_k=0, eos_id=None,
                  weights="native", max_pending=256, detokenize=None,
                  admit_sizes=None, prefill_buckets=None,
-                 autostart=True):
+                 hbm_budget=None, autostart=True):
+        from ..telemetry.memory import parse_bytes
         from .engine import PoolPrograms, pool_state_init
 
         self.model = model
@@ -361,6 +383,13 @@ class DecodeServer:
         self.weights = weights
         self.max_pending = int(max_pending)
         self._detok = detokenize
+        # HBM budget (bytes) for this server's device-resident serving
+        # state: the resident slot-pool KV cache plus admission prefill
+        # scratch.  Growth/admission that would exceed it raises a
+        # clean MXNetError naming the shortfall instead of letting the
+        # allocator OOM mid-dispatch; None = unlimited.
+        self.hbm_budget = parse_bytes(hbm_budget, "hbm_budget") \
+            if hbm_budget is not None else _hbm_budget_from_env()
         # per-server telemetry identity: labels this server's registry
         # counters/histograms and its compile / serve_* events
         self.telemetry_label = f"srv{next(_server_seq)}"
@@ -379,6 +408,7 @@ class DecodeServer:
         self.sync_reason = "MXNET_SERVE_SYNC=1" if self.sync_mode \
             else None
         self._progs = None
+        self._pool_bytes = 0
         if not self.sync_mode:
             try:
                 self._progs = PoolPrograms(
@@ -390,8 +420,40 @@ class DecodeServer:
                 # request at a time, through the kv_generate fallback
                 self.sync_mode = True
                 self.sync_reason = str(e)
+        if self.sync_mode and self.hbm_budget is not None:
+            # the kv_generate fallback holds no resident pool and
+            # allocates per-request caches inside its own executables —
+            # the budget machinery has nothing to meter there.  Say so
+            # loudly: a silently inert limit is worse than none
+            import warnings
+
+            warnings.warn(
+                f"DecodeServer hbm_budget={self.hbm_budget} is NOT "
+                "enforced in sync mode (kv_generate fallback"
+                f"{'' if self.sync_reason is None else ': ' + self.sync_reason}"
+                ") — per-request decode caches are unmetered",
+                stacklevel=2)
+        if not self.sync_mode:
+            # price the MINIMUM USABLE configuration before allocating
+            # anything: the smallest pool plus the smallest admission
+            # wave's prefill scratch (every request must pass through
+            # one admission, so a budget that fits the pool alone would
+            # construct a server that fails every submit) — a budget
+            # the config can never fit is a constructor error, not a
+            # first-request teardown
+            from .engine import pool_state_bytes
+
+            self._check_budget(
+                self.pool_sizes[0],
+                scratch=pool_state_bytes(self._progs.eng,
+                                         self.admit_sizes[0]),
+                what=f"initial pool ({self.pool_sizes[0]} slots) plus "
+                     f"the smallest admission wave's "
+                     f"(A={self.admit_sizes[0]}) prefill scratch")
         self._state = None if self.sync_mode \
             else pool_state_init(self._progs.eng)
+        if self._state is not None:
+            self._account_pool()
 
         # scheduler bookkeeping (single scheduler thread; submit() is
         # the only cross-thread writer and it only touches _pending)
@@ -418,7 +480,8 @@ class DecodeServer:
             admit_sizes=list(self.admit_sizes),
             prefill_buckets=list(self.prefill_buckets),
             max_total_len=self.T, sync_mode=self.sync_mode,
-            sync_reason=self.sync_reason)
+            sync_reason=self.sync_reason,
+            hbm_budget=self.hbm_budget, pool_bytes=self._pool_bytes)
         if autostart:
             self.start()
 
@@ -535,6 +598,12 @@ class DecodeServer:
             "pending": len(self._pending),
             "in_flight": sum(r is not None for r in self._slots),
             "sync_mode": self.sync_mode,
+            # accountant-backed resident-pool bytes (0 in sync mode —
+            # the kv_generate fallback holds no resident cache); never
+            # read from self._state here, whose buffers may be donated
+            # to an in-flight dispatch on the scheduler thread
+            "pool_bytes": self._pool_bytes,
+            "hbm_budget": self.hbm_budget,
             "counters": dict(self.counters),
             "ttft": self._tele["ttft"].summary(),
             "token_gap": self._tele["gap"].summary(),
@@ -652,6 +721,18 @@ class DecodeServer:
         snapshot-and-clear runs under the lock; streams are finished
         OUTSIDE it — _finish wakes consumer threads (and on_token
         callers) that may immediately re-enter submit()/stats()."""
+        from ..telemetry.memory import ACCOUNTANT
+
+        # the pool buffers die with the server: RELEASE them (drop the
+        # state refs so the device memory is actually freed, not just
+        # unaccounted) and retire the ledger entry + stats() mirror
+        # together, so a closed server's stats()["pool_bytes"] agrees
+        # with the zeroed device_bytes gauge AND with the allocator
+        # (idempotent: close() after a failed scheduler lands here
+        # twice)
+        self._state = None
+        ACCOUNTANT.drop("serve.kv_pool", self.telemetry_label)
+        self._pool_bytes = 0
         with self._lock:
             dropped = list(self._pending)
             self._pending.clear()
@@ -661,6 +742,48 @@ class DecodeServer:
         for req in dropped + leftover:
             req.stream._finish(err)
             self._observe_retire(req, reason)
+
+    # memory budget ------------------------------------------------------- #
+    def _account_pool(self):
+        """Register the pool state's exact bytes with the process-wide
+        memory accountant (``device_bytes{subsystem="serve.kv_pool",
+        device=}`` gauge + one ``device_memory`` event per change) —
+        called at init and after each growth, never per step.  The
+        ledger stores byte counts only, so the steady state's donated
+        cache buffers (same shapes every step) stay correctly
+        accounted without re-registration."""
+        from ..telemetry.memory import ACCOUNTANT, nbytes_of
+
+        self._pool_bytes = nbytes_of(self._state)
+        ACCOUNTANT.set("serve.kv_pool", self.telemetry_label,
+                       self._state)
+
+    def _check_budget(self, num_slots, scratch=0, what=""):
+        """Refuse device allocations the HBM budget cannot hold, with a
+        clean error naming requested vs available bytes (instead of an
+        allocator OOM mid-dispatch).  ``num_slots`` prices the resident
+        pool at that size; ``scratch`` adds transient bytes (admission
+        prefill caches) on top of it."""
+        if self.hbm_budget is None:
+            return
+        from ..telemetry.memory import format_bytes
+        from .engine import pool_state_bytes
+
+        projected = pool_state_bytes(self._progs.eng, num_slots) \
+            + scratch
+        if projected <= self.hbm_budget:
+            return
+        requested = projected - self._pool_bytes
+        available = max(self.hbm_budget - self._pool_bytes, 0)
+        raise MXNetError(
+            f"serve HBM budget exceeded: {what or 'allocation'} "
+            f"requests {format_bytes(requested)} on top of the "
+            f"{format_bytes(self._pool_bytes)} resident pool, but only "
+            f"{format_bytes(available)} of the "
+            f"{format_bytes(self.hbm_budget)} budget "
+            f"(hbm_budget= / MXNET_SERVE_HBM_BUDGET) remains — raise "
+            "the budget, pin smaller MXNET_SERVE_POOL_SIZES / "
+            "MXNET_SERVE_ADMIT_SIZES, or lower max_total_len")
 
     # admissions --------------------------------------------------------- #
     def _take_pending(self):
@@ -688,6 +811,19 @@ class DecodeServer:
             new_s = s
             if s >= want:
                 break
+        # consult the memory accountant BEFORE compiling/allocating the
+        # larger pool: an over-budget growth is a clean refusal naming
+        # the shortfall, not an allocator OOM halfway through a retrace.
+        # Priced as old + new pools RESIDENT TOGETHER: pool_state_grow
+        # pads the old state into the new one, so both live until the
+        # copy completes — the transient peak, not the settled size.
+        # The refusal is deliberately LOUD (ISSUE 10 acceptance): a
+        # budget the pinned pool ladder outgrows is a sizing error the
+        # operator must see and fix (pin smaller pool sizes, or raise
+        # the budget — tools/memory_report.py prices configs offline),
+        # not a condition to silently serve degraded through
+        self._check_budget(new_s, scratch=self._pool_bytes,
+                           what=f"pool growth {S} -> {new_s} slots")
         progs = PoolPrograms(self.model, new_s, self.T,
                              self.temperature, self.top_k, self.eos_id,
                              self.weights,
@@ -696,6 +832,7 @@ class DecodeServer:
         # they stay valid — slots only ever grow
         self._progs = progs
         self._state = pool_state_grow(self._state, new_s)
+        self._account_pool()
         with self._lock:
             self._slots.extend([None] * (new_s - S))
         self._count("pool_grows")
@@ -715,14 +852,46 @@ class DecodeServer:
             free = [i for i, r in enumerate(self._slots) if r is None]
             if not free:
                 break
+            limit = min(len(free), cap)
+            if self.hbm_budget is not None:
+                # price the wave's admission scratch BEFORE popping it
+                # into the slot table: a refusal here leaves the
+                # requests pending and the slots free (a raise after
+                # slot-recording would strand never-admitted lanes that
+                # close(drain=True) then pumps forever).  The wave is
+                # CLAMPED to the largest pinned A bucket the budget can
+                # hold next to the current pool — a burst that would
+                # only overflow at the big bucket admits in smaller
+                # waves instead of failing; only a pool too large for
+                # even the smallest bucket (reachable after growth)
+                # raises.  The pop below is capped at the clamped size,
+                # so a submit racing in can't inflate the priced A.
+                from .engine import pool_state_bytes
+
+                with self._lock:
+                    limit = min(limit, len(self._pending))
+                if not limit:
+                    break
+                eng = self._progs.eng
+                usable = [a for a in self.admit_sizes
+                          if pool_state_bytes(eng, len(self._slots))
+                          + pool_state_bytes(eng, a)
+                          <= self.hbm_budget]
+                if not usable:
+                    A = self.admit_sizes[0]
+                    self._check_budget(
+                        len(self._slots),
+                        scratch=pool_state_bytes(eng, A),
+                        what=f"admission wave of {limit} "
+                             f"(A={A} prefill scratch)")
+                limit = min(limit, usable[-1])
             # pop + record into the slot table ATOMICALLY: a request
             # must never be invisible to close(drain=True)'s "anything
             # outstanding?" predicate (or to _fail_all) while its
             # admission dispatch is still being built
             wave = []
             with self._lock:
-                while self._pending and len(wave) < min(len(free),
-                                                        cap):
+                while self._pending and len(wave) < limit:
                     req = self._pending.popleft()
                     slot = free[len(wave)]
                     self._slots[slot] = req
@@ -753,6 +922,10 @@ class DecodeServer:
         A = _bucket_for(self.admit_sizes, len(wave))
         P = _bucket_for(self.prefill_buckets,
                         max(req.prompt.size for _, req in wave))
+        # the A-lane prefill scratch was budget-checked in
+        # _admit_pending BEFORE the wave was popped into the slot
+        # table (wave size <= the priced limit, so A here never
+        # exceeds the checked bucket)
         fn = self._progs.admit_fn(A, P)
         prompts = onp.zeros((A, P), onp.int32)
         # idle rows: valid=0 (their scatter drops on device); true_len

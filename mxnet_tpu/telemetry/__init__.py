@@ -21,6 +21,14 @@ code, hand-called ``profiler_xla.hlo_op_count``):
   serve/train phases appear as ``jax.profiler.TraceAnnotation`` ranges
   whenever a device trace is being captured, and cost a no-op context
   otherwise.
+- **memory axis** (:mod:`.memory`, ISSUE 10): per-executable
+  ``memory_analysis()`` bytes on compile events under
+  ``MXNET_TELEMETRY_MEM=1``, the process-wide :data:`ACCOUNTANT`
+  ledger of device-resident allocations by subsystem
+  (``device_bytes{subsystem,device}`` gauges + ``device_memory``
+  events, reconcilable against ``jax.live_arrays()``), and the byte
+  arithmetic behind ``MXNET_SERVE_HBM_BUDGET`` / ``tools/
+  memory_report.py``.
 
 ``MXNET_TELEMETRY=0`` disables event emission and un-wraps the compile
 watch (the registry itself stays live — ``DecodeServer.counters`` and
@@ -31,9 +39,13 @@ from __future__ import annotations
 import contextlib
 import time
 
+from . import memory
 from .compile import instrument_jit
 from .events import (JsonlSink, add_jsonl_sink, add_sink, clear_events,
                      emit, events, remove_sink, telemetry_enabled)
+from .memory import (ACCOUNTANT, MemoryAccountant, format_bytes,
+                     live_device_bytes, mem_enabled, memory_analysis,
+                     nbytes_of, parse_bytes, per_device_bytes, reconcile)
 from .registry import (DEFAULT_LATENCY_BUCKETS, REGISTRY, Counter, Gauge,
                        Histogram, Registry, counter, gauge, histogram,
                        render_prometheus, reset_metrics, snapshot)
@@ -45,6 +57,9 @@ __all__ = [
     "emit", "events", "clear_events", "add_sink", "remove_sink",
     "add_jsonl_sink", "JsonlSink", "telemetry_enabled",
     "instrument_jit", "annotation", "span",
+    "memory", "ACCOUNTANT", "MemoryAccountant", "memory_analysis",
+    "mem_enabled", "nbytes_of", "per_device_bytes", "live_device_bytes",
+    "parse_bytes", "format_bytes", "reconcile",
 ]
 
 

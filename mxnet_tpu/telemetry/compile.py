@@ -19,9 +19,13 @@ restoring the exact pre-telemetry dispatch path.
 
 ``MXNET_TELEMETRY_HLO=1`` additionally records the optimized-HLO
 instruction count (``profiler_xla.count_hlo_ops``) on each compile
-event.  That lowers+compiles the signature a SECOND time through the
-AOT path (shape structs only — donated buffers are never touched), so
-it is a debugging/CI mode, not a production default.
+event, and ``MXNET_TELEMETRY_MEM=1`` the executable's
+``memory_analysis()`` bytes (argument / output / temp / generated-code
+/ peak — ``mem_*`` fields, see ``telemetry.memory``).  Either flag
+lowers+compiles the signature a SECOND time through the AOT path
+(shape structs only — donated buffers are never touched; both flags on
+share the one recompile), so they are debugging/CI modes, not
+production defaults.
 """
 from __future__ import annotations
 
@@ -29,6 +33,7 @@ import os
 import time
 
 from . import events
+from . import memory
 from .registry import REGISTRY
 
 __all__ = ["instrument_jit"]
@@ -92,22 +97,29 @@ class _CompileWatch:
         retrace = cache_size > 1
         if retrace:
             ev["retrace"] = True
-        if _hlo_wanted():
-            n = self._hlo_ops(args, kwargs)
-            if n is not None:
-                ev["hlo_ops"] = n
+        want_hlo, want_mem = _hlo_wanted(), memory.mem_enabled()
+        if want_hlo or want_mem:
+            compiled = self._aot_compile(args, kwargs)
+            if compiled is not None:
+                if want_hlo:
+                    n = self._hlo_ops(compiled)
+                    if n is not None:
+                        ev["hlo_ops"] = n
+                if want_mem:
+                    ma = memory.memory_analysis(compiled)
+                    if ma is not None:
+                        ev.update((f"mem_{k}", v) for k, v in ma.items())
         REGISTRY.counter("compiles_total", site=self._site).inc()
         if retrace:
             REGISTRY.counter("retraces_total", site=self._site).inc()
         events.emit("compile", **ev)
 
-    def _hlo_ops(self, args, kwargs):
-        """Optimized-HLO instruction count for this signature, computed
-        from shape structs so already-donated input buffers are never
-        dereferenced."""
+    def _aot_compile(self, args, kwargs):
+        """Lower+compile this signature a second time from shape
+        structs (already-donated input buffers are never dereferenced)
+        — the one recompile both the HLO op count and the memory
+        analysis read from."""
         import jax
-
-        from .. import profiler_xla
 
         def struct(x):
             if hasattr(x, "shape") and hasattr(x, "dtype"):
@@ -117,7 +129,17 @@ class _CompileWatch:
         try:
             s_args, s_kwargs = jax.tree_util.tree_map(struct,
                                                       (args, kwargs))
-            compiled = self._fn.lower(*s_args, **s_kwargs).compile()
+            return self._fn.lower(*s_args, **s_kwargs).compile()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _hlo_ops(compiled):
+        """Optimized-HLO instruction count of the AOT-compiled
+        signature."""
+        from .. import profiler_xla
+
+        try:
             return profiler_xla.count_hlo_ops(compiled.as_text())
         except Exception:
             return None

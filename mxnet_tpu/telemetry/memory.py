@@ -1,0 +1,400 @@
+"""Memory observability — the memory axis of ``mx.telemetry``
+(ISSUE 10).
+
+The reference MXNet ships a GPU memory profiler next to its operator
+profiler; this module is that axis for the XLA runtime, in three
+layers:
+
+- **per-executable analysis** (:func:`memory_analysis`): XLA's
+  buffer-assignment verdict for one compiled program — argument /
+  output / temp (scratch) / generated-code bytes.  Under
+  ``MXNET_TELEMETRY_MEM=1`` every ``compile`` event the
+  :func:`~mxnet_tpu.telemetry.instrument_jit` watch emits carries these
+  as ``mem_*`` fields (one extra AOT lower+compile from shape structs,
+  same discipline as ``MXNET_TELEMETRY_HLO`` — donated buffers are
+  never dereferenced; a CI/debugging mode, not a production default).
+- **live accounting** (:data:`ACCOUNTANT`): a process-wide ledger of
+  device-resident allocations BY SUBSYSTEM (``serve.kv_pool``,
+  ``data.prefetch_ring``, ``train.params`` / ``train.opt_states`` /
+  ``train.grad_accum``), exported as ``device_bytes{subsystem,device}``
+  registry gauges and ``device_memory`` events, reconcilable against
+  ``jax.live_arrays()`` ground truth (:func:`reconcile`).
+- **budget arithmetic** (:func:`parse_bytes` / :func:`format_bytes`):
+  the ``MXNET_SERVE_HBM_BUDGET`` / ``DecodeServer(hbm_budget=)``
+  enforcement in ``mxnet_tpu.serve`` and the offline
+  "will this config fit an N-GB chip" report
+  (``tools/memory_report.py``) share these.
+
+Reconcile caveats (docs/TELEMETRY.md "Memory" carries the full list):
+the accountant stores BYTE COUNTS, not array references — a donated
+buffer whose successor has the same shape (the steady-state serve pool,
+the fused-step weight ring) stays correctly accounted without
+re-registration, but a subsystem that frees memory without ``drop()``
+leaves a stale entry (``reconcile()`` then reports ``delta < 0``).
+Sharded arrays are charged per addressable shard to each shard's
+device; ``jax.live_arrays()`` additionally sees everything the
+accountant was never told about (jit constants, RNG keys,
+unregistered weights), so ``accounted <= live`` per device is the
+healthy state and the coverage ratio — not a zero delta — is the
+signal.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+from . import events as _events
+from .registry import REGISTRY
+
+__all__ = ["mem_enabled", "parse_bytes", "format_bytes", "nbytes_of",
+           "per_device_bytes", "live_device_bytes", "memory_analysis",
+           "MemoryAccountant", "ACCOUNTANT", "reconcile"]
+
+
+def mem_enabled():
+    """``MXNET_TELEMETRY_MEM=1`` attaches ``compiled.memory_analysis()``
+    fields to every compile event (read per call so tests can
+    toggle it)."""
+    return os.environ.get("MXNET_TELEMETRY_MEM", "0") == "1"
+
+
+# --------------------------------------------------------------------- #
+# byte arithmetic
+# --------------------------------------------------------------------- #
+
+_SUFFIXES = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(raw, what="byte size"):
+    """``int`` bytes from an int or a ``"512M"``-style string (K/M/G/T
+    suffixes, powers of 1024).  Raises ``MXNetError`` naming ``what``
+    on anything else."""
+    from ..base import MXNetError
+
+    if isinstance(raw, bool):
+        raise MXNetError(f"{what}: expected bytes, got {raw!r}")
+    if isinstance(raw, (int, float)):
+        try:
+            n = int(raw)
+        except (ValueError, OverflowError):   # float('inf')/nan
+            raise MXNetError(
+                f"{what}: expected bytes, got {raw!r}") from None
+    else:
+        s = str(raw).strip()
+        mult = 1
+        if s and s[-1].lower() in _SUFFIXES:
+            mult = _SUFFIXES[s[-1].lower()]
+            s = s[:-1]
+        try:
+            n = int(float(s) * mult)
+        except (ValueError, OverflowError):   # "lots" / "1e999"
+            raise MXNetError(
+                f"{what}: expected bytes (int, optionally with a "
+                f"K/M/G/T suffix), got {raw!r}") from None
+    if n < 0:
+        raise MXNetError(f"{what}: bytes must be >= 0, got {raw!r}")
+    return n
+
+
+def format_bytes(n):
+    """Human-readable bytes (``"1.50 GiB"``) for error messages and
+    report tables."""
+    n = int(n)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                      ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+# --------------------------------------------------------------------- #
+# byte walks over pytrees / live arrays
+# --------------------------------------------------------------------- #
+
+def _leaves(tree):
+    """Array leaves of a pytree that may mix jax arrays, numpy arrays,
+    NDArray wrappers, and plain containers (no jax import needed)."""
+    if tree is None:
+        return
+    if isinstance(tree, (list, tuple)):
+        for x in tree:
+            yield from _leaves(x)
+        return
+    if isinstance(tree, dict):
+        for x in tree.values():
+            yield from _leaves(x)
+        return
+    inner = getattr(tree, "_data", None)   # NDArray wrapper
+    if inner is not None and hasattr(inner, "nbytes"):
+        yield inner
+        return
+    if hasattr(tree, "nbytes") and hasattr(tree, "dtype"):
+        yield tree
+
+
+def nbytes_of(tree):
+    """Total logical bytes of every array leaf in ``tree`` (shape x
+    itemsize — metadata only, never a device sync; a GLOBAL sharded
+    array contributes its full logical size here, use
+    :func:`per_device_bytes` for the per-device split)."""
+    return sum(int(x.nbytes) for x in _leaves(tree))
+
+
+def _devstr(dev):
+    try:
+        return f"{dev.platform}:{dev.id}"
+    except Exception:
+        return str(dev)
+
+
+def per_device_bytes(tree):
+    """``{device: bytes}`` for the array leaves of ``tree``: jax arrays
+    are charged per addressable shard to each shard's device (so a
+    mesh-sharded array is not over-counted), host numpy lands under
+    ``"host:0"``."""
+    out = {}
+    for x in _leaves(tree):
+        # accumulate this leaf's shard bytes LOCALLY and merge only on
+        # a complete walk — a shard iteration that raises partway must
+        # not leave half the leaf charged to a device AND all of it to
+        # the host fallback
+        leaf = {}
+        shards = getattr(x, "addressable_shards", None)
+        if shards is not None:
+            try:
+                for s in shards:
+                    if s.data is not None:
+                        k = _devstr(s.device)
+                        leaf[k] = leaf.get(k, 0) + int(s.data.nbytes)
+            except Exception:
+                leaf = {}
+        if not leaf:
+            leaf = {"host:0": int(x.nbytes)}
+        for k, b in leaf.items():
+            out[k] = out.get(k, 0) + b
+    return out
+
+
+def live_device_bytes():
+    """``{device: bytes}`` over ``jax.live_arrays()`` — the allocator's
+    ground truth this process can see (per-device shard bytes, so
+    sharded arrays are not charged mesh-wide)."""
+    import jax
+
+    out = {}
+    try:
+        live = jax.live_arrays()
+    except Exception:
+        return out
+    for a in live:
+        try:
+            for s in a.addressable_shards:
+                if s.data is not None:
+                    k = _devstr(s.device)
+                    out[k] = out.get(k, 0) + int(s.data.nbytes)
+        except Exception:
+            continue
+    return out
+
+
+# --------------------------------------------------------------------- #
+# per-executable analysis
+# --------------------------------------------------------------------- #
+
+def memory_analysis(compiled):
+    """XLA's buffer-assignment bytes for one compiled executable:
+    ``{arg_bytes, out_bytes, temp_bytes, code_bytes, alias_bytes,
+    peak_bytes}`` (``peak`` = args + outputs + temp + code - aliased;
+    aliased bytes are donated inputs reused as outputs, so they are
+    counted once).  ``None`` when the backend exposes no stats."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    out = {}
+    for attr, key in (("argument_size_in_bytes", "arg_bytes"),
+                      ("output_size_in_bytes", "out_bytes"),
+                      ("temp_size_in_bytes", "temp_bytes"),
+                      ("generated_code_size_in_bytes", "code_bytes"),
+                      ("alias_size_in_bytes", "alias_bytes")):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if "arg_bytes" not in out and "temp_bytes" not in out:
+        return None
+    out["peak_bytes"] = (out.get("arg_bytes", 0) + out.get("out_bytes", 0)
+                         + out.get("temp_bytes", 0)
+                         + out.get("code_bytes", 0)
+                         - out.get("alias_bytes", 0))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# live accounting
+# --------------------------------------------------------------------- #
+
+class MemoryAccountant:
+    """Process-wide ledger of device-resident allocations by subsystem.
+
+    ``set(subsystem, key, tree)`` (re)registers one allocation — the
+    bytes are computed per device HERE and only the numbers are kept,
+    never array references (registration cannot pin buffers).  Each
+    mutation updates the ``device_bytes{subsystem,device}`` registry
+    gauge and, when the numbers actually changed, emits one
+    ``device_memory`` event (so a recorded JSONL carries the allocation
+    timeline without per-batch churn — steady-state re-registration of
+    an unchanged size is free)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}      # (subsystem, key) -> {device: bytes}
+        # finalizer-side drop queue: __del__ paths must NEVER take
+        # _lock (a GC pass can run a finalizer inside a thread that is
+        # already holding it — any allocation can trigger collection),
+        # so they append here (deque.append is atomic) and the entry
+        # is retired on the next normal-thread mutation or query
+        self._deferred = deque()
+
+    # -- mutation -------------------------------------------------------- #
+    def set(self, subsystem, key, tree=None, per_device=None):
+        """Register/update allocation ``key`` of ``subsystem``: bytes
+        from the array leaves of ``tree``, or an explicit
+        ``per_device={device: bytes}`` mapping."""
+        pd = dict(per_device) if per_device is not None \
+            else per_device_bytes(tree)
+        ekey = (str(subsystem), str(key))
+        self._drain_deferred()
+        with self._lock:
+            old = self._entries.get(ekey)
+            if old == pd:
+                return
+            self._entries[ekey] = pd
+            touched = set(pd) | set(old or ())
+            totals = self._totals_locked(str(subsystem), touched)
+            # publish UNDER the lock: two concurrent mutations of one
+            # subsystem must land their gauge totals in the order they
+            # were computed, or the older total wins and the gauge
+            # stays stale until the next size change (the gauge's own
+            # lock nests cleanly; sinks never re-enter the accountant)
+            self._publish(str(subsystem), str(key), pd, totals)
+
+    def drop(self, subsystem, key):
+        """Forget allocation ``key`` (idempotent) — call when the
+        buffers are actually released, or ``reconcile()`` reports the
+        stale entry as a negative delta.  NOT safe from ``__del__``
+        finalizers — those use :meth:`drop_deferred`."""
+        ekey = (str(subsystem), str(key))
+        self._drain_deferred()
+        with self._lock:
+            old = self._entries.pop(ekey, None)
+            if not old:
+                return
+            totals = self._totals_locked(str(subsystem), set(old))
+            self._publish(str(subsystem), str(key),
+                          {d: 0 for d in old}, totals)
+
+    def drop_deferred(self, subsystem, key):
+        """Lock-free :meth:`drop` for garbage-collection finalizers
+        (``Trainer.__del__``, ``DevicePrefetchIter.__del__`` → close):
+        the pair is queued atomically and retired — ledger entry
+        removed, gauge zeroed, event emitted — inside the next
+        ``set``/``drop``/query on a normal thread.  Queries drain
+        first, so ``bytes()``/``snapshot()``/``reconcile()`` never see
+        a dropped-but-queued entry; only the exported gauge may lag
+        until the accountant is next touched."""
+        self._deferred.append((str(subsystem), str(key)))
+
+    def _drain_deferred(self):
+        """Retire queued finalizer drops; the queue itself is touched
+        only by atomic deque ops (never under ``_lock``, matching the
+        lock-free enqueue), the ledger mutation takes the lock per
+        item.  Callers invoke this BEFORE their own locked section —
+        an entry enqueued in the gap simply waits for the next
+        drain."""
+        while True:
+            try:
+                sub, key = self._deferred.popleft()
+            except IndexError:
+                return
+            with self._lock:
+                old = self._entries.pop((sub, key), None)
+                if not old:
+                    continue
+                totals = self._totals_locked(sub, set(old))
+                self._publish(sub, key, {d: 0 for d in old}, totals)
+
+    def _totals_locked(self, subsystem, devices):
+        totals = {d: 0 for d in devices}
+        for (sub, _k), pd in self._entries.items():
+            if sub != subsystem:
+                continue
+            for d, b in pd.items():
+                if d in totals:
+                    totals[d] += b
+        return totals
+
+    def _publish(self, subsystem, key, pd, totals):
+        for dev, total in totals.items():
+            REGISTRY.gauge("device_bytes", subsystem=subsystem,
+                           device=dev).set(total)
+            _events.emit("device_memory", subsystem=subsystem, key=key,
+                         device=dev, bytes=pd.get(dev, 0),
+                         subsystem_bytes=total)
+
+    # -- queries --------------------------------------------------------- #
+    def bytes(self, subsystem=None, key=None, device=None):
+        """Accounted bytes, filtered by any of subsystem/key/device."""
+        total = 0
+        self._drain_deferred()
+        with self._lock:
+            for (sub, k), pd in self._entries.items():
+                if subsystem is not None and sub != str(subsystem):
+                    continue
+                if key is not None and k != str(key):
+                    continue
+                for d, b in pd.items():
+                    if device is not None and d != str(device):
+                        continue
+                    total += b
+        return total
+
+    def snapshot(self):
+        """``{subsystem: {device: bytes}}`` over every live entry."""
+        out = {}
+        self._drain_deferred()
+        with self._lock:
+            for (sub, _k), pd in self._entries.items():
+                dst = out.setdefault(sub, {})
+                for d, b in pd.items():
+                    dst[d] = dst.get(d, 0) + b
+        return out
+
+    def reconcile(self):
+        """Per-device ``{device: {accounted, live, delta, coverage}}``
+        against ``jax.live_arrays()``.  ``delta = live - accounted``;
+        healthy subsystems keep ``delta >= 0`` (live sees jit
+        constants / unregistered weights the ledger was never told
+        about), a NEGATIVE delta means a stale entry whose buffers are
+        gone (see the module docstring's caveats)."""
+        live = live_device_bytes()
+        accounted = {}
+        self._drain_deferred()
+        with self._lock:
+            for pd in self._entries.values():
+                for d, b in pd.items():
+                    accounted[d] = accounted.get(d, 0) + b
+        out = {}
+        for dev in set(live) | set(accounted):
+            a, l = accounted.get(dev, 0), live.get(dev, 0)
+            out[dev] = {"accounted": a, "live": l, "delta": l - a,
+                        "coverage": (a / l) if l else None}
+        return out
+
+
+ACCOUNTANT = MemoryAccountant()
+
+
+def reconcile():
+    """Module-level shortcut for ``ACCOUNTANT.reconcile()``."""
+    return ACCOUNTANT.reconcile()

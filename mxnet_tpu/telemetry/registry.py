@@ -267,7 +267,7 @@ class Registry:
             pname = _prom_name(name)
             lines.append(f"# TYPE {pname} {kind}")
             for m in ms:
-                base = ",".join(f'{_prom_name(k)}="{v}"'
+                base = ",".join(f'{_prom_name(k)}="{_prom_escape(v)}"'
                                 for k, v in m.labels)
                 if m.kind != "histogram":
                     lines.append(
@@ -294,6 +294,15 @@ class Registry:
 
 def _prom_name(name):
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_escape(value):
+    """Label VALUES per the Prometheus text exposition format: inside
+    the double quotes, backslash, double-quote and line-feed must be
+    escaped (``\\\\``, ``\\"``, ``\\n``) — a hostile label value (a
+    file path, an error string) must not break the scrape."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 REGISTRY = Registry()

@@ -91,6 +91,23 @@ class TestRegistry:
         assert 't_prom_hist_bucket{le="+Inf"} 1' in text
         assert "t_prom_hist_count 1" in text
 
+    def test_prometheus_hostile_label_values_escaped(self):
+        """Satellite (ISSUE 10): label VALUES are escaped per the text
+        exposition format — a backslash-laden path, an embedded quote,
+        or a newline in a label (error strings end up in labels) must
+        not break the scrape line."""
+        c = telemetry.counter("t_prom_escape", path="a\\b",
+                              msg='say "hi"\nline2')
+        c.inc()
+        text = telemetry.render_prometheus()
+        line = next(l for l in text.splitlines()
+                    if l.startswith("t_prom_escape{"))
+        # labels sort by key: msg before path
+        assert line == ('t_prom_escape{msg="say \\"hi\\"\\nline2",'
+                        'path="a\\\\b"} 1')
+        # every sample stays one line: the newline was escaped
+        assert "\nline2" not in line
+
     def test_snapshot_and_reset(self):
         c = telemetry.counter("t_snap_counter")
         c.inc(7)

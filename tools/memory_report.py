@@ -1,0 +1,382 @@
+#!/usr/bin/env python3
+"""Offline per-step memory budget report from a telemetry JSONL
+(``MXNET_TELEMETRY_JSONL`` recorded under ``MXNET_TELEMETRY_MEM=1``).
+
+    python tools/memory_report.py run.jsonl
+    python tools/memory_report.py run.jsonl --hbm 16G
+    python tools/memory_report.py run.jsonl --json
+    python tools/memory_report.py --smoke
+
+Sections (each skipped when the stream has no events of that kind):
+
+- **per-executable memory** — per compile site: executables analyzed,
+  max argument / output / temp (XLA scratch) / generated-code / peak
+  bytes from the ``mem_*`` compile-event fields.
+- **resident subsystems** — the live-accountant timeline
+  (``device_memory`` events): last-known bytes per subsystem per
+  device (``train.params`` / ``train.opt_states`` /
+  ``train.grad_accum`` / ``serve.kv_pool`` / ``data.prefetch_ring``).
+- **budget table** — the per-step answer: PEAK resident subsystem
+  totals over the recording (a pool or trainer released before the
+  recording ended still had to fit while live) + the largest
+  executable's temp and generated-code scratch = the HBM a step of
+  this recorded config needs.  With ``--hbm N`` (bytes; K/M/G
+  suffixes) the verdict "will this config fit an N-byte chip" is
+  printed and the exit status is 1 when it does not — or when the
+  stream carries no memory telemetry at all (an unmeasured recording
+  must fail a CI gate, not sail through at 0 bytes) — the offline
+  capacity check the serve runtime enforces live through
+  ``MXNET_SERVE_HBM_BUDGET``.
+
+``--smoke`` records its own tiny workload (a fused train step + a
+slot-pool decode server on a toy GPT) under ``MXNET_TELEMETRY_MEM=1``,
+then asserts the report pipeline end to end: memory fields from both
+train and serve compile sites, accountant events for ``train.params``
+and ``serve.kv_pool``, and a fits-verdict round trip.  Tier-1 shells it
+(tests/test_memory.py).
+
+This reader is dependency-free on purpose (no mxnet_tpu/jax import
+unless ``--smoke`` runs a workload) so a recording can be analyzed
+anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+_SUFFIXES = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+# ledger subsystems rendered in budget order (anything else the stream
+# carries is appended after these)
+_KNOWN_SUBSYSTEMS = ("train.params", "train.opt_states",
+                     "train.grad_accum", "serve.kv_pool",
+                     "data.prefetch_ring")
+
+
+def parse_bytes(raw):
+    """Local copy of ``telemetry.memory.parse_bytes`` (this tool stays
+    importable without mxnet_tpu/jax for offline analysis) — same
+    validation: clean ``ValueError`` on junk, negatives rejected."""
+    s = str(raw).strip()
+    mult = 1
+    if s and s[-1].lower() in _SUFFIXES:
+        mult = _SUFFIXES[s[-1].lower()]
+        s = s[:-1]
+    try:
+        n = int(float(s) * mult)
+    except (ValueError, OverflowError):
+        raise ValueError(
+            f"expected bytes (int, optionally with a K/M/G/T suffix), "
+            f"got {raw!r}") from None
+    if n < 0:
+        raise ValueError(f"bytes must be >= 0, got {raw!r}")
+    return n
+
+
+def fmt_bytes(n):
+    n = int(n)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                      ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def load(path):
+    events = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                print(f"# {path}:{i}: skipping unparseable line ({e})",
+                      file=sys.stderr)
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+    return events
+
+
+# --------------------------------------------------------------------- #
+# sections
+# --------------------------------------------------------------------- #
+
+def compile_memory(events):
+    """Per-site rows over compile events that carry ``mem_*`` fields."""
+    by_site = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "compile" and "mem_peak_bytes" in e:
+            by_site[e.get("site", "?")].append(e)
+    rows = []
+    for site in sorted(by_site):
+        evs = by_site[site]
+        rows.append({
+            "site": site,
+            "executables": len(evs),
+            "arg_bytes": max(e.get("mem_arg_bytes", 0) for e in evs),
+            "out_bytes": max(e.get("mem_out_bytes", 0) for e in evs),
+            "temp_bytes": max(e.get("mem_temp_bytes", 0) for e in evs),
+            "code_bytes": max(e.get("mem_code_bytes", 0) for e in evs),
+            "peak_bytes": max(e.get("mem_peak_bytes", 0) for e in evs),
+        })
+    return rows
+
+
+def subsystem_memory(events, agg="last"):
+    """Accountant bytes per ``(subsystem, device)`` from the
+    ``device_memory`` timeline.  ``agg="last"`` is the end-of-recording
+    view (dropped entries report 0 — the "resident subsystems"
+    display); ``agg="peak"`` keeps each entry's maximum, which is what
+    the fit verdict must use — a server closed before the recording
+    ends emits a final 0 for its KV pool, but the step still had to
+    fit while the pool was live."""
+    seen = {}            # (subsystem, key, device) -> bytes
+    for e in events:
+        if e.get("kind") != "device_memory":
+            continue
+        k = (e.get("subsystem", "?"), e.get("key", "?"),
+             e.get("device", "?"))
+        b = e.get("bytes", 0)
+        seen[k] = max(seen.get(k, 0), b) if agg == "peak" else b
+    out = defaultdict(lambda: defaultdict(int))
+    for (sub, _key, dev), b in seen.items():
+        out[sub][dev] += b
+    return {sub: dict(devs) for sub, devs in out.items()}
+
+
+def budget_table(events):
+    """The per-step budget rows: PEAK resident subsystem totals over
+    the recording (summed over devices — single-chip reading;
+    per-device splits are in :func:`subsystem_memory`) plus the
+    largest executable's temp and generated-code scratch.  Peak, not
+    last-known: a pool/trainer released before the sink detached still
+    had to fit while it was live."""
+    subs = subsystem_memory(events, agg="peak")
+    comp = compile_memory(events)
+    rows = []
+    ordered = [s for s in _KNOWN_SUBSYSTEMS if s in subs] + \
+        sorted(s for s in subs if s not in _KNOWN_SUBSYSTEMS)
+    for sub in ordered:
+        rows.append({"item": sub, "kind": "resident",
+                     "bytes": sum(subs[sub].values())})
+    if comp:
+        temp = max(r["temp_bytes"] for r in comp)
+        code = max(r["code_bytes"] for r in comp)
+        worst = max(comp, key=lambda r: r["temp_bytes"])
+        rows.append({"item": f"xla temp (max: {worst['site']})",
+                     "kind": "scratch", "bytes": temp})
+        if code:
+            rows.append({"item": "generated code (max)",
+                         "kind": "scratch", "bytes": code})
+    rows.append({"item": "TOTAL (resident + worst-step scratch)",
+                 "kind": "total",
+                 "bytes": sum(r["bytes"] for r in rows)})
+    return rows
+
+
+def fit_verdict(events, hbm_bytes):
+    """Fit verdict for an ``hbm_bytes`` chip.  ``measured`` requires
+    per-executable ``mem_*`` compile events in the stream — the
+    always-on accountant alone cannot answer "does a STEP fit": a
+    recording made without ``MXNET_TELEMETRY_MEM=1`` has resident rows
+    but zero bytes of XLA scratch, and passing that through a CI gate
+    would bless a config whose executable temp OOMs the real chip."""
+    rows = budget_table(events)
+    total = rows[-1]["bytes"]
+    measured = bool(compile_memory(events))
+    return {
+        "hbm_bytes": hbm_bytes,
+        "total_bytes": total,
+        "headroom_bytes": hbm_bytes - total,
+        "measured": measured,
+        "fits": measured and total <= hbm_bytes,
+    }
+
+
+def render(events):
+    lines = []
+    comp = compile_memory(events)
+    if comp:
+        lines.append("per-executable memory (max over compiles, "
+                     "MXNET_TELEMETRY_MEM=1 fields)")
+        lines.append(f"  {'site':<24}{'execs':>6}{'args':>12}"
+                     f"{'outputs':>12}{'temp':>12}{'peak':>12}")
+        for r in comp:
+            lines.append(
+                f"  {r['site']:<24}{r['executables']:>6}"
+                f"{fmt_bytes(r['arg_bytes']):>12}"
+                f"{fmt_bytes(r['out_bytes']):>12}"
+                f"{fmt_bytes(r['temp_bytes']):>12}"
+                f"{fmt_bytes(r['peak_bytes']):>12}")
+    subs = subsystem_memory(events)
+    if subs:
+        lines.append("")
+        lines.append("resident subsystems (accountant, last known)")
+        for sub in sorted(subs):
+            for dev, b in sorted(subs[sub].items()):
+                lines.append(f"  {sub:<24}{dev:<12}{fmt_bytes(b):>12}")
+    table = budget_table(events)
+    if len(table) > 1:
+        lines.append("")
+        lines.append("per-step budget")
+        for r in table:
+            lines.append(f"  {r['item']:<44}{fmt_bytes(r['bytes']):>12}")
+    if not lines:
+        lines.append("(no memory telemetry in the stream — record with "
+                     "MXNET_TELEMETRY_MEM=1 and MXNET_TELEMETRY_JSONL)")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- #
+# smoke
+# --------------------------------------------------------------------- #
+
+def smoke():
+    """Record a tiny train + serve workload under
+    ``MXNET_TELEMETRY_MEM=1`` and assert the whole report pipeline:
+    memory fields from train AND serve compile sites, accountant events
+    for params and the KV pool, a fits verdict round trip."""
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TELEMETRY_MEM"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.models import GPT, GPTConfig
+    from mxnet_tpu.serve import DecodeServer
+
+    jsonl = os.path.join(tempfile.mkdtemp(prefix="mxtpu_memrep_"),
+                         "mem.jsonl")
+    sink = telemetry.add_jsonl_sink(jsonl)
+    try:
+        # -- fused train step (train.params / opt_states ledger +
+        #    gluon.fused_step compile memory)
+        mx.random.seed(0)
+        net = nn.Dense(8, in_units=8)
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "adamw",
+                                {"learning_rate": 1e-3}, kvstore=None)
+        loss_l = gluon.loss.L2Loss()
+
+        def loss_fn(xx, yy):
+            return loss_l(net(xx), yy)
+
+        rng = onp.random.RandomState(0)
+        x = mx.nd.array(rng.rand(4, 8).astype("float32"))
+        y = mx.nd.array(rng.rand(4, 8).astype("float32"))
+        trainer.fused_step(loss_fn, x, y)
+
+        # -- slot-pool decode server (serve.kv_pool ledger +
+        #    serve.step/serve.admit compile memory)
+        gpt = GPT(GPTConfig(vocab_size=64, max_length=24, num_layers=2,
+                            units=16, num_heads=2, hidden_size=32))
+        gpt.initialize(mx.init.Normal(0.02))
+        srv = DecodeServer(gpt, max_total_len=24, pool_sizes=(2,),
+                           autostart=False)
+        streams = [srv.submit(rng.randint(0, 64, (4,)),
+                              max_new_tokens=4) for _ in range(2)]
+        while srv.pump():
+            pass
+        for s in streams:
+            s.tokens(30)
+        srv.close()
+    finally:
+        telemetry.remove_sink(sink)
+
+    events = load(jsonl)
+    comp = compile_memory(events)
+    sites = {r["site"] for r in comp}
+    assert {"gluon.fused_step", "serve.step"} <= sites, sites
+    subs = subsystem_memory(events)
+    assert "train.params" in subs and "serve.kv_pool" in subs, subs
+    # the server closed before the sink detached, so last-known pool
+    # bytes are 0 — but the PEAK view (what the fit verdict uses) must
+    # carry the live pool's size
+    peak = subsystem_memory(events, agg="peak")
+    assert sum(peak["serve.kv_pool"].values()) > 0, peak
+    print(render(events))
+    verdict = fit_verdict(events, parse_bytes("16G"))
+    assert verdict["fits"], verdict
+    bad = fit_verdict(events, 1024)
+    assert not bad["fits"], bad
+    print(f"\nmemory report smoke OK: {len(comp)} analyzed sites "
+          f"({', '.join(sorted(sites))}), "
+          f"{len(subs)} resident subsystems, "
+          f"total {fmt_bytes(verdict['total_bytes'])} "
+          f"fits 16G with {fmt_bytes(verdict['headroom_bytes'])} "
+          "headroom")
+    return 0
+
+
+# --------------------------------------------------------------------- #
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Per-step memory budget report from a telemetry "
+                    "JSONL recorded under MXNET_TELEMETRY_MEM=1.")
+    ap.add_argument("path", nargs="?",
+                    help="JSONL recorded via MXNET_TELEMETRY_JSONL")
+    ap.add_argument("--hbm", metavar="BYTES",
+                    help="chip HBM to check against (K/M/G suffixes; "
+                         "e.g. 16G for a v5e chip); exit 1 when the "
+                         "recorded config does not fit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of tables")
+    ap.add_argument("--smoke", action="store_true",
+                    help="record + report a tiny train/serve workload "
+                         "end to end (tier-1 gate, CPU)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return smoke()
+    if args.path is None:
+        ap.error("path is required unless --smoke")
+
+    events = load(args.path)
+    verdict = None
+    if args.hbm is not None:
+        try:
+            hbm = parse_bytes(args.hbm)
+        except ValueError as e:
+            ap.error(f"--hbm: {e}")
+        verdict = fit_verdict(events, hbm)
+    if args.json:
+        print(json.dumps({
+            "events": len(events),
+            "compile_memory": compile_memory(events),
+            "subsystems": subsystem_memory(events),
+            "budget": budget_table(events),
+            "verdict": verdict,
+        }, indent=2, sort_keys=True))
+    else:
+        print(f"# {args.path}: {len(events)} events")
+        print(render(events))
+        if verdict is not None:
+            if not verdict["measured"]:
+                print("\nNO MEMORY TELEMETRY: the stream has no "
+                      "per-executable mem_* compile events, so the "
+                      "step's XLA scratch is unknown — cannot judge "
+                      "the fit (record with MXNET_TELEMETRY_MEM=1 "
+                      "and MXNET_TELEMETRY_JSONL)")
+            else:
+                word = "FITS" if verdict["fits"] else "DOES NOT FIT"
+                print(f"\n{word} {fmt_bytes(verdict['hbm_bytes'])}: "
+                      f"total {fmt_bytes(verdict['total_bytes'])}, "
+                      f"headroom "
+                      f"{fmt_bytes(verdict['headroom_bytes'])}")
+    return 0 if verdict is None or verdict["fits"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,12 @@ the stream (no process state needed):
 2. zero RETRACES: every serve compile event is a distinct program
    (first-trace), never a second signature of one;
 3. one step-executable dispatch per decode step
-   (``serve_stats.counters.step_dispatches == serve_stats.steps``).
+   (``serve_stats.counters.step_dispatches == serve_stats.steps``);
+4. pool bytes ≤ the configured HBM budget across the whole recording:
+   for servers whose ``serve_config`` carries a non-null
+   ``hbm_budget``, every ``serve.kv_pool`` accountant sample
+   (``device_memory`` events) and the close-time
+   ``serve_stats.pool_bytes`` must stay within it.
 
 Exit status 1 when a check fails (the tier-1 serve smoke shells this
 against the JSONL ``benchmark/serve_bench.py --smoke`` records).
@@ -180,6 +185,32 @@ def check_serve(events):
             failures.append(
                 f"{st.get('server', '?')}: {disp} step dispatches for "
                 f"{n_steps} decode steps (expected exactly 1/step)")
+
+    # pool bytes vs the configured HBM budget, across the recording:
+    # the accountant timeline (device_memory events keyed by the server
+    # label) plus the close-time serve_stats snapshot
+    pool_peak = defaultdict(int)
+    for e in events:
+        if e.get("kind") == "device_memory" and \
+                e.get("subsystem") == "serve.kv_pool":
+            srv = e.get("key", "?")
+            pool_peak[srv] = max(pool_peak[srv], e.get("bytes", 0))
+    for srv, cfg in sorted(configs.items()):
+        budget = cfg.get("hbm_budget")
+        if budget is None:
+            continue
+        peak = pool_peak.get(srv, 0)
+        if peak > budget:
+            failures.append(
+                f"{srv}: pool bytes {peak} exceed the configured "
+                f"hbm_budget {budget}")
+    for st in stats:
+        budget = configs.get(st.get("server"), {}).get("hbm_budget")
+        pb = st.get("pool_bytes")
+        if budget is not None and pb is not None and pb > budget:
+            failures.append(
+                f"{st.get('server', '?')}: serve_stats pool_bytes "
+                f"{pb} exceed the configured hbm_budget {budget}")
     if not configs and not stats:
         failures.append("no serve_config/serve_stats events in the "
                         "stream — nothing to check")
@@ -285,7 +316,7 @@ def main(argv=None):
                 print(f"CHECK FAILED: {f}", file=sys.stderr)
             return 1
         print("serve checks OK: ladder-bounded compiles, zero "
-              "retraces, 1 dispatch/step")
+              "retraces, 1 dispatch/step, pool bytes within budget")
     return 0
 
 
