@@ -154,7 +154,9 @@ def main():
                 return lax.fori_loop(0, n, body, jnp.float32(0))
 
             ones = jnp.ones((8,), jnp.bfloat16)
+            # tracelint: disable=TL003 -- bench sweep: each loop iteration times a DIFFERENT shape config, one jit each is the point
             t_p = slope(jax.jit(pallas_run), (ones, dy, x, w))
+        # tracelint: disable=TL003 -- bench sweep: each loop iteration times a DIFFERENT shape config, one jit each is the point
         t_x = slope(jax.jit(xla_run), (dyb, x, w))
         roof2 = (2 * p * co + 2 * p * ci) * 2 / HBM_GBS / 1e9
         roof1 = (p * co + 2 * p * ci) * 2 / HBM_GBS / 1e9

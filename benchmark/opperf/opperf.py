@@ -106,6 +106,7 @@ def run_op_benchmark(names=None, warmup=2, runs=10, large=False):
         try:
             arrays, kwargs = _default_inputs(name, rng, large)
             fn = lambda *xs: opref.fn(*xs, **kwargs)
+            # tracelint: disable=TL003 -- opperf times one fresh executable per op by design; fn differs every iteration
             jitted = jax.jit(fn)
             # correctness/compile check
             out = jitted(*arrays)

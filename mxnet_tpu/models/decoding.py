@@ -466,7 +466,8 @@ class _DecodeEngine:
         if temperature == 0.0:
             return None
         # temperature is a python-scalar closure capture, not an operand:
-        # tracelint: disable=TL001 -- scalar cast folds at trace time
+        # the cast folds at trace time (no suppression needed — the jit
+        # seeds here close over the engine, so this is host-side prep)
         lg = logits / max(float(temperature), 1e-6)
         if top_k and top_k < lg.shape[-1]:
             kth = jax.lax.top_k(lg, top_k)[0][:, -1]

@@ -389,7 +389,7 @@ def _infer_node_shapes(sym, params, input_shapes, input_types):
     underspecified; converters then degrade with explicit errors."""
     import jax
 
-    from ..symbol.symbol import _topo, _node_outputs_from_invoke
+    from ..symbol.symbol import _topo, _node_outputs_abstract
 
     try:
         ishp = dict(input_shapes) if input_shapes else {}
@@ -424,8 +424,7 @@ def _infer_node_shapes(sym, params, input_shapes, input_types):
                     memo[id(node)] = [f[node.name]]
                 else:
                     ins = [memo[id(i)][idx] for i, idx in node.inputs]
-                    memo[id(node)] = _node_outputs_from_invoke(
-                        node, ins, as_ndarray=False)
+                    memo[id(node)] = _node_outputs_abstract(node, ins)
                 shapes[id(node)] = [tuple(o.shape)
                                     for o in memo[id(node)]]
             return [memo[id(n)][i] for n, i in sym._heads]

@@ -45,6 +45,9 @@ _BLOCK = 128  # MXU-native q/k tile
 
 def _interpret() -> bool:
     # run the Pallas kernels in interpreter mode (CPU numerics testing)
+    # backend hatch read at trace time; the pod launcher exports MXNET_*
+    # to every rank, so the read is host-uniform by deployment contract:
+    # tracelint: disable=TL007 -- tools/launch.py propagates MXNET_* env to all ranks
     return os.environ.get("MXNET_FLASH_INTERPRET", "") == "1"
 
 
@@ -63,6 +66,9 @@ def _pallas_backend_ok() -> bool:
 
 
 def _use_pallas() -> bool:
+    # backend hatch read at trace time; the pod launcher exports MXNET_*
+    # to every rank, so the read is host-uniform by deployment contract:
+    # tracelint: disable=TL007 -- tools/launch.py propagates MXNET_* env to all ranks
     env = os.environ.get("MXNET_USE_FLASH_ATTENTION", "").lower()
     if env in ("0", "false", "off"):
         return False

@@ -30,6 +30,12 @@ _state = threading.local()
 
 def _root():
     if not hasattr(_state, "key"):
+        # the lazy root draw is per-process on purpose (the reference's
+        # per-worker RNG stream); traced code never consumes this value
+        # — under a trace, next_key() splits from the trace-key STACK,
+        # whose key is an executable operand, so the compiled program is
+        # identical on every host:
+        # tracelint: disable=TL007 -- host-side root-key bookkeeping; traced draws split the trace-key stack operand
         _state.key = jax.random.PRNGKey(onp.random.randint(0, 2**31 - 1))
         _state.seed_val = None
     return _state

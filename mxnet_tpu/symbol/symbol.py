@@ -381,18 +381,34 @@ def load(fname: str) -> Symbol:
 # graph walking
 # --------------------------------------------------------------------- #
 
-def _node_outputs_from_invoke(node, in_arrays, as_ndarray=True):
-    """Run one node through the shared registry."""
-    opref = _registry.get_op(node.op)
+def _node_attrs(node):
     attrs = {k: v for k, v in node.attrs.items()
              if not k.startswith("__")}
     # JSON round-trips tuples to lists; normalize for static hashability
-    attrs = {k: tuple(v) if isinstance(v, list) else v
-             for k, v in attrs.items()}
-    if as_ndarray:
-        res = _registry.invoke(opref, in_arrays, attrs)
-    else:
-        res = opref.fn(*in_arrays, **attrs)
+    return {k: tuple(v) if isinstance(v, list) else v
+            for k, v in attrs.items()}
+
+
+def _node_outputs_from_invoke(node, in_arrays):
+    """Run one node imperatively through the shared registry
+    (autograd-aware, profiled, engine-synced)."""
+    opref = _registry.get_op(node.op)
+    res = _registry.invoke(opref, in_arrays, _node_attrs(node))
+    outs = list(res) if isinstance(res, (list, tuple)) else [res]
+    node.num_outputs = len(outs)
+    return outs
+
+
+def _node_outputs_abstract(node, in_arrays):
+    """Run one node through its op's raw fn — the abstract-eval body.
+
+    Deliberately NOT routed through ``_registry.invoke``: this function
+    is traced (``jax.eval_shape`` in ``_abstract_eval``/``infer_args``
+    and the onnx exporter), and invoke's imperative machinery —
+    profiler clocks, the NaiveEngine ``block_until_ready`` sync, env
+    hatches — must stay unreachable from traced code (TL001/TL007)."""
+    opref = _registry.get_op(node.op)
+    res = opref.fn(*in_arrays, **_node_attrs(node))
     outs = list(res) if isinstance(res, (list, tuple)) else [res]
     node.num_outputs = len(outs)
     return outs
@@ -435,8 +451,7 @@ def _abstract_eval(heads, feed_structs):
                 memo[id(node)] = [feed[node.name]]
             else:
                 ins = [memo[id(i)][idx] for i, idx in node.inputs]
-                memo[id(node)] = _node_outputs_from_invoke(
-                    node, ins, as_ndarray=False)
+                memo[id(node)] = _node_outputs_abstract(node, ins)
         return [memo[id(n)][i] for n, i in heads]
 
     outs = jax.eval_shape(run, *[feed_structs[n] for n in names])
@@ -542,8 +557,7 @@ def infer_args(symbol, dtype="float32", **known_shapes):
         structs = [jax.ShapeDtypeStruct(s, onp.dtype(dtype))
                    for s in in_shapes]
         outs = jax.eval_shape(
-            lambda *xs: _node_outputs_from_invoke(node, list(xs),
-                                                  as_ndarray=False), *structs)
+            lambda *xs: _node_outputs_abstract(node, list(xs)), *structs)
         shapes[id(node)] = [tuple(o.shape) for o in outs]
     missing = [k for k, v in arg_shapes.items() if v is None]
     if missing:

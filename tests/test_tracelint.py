@@ -467,12 +467,25 @@ class TestTL005EnvRegistry:
 
 class TestGate:
     def test_self_run_is_clean(self):
-        """THE CI gate: tracelint over the library must stay clean at
-        merge — a regression in trace discipline fails tier-1."""
-        r = cli(["mxnet_tpu/", "--format=json"])
+        """THE CI gate: tracelint over the library AND the tooling and
+        benchmark layers must stay clean at merge — a regression in
+        trace/sharding discipline fails tier-1.  Runs with --jobs to
+        exercise the parallel path in CI."""
+        r = cli(["mxnet_tpu/", "tools/", "benchmark/", "--jobs", "2",
+                 "--format=json"])
         assert r.returncode == 0, f"tracelint found:\n{r.stdout}\n{r.stderr}"
         payload = json.loads(r.stdout)
         assert payload["findings"] == []
+
+    def test_no_reasonless_suppressions_repo_wide(self):
+        """Every `# tracelint: disable=` in the repo — library, tools,
+        benchmarks, tests, examples — carries a justification (zero
+        TL000s), so nothing is suppressed silently."""
+        r = cli(["mxnet_tpu/", "tools/", "benchmark/", "tests/",
+                 "example/", "bench.py", "--select", "TL000",
+                 "--format=json"])
+        assert r.returncode == 0, r.stdout
+        assert json.loads(r.stdout)["findings"] == []
 
     def test_seeded_float_loss_fails_gate(self, tmp_path):
         """Acceptance check: a synthetic host sync in a fused-step body
@@ -492,6 +505,43 @@ class TestGate:
         payload = json.loads(r.stdout)
         assert any(f["rule"] == "TL001" and "float" in f["message"]
                    for f in payload["findings"])
+
+    def test_seeded_axis_mismatch_fails_gate(self, tmp_path):
+        """Acceptance check: an axis-name literal drifted away from the
+        collectives' axis vocabulary is caught (TL006)."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "parallel", "collectives.py")).read()
+        needle = "        return jax.lax.psum(contrib, axis)"
+        assert needle in src
+        seeded = src.replace(
+            needle, '        return jax.lax.psum(contrib, "dcn")', 1)
+        bad = tmp_path / "collectives_seeded.py"
+        bad.write_text(seeded)
+        r = cli([str(bad), "--format=json"])
+        assert r.returncode == 1
+        hits = [f for f in json.loads(r.stdout)["findings"]
+                if f["rule"] == "TL006"]
+        assert hits and "'dcn'" in hits[0]["message"]
+        assert hits[0]["severity"] == "error"
+
+    def test_seeded_conditional_collective_fails_gate(self, tmp_path):
+        """Acceptance check: a collective gated on jax.process_index()
+        inside the pipeline's traced shard body is caught (TL008)."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "parallel", "pipeline.py")).read()
+        needle = "        my = lax.axis_index(axis)\n"
+        assert needle in src
+        seeded = src.replace(
+            needle, needle +
+            "        if jax.process_index() == 0:\n"
+            "            xs_local = lax.psum(xs_local, axis)\n", 1)
+        bad = tmp_path / "pipeline_seeded.py"
+        bad.write_text(seeded)
+        r = cli([str(bad), "--select", "TL008", "--format=json"])
+        assert r.returncode == 1
+        hits = json.loads(r.stdout)["findings"]
+        assert any("psum" in f["message"] and
+                   "host-dependent" in f["message"] for f in hits)
 
     def test_baseline_lands_rule_warn_only(self, tmp_path):
         """--baseline lets a future rule land without failing the gate:
@@ -589,4 +639,741 @@ class TestReviewRegressions:
         # the analyzer's own sources (which quote the suppression
         # syntax in strings/docstrings) must lint clean
         r = cli(["tools/tracelint/", "--format=json"])
+        assert r.returncode == 0, r.stdout
+
+
+# ------------------------------------------------------------------ #
+# cross-module call-graph resolution (ISSUE 11 engine upgrade)
+# ------------------------------------------------------------------ #
+
+def lint_tree(tmp_path, files, **kw):
+    for name, source in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(source))
+    return run_paths([str(tmp_path)], **kw)
+
+
+class TestCrossModuleEngine:
+    def test_tl001_reaches_host_sync_two_modules_away(self, tmp_path):
+        """THE regression pin for the repo-wide engine: the jit seed in
+        a.py propagates through b.py into c.py's host sync."""
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                import jax
+                from b import step
+
+                fn = jax.jit(step)
+            """,
+            "b.py": """
+                from c import helper
+
+                def step(x):
+                    return helper(x)
+            """,
+            "c.py": """
+                def helper(x):
+                    return x.item()
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith("c.py")
+        assert "helper" in fs[0].message
+
+    def test_from_import_aliasing(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                import jax
+                from b import step as entry
+
+                fn = jax.jit(entry)
+            """,
+            "b.py": """
+                def step(x):
+                    return float(x)
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith("b.py")
+
+    def test_module_dotted_seed(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                import jax
+                import b
+
+                fn = jax.jit(b.step)
+            """,
+            "b.py": """
+                def step(x):
+                    return x.asnumpy()
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith("b.py")
+
+    def test_relative_import_chain_in_package(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/a.py": """
+                import jax
+                from .b import step
+
+                fn = jax.jit(step)
+            """,
+            "pkg/b.py": """
+                from .c import helper
+
+                def step(x):
+                    return helper(x)
+            """,
+            "pkg/c.py": """
+                def helper(x):
+                    return x.tolist()
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith(os.path.join("pkg", "c.py"))
+
+    def test_reexport_through_package_init(self, tmp_path):
+        # `from pkg import helper` where pkg/__init__ re-exports it
+        fs = lint_tree(tmp_path, {
+            "pkg/__init__.py": "from .impl import helper\n",
+            "pkg/impl.py": """
+                def helper(x):
+                    return x.item()
+            """,
+            "main.py": """
+                import jax
+                from pkg import helper
+
+                def step(x):
+                    return helper(x)
+
+                fn = jax.jit(step)
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith("impl.py")
+
+    def test_diamond_imports_flag_once(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "base.py": """
+                def helper(x):
+                    return x.item()
+            """,
+            "left.py": """
+                from base import helper
+
+                def via_left(x):
+                    return helper(x)
+            """,
+            "right.py": """
+                from base import helper
+
+                def via_right(x):
+                    return helper(x)
+            """,
+            "top.py": """
+                import jax
+                from left import via_left
+                from right import via_right
+
+                def step(x):
+                    return via_left(x) + via_right(x)
+
+                fn = jax.jit(step)
+            """})
+        assert rules_of(fs) == ["TL001"]  # one finding, not two
+        assert fs[0].path.endswith("base.py")
+
+    def test_unresolvable_import_falls_back_to_module_local(
+            self, tmp_path):
+        # an import the project can't see contributes no edges; the
+        # module-local walk still catches the local violation
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                import jax
+                from some_external_dep import helper
+
+                def step(x):
+                    y = helper(x)
+                    return float(y)
+
+                fn = jax.jit(step)
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert "float" in fs[0].message
+
+    def test_class_method_resolution_across_modules(self, tmp_path):
+        # ancestor direction: traced Sub.step calls self.helper defined
+        # on a base class imported from another module
+        fs = lint_tree(tmp_path, {
+            "base_mod.py": """
+                class Base:
+                    def helper(self, x):
+                        return x.item()
+            """,
+            "sub_mod.py": """
+                import jax
+                from base_mod import Base
+
+                class Sub(Base):
+                    @jax.jit
+                    def step(self, x):
+                        return self.helper(x)
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith("base_mod.py")
+
+    def test_subclass_override_across_modules(self, tmp_path):
+        # descendant direction: traced Base.run calls self.rule, which
+        # a subclass in ANOTHER module overrides with a host sync (the
+        # optimizer-registry pattern, now cross-file)
+        fs = lint_tree(tmp_path, {
+            "base_mod.py": """
+                import jax
+
+                class Base:
+                    @jax.jit
+                    def run(self, x):
+                        return self.rule(x)
+
+                    def rule(self, x):
+                        return x
+            """,
+            "sub_mod.py": """
+                from base_mod import Base
+
+                class Sub(Base):
+                    def rule(self, x):
+                        return float(x)
+            """})
+        assert rules_of(fs) == ["TL001"]
+        assert fs[0].path.endswith("sub_mod.py")
+
+    def test_partial_wrapped_seed(self, tmp_path):
+        # shard_map(partial(fn, ...)) traces fn
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                import jax
+                from functools import partial
+
+                def body(v, flag):
+                    return v.item()
+
+                fn = jax.shard_map(partial(body, flag=True), mesh=None,
+                                   in_specs=None, out_specs=None)
+            """})
+        assert rules_of(fs) == ["TL001"]
+
+    def test_local_variable_sharing_a_module_name_stays_unresolved(
+            self, tmp_path):
+        # review regression: `bench = Bench(); bench.run(x)` must NOT
+        # resolve into a lint module named bench.py — a plain variable
+        # receiver is not an import binding
+        fs = lint_tree(tmp_path, {
+            "bench.py": """
+                def run(x):
+                    return float(x)
+            """,
+            "a.py": """
+                import jax
+                from somewhere import Bench
+
+                def step(x):
+                    bench = Bench()
+                    return bench.run(x)
+
+                fn = jax.jit(step)
+            """})
+        assert fs == []
+
+    def test_symbol_abstract_eval_does_not_trace_invoke(self):
+        """Regression for the cross-module finding fixed in this PR:
+        symbol's eval_shape bodies route through _node_outputs_abstract
+        (raw opref.fn), NOT _registry.invoke, so the imperative
+        machinery (profiler clocks, NaiveEngine block_until_ready, env
+        hatches via is_naive_engine) is no longer trace-reachable."""
+        r = cli(["mxnet_tpu/symbol/symbol.py", "mxnet_tpu/ops/registry.py",
+                 "mxnet_tpu/base.py", "--select", "TL001,TL007",
+                 "--format=json"])
+        assert r.returncode == 0, r.stdout
+        assert json.loads(r.stdout)["findings"] == []
+
+
+# ------------------------------------------------------------------ #
+# TL006 — axis/mesh discipline
+# ------------------------------------------------------------------ #
+
+class TestTL006AxisDiscipline:
+    def test_unknown_axis_cross_module_is_error(self, tmp_path):
+        # the binding mesh lives in one module, the drifted literal in
+        # another — the exact seam the module-local engine missed
+        fs = lint_tree(tmp_path, {
+            "mesh_mod.py": """
+                import numpy as onp
+                from jax.sharding import Mesh
+
+                MESH = Mesh(onp.arange(4), ("dp",))
+            """,
+            "use_mod.py": """
+                from jax import lax
+
+                def reduce_grads(g):
+                    return lax.psum(g, "pd")
+            """})
+        assert rules_of(fs) == ["TL006"]
+        assert fs[0].severity == "error"
+        assert "'pd'" in fs[0].message and fs[0].path.endswith("use_mod.py")
+
+    def test_bound_axis_is_clean(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "mesh_mod.py": """
+                import numpy as onp
+                from jax.sharding import Mesh
+
+                MESH = Mesh(onp.arange(8).reshape(4, 2), ("dp", "tp"))
+            """,
+            "use_mod.py": """
+                from jax import lax
+                from jax.sharding import PartitionSpec
+
+                def reduce_grads(g):
+                    return lax.psum(g, "tp")
+
+                SPEC = PartitionSpec("dp", None)
+            """})
+        assert fs == []
+
+    def test_param_default_only_axis_literal_is_warn(self, tmp_path):
+        # 'sp' exists only as a default-axis parameter: a literal use is
+        # conditionally bound (depends on the caller's mesh) — warn
+        fs = lint_tree(tmp_path, {
+            "api.py": """
+                from jax import lax
+
+                def ring_pass(x, axis="sp"):
+                    return lax.ppermute(x, axis_name=axis, perm=[])
+            """,
+            "use.py": """
+                from jax import lax
+
+                def fold(x):
+                    return lax.psum(x, "sp")
+            """})
+        assert rules_of(fs) == ["TL006"]
+        assert fs[0].severity == "warn"
+        assert "conditionally bound" in fs[0].message
+
+    def test_make_mesh_dict_binds_axes(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                from jax import lax
+                from mylib import make_mesh
+
+                MESH = make_mesh({"dp": 4, "sp": 2})
+
+                def fold(x):
+                    return lax.psum(x, ("dp", "sp"))
+            """})
+        assert fs == []
+
+    def test_partition_spec_unknown_axis(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                import numpy as onp
+                from jax.sharding import Mesh, PartitionSpec as P
+
+                MESH = Mesh(onp.arange(4), ("dp",))
+                SPEC = P("model", None)
+            """})
+        assert rules_of(fs) == ["TL006"]
+        assert "PartitionSpec" in fs[0].message
+        assert "'model'" in fs[0].message
+
+    def test_gather_axis_kwarg_does_not_shadow_axis_name(self, tmp_path):
+        # review regression: all_gather's axis= kwarg is the INTEGER
+        # array dim; the positional axis NAME must still be checked
+        fs = lint_tree(tmp_path, {
+            "mesh_mod.py": """
+                import numpy as onp
+                from jax.sharding import Mesh
+
+                MESH = Mesh(onp.arange(4), ("dp",))
+            """,
+            "use_mod.py": """
+                from jax import lax
+
+                def gather(x):
+                    return lax.all_gather(x, "dcn", axis=0, tiled=True)
+            """})
+        assert rules_of(fs) == ["TL006"]
+        assert "'dcn'" in fs[0].message
+
+    def test_suppressed(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                from jax import lax
+
+                def fold(x):
+                    # tracelint: disable=TL006 -- fixture: axis bound by caller's test mesh
+                    return lax.psum(x, "zz")
+            """})
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL007 — cross-host trace divergence
+# ------------------------------------------------------------------ #
+
+class TestTL007HostDivergence:
+    def test_process_index_feeding_return(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def step(x):
+                r = jax.process_index()
+                return x + r
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL007"]
+        assert "process_index" in fs[0].message
+
+    def test_environ_branching_the_trace(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import os
+
+            def step(x):
+                if os.environ.get("MXNET_DEBUG_SCALE"):
+                    return x * 2
+                return x
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL007"]
+        assert "environ" in fs[0].message
+
+    def test_host_rng_feeding_jax_call(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as onp
+
+            def step(x):
+                key = jax.random.PRNGKey(onp.random.randint(0, 100))
+                return x + jax.random.uniform(key, x.shape)
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL007"]
+        assert "host RNG" in fs[0].message
+
+    def test_from_imported_host_reads_are_caught(self, tmp_path):
+        # review regression: `from os import getenv` / `from time
+        # import perf_counter` classify the same as the dotted forms
+        fs = lint(tmp_path, """
+            import jax
+            from os import getenv
+
+            def step(x):
+                if getenv("MXNET_DEBUG_SCALE"):
+                    return x * 2
+                return x
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TL007"]
+
+    def test_project_module_named_random_is_not_stdlib(self, tmp_path):
+        # `from pkg import random` binds a PROJECT module; its draws are
+        # jax-keyed, not host RNG — must not classify as stdlib random
+        fs = lint_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/random.py": """
+                def uniform(key, shape):
+                    return shape
+            """,
+            "pkg/use.py": """
+                import jax
+                from . import random
+
+                def step(x):
+                    return x + random.uniform(None, x.shape)
+
+                fn = jax.jit(step)
+            """})
+        assert [f for f in fs if f.rule == "TL007"] == []
+
+    def test_host_side_timer_is_not_divergence(self, tmp_path):
+        # a profiler clock whose value never feeds the trace (the
+        # registry.invoke pattern): no finding
+        fs = lint(tmp_path, """
+            import jax
+            import time
+
+            def log_ms(dt):
+                pass
+
+            def step(x):
+                t0 = time.perf_counter()
+                y = x + 1
+                if t0 is not None:
+                    log_ms(time.perf_counter() - t0)
+                return y
+
+            fn = jax.jit(step)
+        """)
+        assert fs == []
+
+    def test_process_index_outside_trace_is_fine(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def rank():
+                return jax.process_index()
+        """)
+        assert fs == []
+
+    def test_donate_argnums_from_set_iteration(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def f(a, b):
+                return a + b
+
+            fn = jax.jit(f, donate_argnums=tuple({0, 1}))
+        """)
+        assert rules_of(fs) == ["TL007"]
+        assert "donate_argnums" in fs[0].message
+
+    def test_sorted_set_is_stable(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def f(a, b):
+                return a + b
+
+            fn = jax.jit(f, donate_argnums=tuple(sorted({0, 1})))
+        """)
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import os
+
+            def step(x):
+                # tracelint: disable=TL007 -- fixture: launcher propagates env
+                if os.environ.get("MXNET_DEBUG_SCALE"):
+                    return x * 2
+                return x
+
+            fn = jax.jit(step)
+        """)
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL008 — conditional collectives
+# ------------------------------------------------------------------ #
+
+class TestTL008ConditionalCollective:
+    def test_collective_under_data_dependent_branch(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def body(v):
+                s = jnp.sum(v)
+                if s > 0:
+                    v = lax.psum(v, "dp")
+                return v
+
+            fn = jax.shard_map(body, mesh=None, in_specs=None,
+                               out_specs=None)
+        """, select=["TL008"])
+        assert rules_of(fs) == ["TL008"]
+        assert "data-dependent" in fs[0].message
+        assert "psum" in fs[0].message
+
+    def test_collective_under_host_dependent_branch(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def body(v):
+                if jax.process_index() == 0:
+                    v = lax.psum(v, "dp")
+                return v
+
+            fn = jax.shard_map(body, mesh=None, in_specs=None,
+                               out_specs=None)
+        """, select=["TL008"])
+        assert rules_of(fs) == ["TL008"]
+        assert "host-dependent" in fs[0].message
+
+    def test_collective_under_static_config_branch_is_fine(
+            self, tmp_path):
+        # a trace-time hyperparameter branch is uniform across shards
+        fs = lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def make(reduce_grads):
+                def body(v):
+                    if reduce_grads:
+                        v = lax.psum(v, "dp")
+                    return v
+                return jax.shard_map(body, mesh=None, in_specs=None,
+                                     out_specs=None)
+        """, select=["TL008"])
+        assert fs == []
+
+    def test_collective_in_loop_is_fine(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def body(v):
+                for i in range(4):
+                    v = lax.ppermute(v, "sp", [(0, 1), (1, 0)])
+                return v
+
+            fn = jax.shard_map(body, mesh=None, in_specs=None,
+                               out_specs=None)
+        """, select=["TL008"])
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def body(v):
+                s = jnp.sum(v)
+                if s > 0:
+                    # tracelint: disable=TL008 -- fixture justification
+                    v = lax.psum(v, "dp")
+                return v
+
+            fn = jax.shard_map(body, mesh=None, in_specs=None,
+                               out_specs=None)
+        """, select=["TL008"])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL009 — accountant discipline
+# ------------------------------------------------------------------ #
+
+class TestTL009AccountantDiscipline:
+    def test_set_without_drop(self, tmp_path):
+        fs = lint(tmp_path, """
+            from mxnet_tpu.telemetry.memory import ACCOUNTANT
+
+            def hold(key, tree):
+                ACCOUNTANT.set("serve.scratch", key, tree)
+        """, select=["TL009"])
+        assert rules_of(fs) == ["TL009"]
+        assert "serve.scratch" in fs[0].message
+
+    def test_drop_in_another_module_pairs(self, tmp_path):
+        # the release path may live across the repo (Trainer sets,
+        # FusedStep drops) — project-wide pairing, no finding
+        fs = lint_tree(tmp_path, {
+            "a.py": """
+                from mxnet_tpu.telemetry.memory import ACCOUNTANT
+
+                def hold(key, tree):
+                    ACCOUNTANT.set("serve.scratch", key, tree)
+            """,
+            "b.py": """
+                from mxnet_tpu.telemetry.memory import ACCOUNTANT
+
+                def release(key):
+                    ACCOUNTANT.drop_deferred("serve.scratch", key)
+            """}, select=["TL009"])
+        assert fs == []
+
+    def test_dynamic_subsystem_is_skipped(self, tmp_path):
+        fs = lint(tmp_path, """
+            from mxnet_tpu.telemetry.memory import ACCOUNTANT
+
+            def hold(subsystem, key, tree):
+                ACCOUNTANT.set(subsystem, key, tree)
+        """, select=["TL009"])
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            from mxnet_tpu.telemetry.memory import ACCOUNTANT
+
+            def hold(key, tree):
+                # tracelint: disable=TL009 -- fixture: process-lifetime entry
+                ACCOUNTANT.set("proc.forever", key, tree)
+        """, select=["TL009"])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# TL010 — stale suppressions (opt-in)
+# ------------------------------------------------------------------ #
+
+class TestTL010StaleSuppressions:
+    SRC = """
+        import jax
+
+        def step(w, g):
+            lr = float(g)  # tracelint: disable=TL001 -- epoch sync fixture
+            return w - lr * g
+
+        def host_only(x):
+            return x + 1  # tracelint: disable=TL002 -- stale: nothing fires here
+
+        fn = jax.jit(step)
+    """
+
+    def test_stale_suppression_reported_on_select(self, tmp_path):
+        fs = lint(tmp_path, self.SRC, select=["TL010"])
+        assert rules_of(fs) == ["TL010"]
+        assert "TL002" in fs[0].message
+        assert fs[0].severity == "warn"
+
+    def test_live_suppression_not_reported(self, tmp_path):
+        fs = lint(tmp_path, self.SRC, select=["TL010"])
+        assert all("TL001" not in f.message for f in fs)
+
+    def test_not_reported_by_default(self, tmp_path):
+        fs = lint(tmp_path, self.SRC)
+        assert fs == []
+
+    def test_repo_has_no_stale_suppressions(self):
+        r = cli(["mxnet_tpu/", "tools/", "benchmark/", "--select",
+                 "TL010", "--format=json"])
+        assert json.loads(r.stdout)["findings"] == []
+
+
+# ------------------------------------------------------------------ #
+# --jobs — parallel lint determinism
+# ------------------------------------------------------------------ #
+
+class TestJobs:
+    def test_parallel_output_identical_to_serial(self, tmp_path):
+        for i in range(3):
+            (tmp_path / f"mod{i}.py").write_text(textwrap.dedent(f"""
+                import jax
+
+                def step{i}(w, g):
+                    lr = float(g)
+                    return w - lr * g
+
+                fn{i} = jax.jit(step{i})
+            """))
+        serial = cli([str(tmp_path), "--format=json"])
+        parallel = cli([str(tmp_path), "--format=json", "--jobs", "3"])
+        assert serial.returncode == parallel.returncode == 1
+        assert serial.stdout == parallel.stdout
+
+    def test_jobs_accepted_on_clean_tree(self, tmp_path):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        r = cli([str(tmp_path), "--jobs", "2"])
         assert r.returncode == 0, r.stdout

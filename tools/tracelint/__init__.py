@@ -13,11 +13,20 @@ ring) is fast because of invariants the code cannot express in types:
   locks are always taken in one order;
 * every ``MXNET_*`` escape hatch is documented in docs/ENV_VARS.md.
 
+The multi-host pod runtime adds a harsher class — collective axes no
+mesh binds, traces that diverge per host, collectives under
+data-dependent branches, device-ledger entries nobody releases — each
+a 64-chip hang or a silent leak instead of a stack trace.
+
 tracelint checks those invariants with ``ast`` only (no third-party
 dependencies) so CI fails the moment a change reintroduces the
-74.8 ms/step world.  Run it as::
+74.8 ms/step world.  Traced-region discovery walks a REPO-WIDE call
+graph (imports, re-exports, cross-module class families — see
+``project.py``), falling back to the module-local walk where an import
+cannot be resolved.  Run it as::
 
-    python -m tools.tracelint mxnet_tpu/ [--format=json] [--baseline f]
+    python -m tools.tracelint mxnet_tpu/ tools/ benchmark/ \
+        [--format=json] [--jobs N] [--baseline f]
 
 Rules (see docs/TRACELINT.md for the full catalog):
 
@@ -28,6 +37,12 @@ TL002    donated buffer read after the dispatch that donates it
 TL003    retrace hazard (unhashable / identity cache key, jit-in-loop)
 TL004    lock-order inversion or unlocked shared-state mutation
 TL005    ``MXNET_*`` env read and docs/ENV_VARS.md out of sync
+TL006    collective/PartitionSpec axis not bound by any mesh
+TL007    cross-host trace divergence (process id / env / time / RNG
+         feeding the trace; set/id ordering feeding shardings)
+TL008    collective under a data- or host-dependent branch
+TL009    ``ACCOUNTANT.set`` without a reachable drop/release path
+TL010    stale suppression (opt-in via ``--select TL010``)
 =======  ==========================================================
 
 Suppress a deliberate violation with a justified comment on the same

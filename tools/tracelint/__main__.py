@@ -30,6 +30,9 @@ def main(argv=None):
     ap.add_argument("--env-docs", default=None, metavar="FILE",
                     help="override the docs/ENV_VARS.md location for "
                          "TL005 (auto-discovered by default)")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="distribute per-module rule passes over N "
+                         "forked workers (identical output to serial)")
     args = ap.parse_args(argv)
 
     select = None
@@ -43,7 +46,7 @@ def main(argv=None):
 
     try:
         findings = run_paths(args.paths, select=select,
-                             env_docs=args.env_docs)
+                             env_docs=args.env_docs, jobs=args.jobs)
     except FileNotFoundError as e:
         print(f"tracelint: no such path: {e}", file=sys.stderr)
         return 2
@@ -56,6 +59,7 @@ def main(argv=None):
     if args.baseline:
         findings = apply_baseline(findings, load_baseline(args.baseline))
 
+    errors = [f for f in findings if f.severity != "warn"]
     if args.format == "json":
         counts: dict = {}
         for f in findings:
@@ -67,9 +71,11 @@ def main(argv=None):
             print(f.render())
             if f.snippet:
                 print(f"    {f.snippet}")
-        n = len(findings)
-        print(f"tracelint: {n} finding(s)" if n else "tracelint: clean")
-    return 1 if findings else 0
+        n, w = len(errors), len(findings) - len(errors)
+        tail = f", {w} warning(s)" if w else ""
+        print(f"tracelint: {n} finding(s){tail}" if findings
+              else "tracelint: clean")
+    return 1 if errors else 0
 
 
 if __name__ == "__main__":

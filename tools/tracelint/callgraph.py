@@ -1,20 +1,19 @@
-"""Module-local call-graph + traced-region discovery.
+"""Per-module scope index + traced-seed recognition.
 
 "Traced" code is anything jax re-executes abstractly: bodies passed to
 ``jax.jit`` / ``value_and_grad`` / ``vjp`` / ``pallas_call`` / control-flow
 combinators, functions decorated with jit, and functions that open a
 ``trace_scope`` (the repo's CachedOp trace discipline — their body runs
-under an active jax trace by construction).  From those seeds we walk the
-*module-local* call graph: bare-name calls resolve lexically through
-nested scopes; ``self.method`` calls resolve within the enclosing class,
-its module-local ancestors and descendants (the optimizer registry
-pattern: ``Optimizer._apply_one`` calls ``self._update_rule``, overridden
-by every registered subclass).
+under an active jax trace by construction).  Bare-name calls resolve
+lexically through nested scopes; ``self.method`` calls resolve within
+the enclosing class, its module-local ancestors and descendants (the
+optimizer registry pattern: ``Optimizer._apply_one`` calls
+``self._update_rule``, overridden by every registered subclass).
 
-Cross-module calls are deliberately not followed — each hot-path module
-carries its own seeds (the jit/trace_scope call sites live next to the
-functions they trace), and a repo-wide points-to analysis would buy
-little precision for a lot of fragility.
+Seed propagation across modules — import resolution, re-export chasing,
+project-wide class families — lives in :mod:`.project`; this module
+stays the single-file building block it composes (and the fallback when
+an import cannot be resolved).
 """
 from __future__ import annotations
 
@@ -229,54 +228,3 @@ def _opens_trace_scope(fn_node):
     return False
 
 
-class CallGraph:
-    """Traced-function discovery for one module."""
-
-    def __init__(self, module):
-        self.module = module
-        self.index = Index(module)
-        self.traced = {}  # id(fn node) -> (FuncInfo, reason)
-        self._discover()
-
-    def _mark(self, info, reason, work):
-        if info is None or id(info.node) in self.traced:
-            return
-        self.traced[id(info.node)] = (info, reason)
-        work.append(info)
-
-    def _discover(self):
-        idx = self.index
-        work = []
-        # seeds: function-valued args of tracing entry points
-        for call, scopes in idx.calls:
-            if not is_tracing_entry(call, self.module):
-                continue
-            entry = dotted(call.func)
-            for arg in call.args:
-                if isinstance(arg, ast.Name):
-                    self._mark(idx.resolve_name(arg.id, scopes),
-                               f"passed to {entry} at line {call.lineno}",
-                               work)
-        for info in idx.functions:
-            # seeds: @jit decorators
-            for dec in info.node.decorator_list:
-                if _is_jit_decorator(dec, self.module):
-                    self._mark(info, "decorated with jit", work)
-            # seeds: opens a trace_scope (CachedOp trace discipline)
-            if _opens_trace_scope(info.node):
-                self._mark(info, "opens trace_scope", work)
-        # propagate through module-local calls
-        while work:
-            info = work.pop()
-            reason = self.traced[id(info.node)][1]
-            scopes = info.scopes + (info.node,)
-            for n in iter_own(info.node):
-                if isinstance(n, ast.Call):
-                    for callee in self.index.resolve_call(n, scopes):
-                        self._mark(
-                            callee,
-                            f"called from traced `{info.qualname}` "
-                            f"({reason})", work)
-
-    def traced_funcs(self):
-        return list(self.traced.values())

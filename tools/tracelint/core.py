@@ -21,6 +21,12 @@ RULES = {
     "TL003": "retrace hazard in executable cache key / jit construction",
     "TL004": "lock-order inversion or unlocked shared-state mutation",
     "TL005": "MXNET_* env var out of sync with docs/ENV_VARS.md",
+    "TL006": "collective/PartitionSpec axis not bound by any mesh",
+    "TL007": "cross-host trace divergence (process id/env/time/RNG/"
+             "set-order in traced or sharding-feeding code)",
+    "TL008": "collective under a data- or host-dependent branch",
+    "TL009": "ACCOUNTANT.set without a reachable drop/release path",
+    "TL010": "stale suppression: disabled rule no longer fires here",
 }
 
 # `# tracelint: disable=TL001[,TL004] -- justification`
@@ -37,6 +43,9 @@ class Finding:
     col: int
     message: str
     snippet: str = ""
+    # "error" fails the gate; "warn" is advisory (conditionally-bound
+    # axes, stale suppressions) and leaves the exit code at 0
+    severity: str = "error"
 
     def fingerprint(self) -> str:
         """Line-number-free identity used by ``--baseline`` so findings
@@ -47,7 +56,8 @@ class Finding:
         return dataclasses.asdict(self)
 
     def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+        sev = "warning: " if self.severity == "warn" else ""
+        return (f"{self.path}:{self.line}:{self.col}: {sev}{self.rule} "
                 f"{self.message}")
 
 
@@ -91,6 +101,8 @@ class Module:
         docstring example) is not a suppression.
         """
         out: dict = {}
+        if "tracelint" not in self.source:
+            return out  # fast path: no marker, no tokenize pass
         try:
             tokens = list(tokenize.generate_tokens(
                 io.StringIO(self.source).readline))
@@ -193,29 +205,110 @@ def _validate_suppressions(module: Module):
     return out
 
 
-def run_paths(paths, select=None, env_docs=None):
+def _module_findings(project, shared, module):
+    """Every per-module rule pass over one module (the unit of work
+    ``--jobs`` distributes)."""
+    from . import rules_sharding, rules_threading, rules_trace
+
+    out = list(_validate_suppressions(module))
+    out.extend(rules_trace.check_module(project, module))
+    out.extend(rules_threading.check_module(module))
+    out.extend(rules_sharding.check_module(project, shared, module))
+    return out
+
+
+# worker context for --jobs: set in the parent immediately before the
+# fork so children inherit the fully-built project (parse + call graph
+# happen ONCE, in the parent; only rule execution is distributed)
+_WORKER_CTX = None
+
+
+def _lint_one(path):
+    project, shared = _WORKER_CTX
+    return _module_findings(project, shared, project.by_path[path])
+
+
+def _run_modules(project, shared, modules, jobs):
+    """Per-module findings, serial or via a fork pool.  The parallel
+    path returns byte-identical results to the serial one: workers see
+    the same pre-built project, ``map`` preserves submission order, and
+    the caller sorts regardless."""
+    if jobs and jobs > 1 and len(modules) > 1:
+        import multiprocessing as mp
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:  # pragma: no cover - non-posix fallback
+            ctx = None
+        if ctx is not None:
+            global _WORKER_CTX
+            _WORKER_CTX = (project, shared)
+            try:
+                with ctx.Pool(min(jobs, len(modules))) as pool:
+                    chunks = pool.map(
+                        _lint_one, [m.path for m in modules],
+                        chunksize=max(1, len(modules) // (jobs * 4)))
+            finally:
+                _WORKER_CTX = None
+            return [f for chunk in chunks for f in chunk]
+    out = []
+    for m in modules:
+        out.extend(_module_findings(project, shared, m))
+    return out
+
+
+def _unused_suppressions(modules, findings):
+    """TL010: a justified ``disable=TLxxx`` whose rule produced no
+    finding on its line is stale — it documents a hazard that no longer
+    exists and would silently mask the next real one.  Warn-level and
+    ``--select TL010`` opt-in (run_paths drops it otherwise)."""
+    hits = {(f.path, f.line, f.rule) for f in findings}
+    out = []
+    for m in modules:
+        for target, (rules, reason, line) in sorted(
+                m.suppressions.items()):
+            if not reason:
+                continue  # reasonless: already a TL000
+            for r in sorted(rules):
+                if r in RULES and r != "TL010" and \
+                        (m.path, target, r) not in hits:
+                    out.append(Finding(
+                        "TL010", m.path, line, 0,
+                        f"suppression for {r} matches no {r} finding on "
+                        "its line — stale; delete it so a future "
+                        "regression here is not silently masked",
+                        snippet=m.snippet(line), severity="warn"))
+    return out
+
+
+def run_paths(paths, select=None, env_docs=None, jobs=None):
     """Run every rule over ``paths``; returns the surviving findings.
 
-    ``select`` restricts to an iterable of rule ids.  Suppressions with a
-    justification remove matching findings; reasonless suppressions do
-    not (and raise TL000 themselves).
+    ``select`` restricts to an iterable of rule ids (and is the opt-in
+    for TL010).  ``jobs`` > 1 distributes per-module rule execution
+    over a fork pool — output is identical to the serial run.
+    Suppressions with a justification remove matching findings;
+    reasonless suppressions do not (and raise TL000 themselves).
     """
-    from . import rules_env, rules_threading, rules_trace
+    from . import rules_env
+    from .project import Project
+    from .rules_sharding import build_state
 
     files = collect_py_files(paths)
     modules, findings = load_modules(files)
     mod_by_path = {m.path: m for m in modules}
 
-    for m in modules:
-        findings.extend(_validate_suppressions(m))
-        findings.extend(rules_trace.check_module(m))
-        findings.extend(rules_threading.check_module(m))
+    project = Project(modules)
+    shared = build_state(project)
+    findings.extend(_run_modules(project, shared, modules, jobs))
     docs = find_repo_docs(paths, env_docs)
     findings.extend(rules_env.check(modules, docs))
+    findings.extend(_unused_suppressions(modules, findings))
 
     if select:
         keep = set(select)
         findings = [f for f in findings if f.rule in keep]
+    else:
+        findings = [f for f in findings if f.rule != "TL010"]
 
     out = []
     for f in findings:

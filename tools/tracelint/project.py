@@ -1,0 +1,390 @@
+"""Repo-wide call graph: import resolution + project traced discovery.
+
+PR 4's tracelint walked a *module-local* call graph, which made it blind
+to exactly the seams where sharding discipline breaks — the fused step
+(`gluon/fused_step.py`) jits closures built in `autograd`, the serve
+engine traces samplers defined in `models/decoding.py`, and the
+collectives (`parallel/collectives.py`) wrap helpers from `mesh.py`.
+This module upgrades discovery to the whole lint target:
+
+* every scanned file gets a dotted module name (walk up while
+  ``__init__.py`` exists, so ``mxnet_tpu/parallel/mesh.py`` is
+  ``mxnet_tpu.parallel.mesh`` and a bare fixture ``a.py`` is ``a``);
+* per-module import tables resolve ``import a.b as c``,
+  ``from x import y as z`` (function or submodule, any alias), and
+  relative imports at any level;
+* calls resolve across modules: bare names through ``from x import y``
+  (chasing ``__init__`` re-exports), dotted names through module
+  aliases (longest-prefix match), ``self.method`` through the class's
+  *project-wide* family (bases imported from other modules and their
+  cross-module subclasses);
+* traced seeds (jit call sites, decorators, trace_scope — plus
+  function-valued args inside ``functools.partial``) propagate through
+  those cross-module edges.
+
+Unresolvable imports (jax, numpy, stdlib, files outside the lint
+target) simply contribute no edges, so per-module behavior degrades to
+exactly the old module-local walk — linting a single file still works.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .callgraph import (Index, dotted, is_tracing_entry, iter_own,
+                        _is_jit_decorator, _opens_trace_scope)
+
+__all__ = ["Project", "module_name"]
+
+_MAX_REEXPORT_HOPS = 8
+
+
+def module_name(path):
+    """Dotted module name for ``path``, anchored at the outermost
+    directory that still has an ``__init__.py``."""
+    path = os.path.abspath(path)
+    base = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if base == "__init__" else [base]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        nxt = os.path.dirname(d)
+        if nxt == d:
+            break
+        d = nxt
+    return ".".join(reversed(parts)) if parts else None
+
+
+class Imports:
+    """One module's import bindings.
+
+    ``mod_aliases``  local name -> dotted module name (``import a.b as c``,
+                     ``from pkg import submod``)
+    ``from_imports`` local name -> (dotted module, remote name) for
+                     ``from x import y [as z]`` — recorded even when ``x``
+                     is outside the project (rules use the target names,
+                     e.g. ``from jax.lax import psum``)
+    ``stars``        modules star-imported (``from x import *``)
+    """
+
+    def __init__(self, module, my_name, is_pkg):
+        self.mod_aliases = {}
+        self.from_imports = {}
+        self.stars = []
+        # the package context for relative imports: a package's
+        # __init__ is its own base; a plain module's base is its parent
+        pkg_parts = (my_name.split(".") if my_name else [])
+        if not is_pkg and pkg_parts:
+            pkg_parts = pkg_parts[:-1]
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.mod_aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.mod_aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base_parts = list(pkg_parts)
+                if node.level:
+                    drop = node.level - 1
+                    if drop > len(base_parts):
+                        continue  # relative import past the root
+                    base_parts = base_parts[:len(base_parts) - drop] \
+                        if drop else base_parts
+                else:
+                    base_parts = []
+                if node.module:
+                    base_parts = base_parts + node.module.split(".")
+                base = ".".join(base_parts)
+                if not base:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        self.stars.append(base)
+                        continue
+                    local = a.asname or a.name
+                    self.from_imports[local] = (base, a.name)
+
+
+class Project:
+    """All scanned modules + the cross-module resolution every rule
+    shares.  Build once per run; per-module rule passes read it."""
+
+    def __init__(self, modules):
+        self.modules = modules
+        self.by_path = {m.path: m for m in modules}
+        self.by_name = {}
+        self.names = {}          # id(module) -> dotted name
+        self.indexes = {}        # id(module) -> Index
+        self.imports = {}        # id(module) -> Imports
+        for m in modules:
+            name = module_name(m.path)
+            self.names[id(m)] = name
+            if name:
+                self.by_name[name] = m
+            self.indexes[id(m)] = Index(m)
+        for m in modules:
+            is_pkg = os.path.basename(m.path) == "__init__.py"
+            self.imports[id(m)] = Imports(m, self.names[id(m)], is_pkg)
+        self._build_class_registry()
+        self.traced = {}  # id(fn node) -> (module, FuncInfo, reason)
+        self._discover_traced()
+
+    def index(self, module):
+        return self.indexes[id(module)]
+
+    # -- module-scope function lookup (with re-export chasing) ---------- #
+    def _module_func(self, mod, name, hops=0):
+        """FuncInfo for ``name`` at the top level of module ``mod``,
+        following ``from x import name`` re-exports (the package
+        ``__init__`` pattern) up to a small hop budget."""
+        if mod is None or hops > _MAX_REEXPORT_HOPS:
+            return None
+        idx = self.indexes[id(mod)]
+        info = idx.scope_funcs.get(id(mod.tree), {}).get(name)
+        if info is not None:
+            return mod, info
+        imp = self.imports[id(mod)]
+        if name in imp.from_imports:
+            tgt, remote = imp.from_imports[name]
+            return self._module_func(self.by_name.get(tgt), remote,
+                                     hops + 1)
+        if name in imp.mod_aliases:
+            return None  # a submodule, not a function
+        for star in imp.stars:
+            hit = self._module_func(self.by_name.get(star), name,
+                                    hops + 1)
+            if hit is not None:
+                return hit
+        return None
+
+    def _resolve_module_prefix(self, module, parts):
+        """Longest prefix of ``parts`` naming a project module (through
+        this module's aliases), plus the remainder.
+
+        The head must be an IMPORT BINDING of this module — a dotted
+        name whose head is a plain local variable (``bench.run(x)``
+        where ``bench = Bench()``) stays unresolved even when a lint
+        module happens to share the name; resolving it would fabricate
+        traced edges into unrelated files."""
+        imp = self.imports[id(module)]
+        head = parts[0]
+        expansions = []
+        if head in imp.mod_aliases:
+            expansions.append(imp.mod_aliases[head].split(".")
+                              + parts[1:])
+        if head in imp.from_imports:
+            tgt, remote = imp.from_imports[head]
+            expansions.append(tgt.split(".") + [remote] + parts[1:])
+        for full in expansions:
+            for cut in range(len(full) - 1, 0, -1):
+                mod = self.by_name.get(".".join(full[:cut]))
+                if mod is not None:
+                    return mod, full[cut:]
+        return None, parts
+
+    # -- cross-module class families ------------------------------------ #
+    def _build_class_registry(self):
+        self._class_key = {}    # (modname, clsname) -> (module, ClassDef)
+        for m in self.modules:
+            name = self.names[id(m)] or m.path
+            for cname, cnode in self.indexes[id(m)].classes.items():
+                self._class_key[(name, cname)] = (m, cnode)
+        self._bases = {}        # class key -> [base class keys]
+        self._subs = {}         # class key -> [subclass keys]
+        for m in self.modules:
+            name = self.names[id(m)] or m.path
+            for cname, cnode in self.indexes[id(m)].classes.items():
+                key = (name, cname)
+                for base in cnode.bases:
+                    bkey = self._resolve_class_ref(m, base)
+                    if bkey is not None:
+                        self._bases.setdefault(key, []).append(bkey)
+                        self._subs.setdefault(bkey, []).append(key)
+
+    def _resolve_class_ref(self, module, expr):
+        """(modname, clsname) key for a base-class expression, through
+        this module's imports; None when outside the project."""
+        d = dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        myname = self.names[id(module)] or module.path
+        if len(parts) == 1:
+            if parts[0] in self.indexes[id(module)].classes:
+                return (myname, parts[0])
+            imp = self.imports[id(module)]
+            if parts[0] in imp.from_imports:
+                tgt, remote = imp.from_imports[parts[0]]
+                tm = self.by_name.get(tgt)
+                if tm is not None and \
+                        remote in self.indexes[id(tm)].classes:
+                    return (self.names[id(tm)], remote)
+            return None
+        mod, rest = self._resolve_module_prefix(module, parts)
+        if mod is not None and len(rest) == 1 and \
+                rest[0] in self.indexes[id(mod)].classes:
+            return (self.names[id(mod)] or mod.path, rest[0])
+        return None
+
+    def _class_family(self, module, cls):
+        """The class plus its ancestors and descendants, project-wide."""
+        myname = self.names[id(module)] or module.path
+        start = (myname, cls.name)
+        family, work = {start}, [start]
+        while work:
+            key = work.pop()
+            for nxt in self._bases.get(key, []) + self._subs.get(key, []):
+                if nxt not in family:
+                    family.add(nxt)
+                    work.append(nxt)
+        return [self._class_key[k] for k in sorted(family)
+                if k in self._class_key]
+
+    def resolve_self_method(self, module, attr, scopes):
+        """``self.attr(...)`` → matching method defs across the class's
+        project-wide family."""
+        cls = None
+        for scope in reversed(scopes):
+            if isinstance(scope, ast.ClassDef):
+                cls = scope
+                break
+        if cls is None:
+            return []
+        out = []
+        for fam_mod, fam_cls in self._class_family(module, cls):
+            info = self.indexes[id(fam_mod)].class_methods.get(
+                id(fam_cls), {}).get(attr)
+            if info is not None:
+                out.append((fam_mod, info))
+        return out
+
+    # -- call resolution ------------------------------------------------- #
+    def resolve_call(self, module, call, scopes):
+        """(module, FuncInfo) pairs a call statically resolves to.
+        Module-local resolution first; cross-module through the import
+        tables when that comes up empty (the fallback contract)."""
+        idx = self.indexes[id(module)]
+        func = call.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self":
+            # the project-wide class family is a superset of the
+            # module-local one (bases/subclasses in other modules), so
+            # self.method resolves through it directly
+            hits = self.resolve_self_method(module, func.attr, scopes)
+            if hits:
+                return hits
+        local = idx.resolve_call(call, scopes)
+        if local:
+            return [(module, info) for info in local]
+        if isinstance(func, ast.Name):
+            imp = self.imports[id(module)]
+            if func.id in imp.from_imports:
+                tgt, remote = imp.from_imports[func.id]
+                hit = self._module_func(self.by_name.get(tgt), remote)
+                if hit is not None:
+                    return [hit]
+            for star in imp.stars:
+                hit = self._module_func(self.by_name.get(star), func.id)
+                if hit is not None:
+                    return [hit]
+            return []
+        if isinstance(func, ast.Attribute):
+            d = dotted(func)
+            if d is None:
+                return []
+            mod, rest = self._resolve_module_prefix(module, d.split("."))
+            if mod is None:
+                return []
+            if len(rest) == 1:
+                hit = self._module_func(mod, rest[0])
+                return [hit] if hit is not None else []
+            if len(rest) == 2:  # mod.Class.method
+                cnode = self.indexes[id(mod)].classes.get(rest[0])
+                if cnode is not None:
+                    info = self.indexes[id(mod)].class_methods.get(
+                        id(cnode), {}).get(rest[1])
+                    if info is not None:
+                        return [(mod, info)]
+        return []
+
+    # -- traced discovery ------------------------------------------------ #
+    def _seed_targets(self, module, call, scopes):
+        """Function-valued args of one tracing entry point — bare names,
+        dotted module paths, and the same through functools.partial."""
+        out = []
+        args = list(call.args)
+        for a in call.args:
+            if isinstance(a, ast.Call):
+                d = dotted(a.func)
+                if d and d.split(".")[-1] == "partial" and a.args:
+                    args.extend(a.args)
+        for arg in args:
+            if isinstance(arg, ast.Name):
+                idx = self.indexes[id(module)]
+                info = idx.resolve_name(arg.id, scopes)
+                if info is not None:
+                    out.append((module, info))
+                    continue
+                imp = self.imports[id(module)]
+                if arg.id in imp.from_imports:
+                    tgt, remote = imp.from_imports[arg.id]
+                    hit = self._module_func(self.by_name.get(tgt), remote)
+                    if hit is not None:
+                        out.append(hit)
+            elif isinstance(arg, ast.Attribute):
+                d = dotted(arg)
+                if d is None:
+                    continue
+                mod, rest = self._resolve_module_prefix(
+                    module, d.split("."))
+                if mod is not None and len(rest) == 1:
+                    hit = self._module_func(mod, rest[0])
+                    if hit is not None:
+                        out.append(hit)
+        return out
+
+    def _mark(self, module, info, reason, work):
+        if info is None or id(info.node) in self.traced:
+            return
+        self.traced[id(info.node)] = (module, info, reason)
+        work.append((module, info))
+
+    def _discover_traced(self):
+        work = []
+        for m in self.modules:
+            idx = self.indexes[id(m)]
+            for call, scopes in idx.calls:
+                if not is_tracing_entry(call, m):
+                    continue
+                entry = dotted(call.func)
+                for tmod, tinfo in self._seed_targets(m, call, scopes):
+                    self._mark(tmod, tinfo,
+                               f"passed to {entry} at "
+                               f"{os.path.basename(m.path)}:{call.lineno}",
+                               work)
+            for info in idx.functions:
+                for dec in info.node.decorator_list:
+                    if _is_jit_decorator(dec, m):
+                        self._mark(m, info, "decorated with jit", work)
+                if _opens_trace_scope(info.node):
+                    self._mark(m, info, "opens trace_scope", work)
+        while work:
+            mod, info = work.pop()
+            reason = self.traced[id(info.node)][2]
+            scopes = info.scopes + (info.node,)
+            for n in iter_own(info.node):
+                if isinstance(n, ast.Call):
+                    for cmod, callee in self.resolve_call(mod, n, scopes):
+                        self._mark(
+                            cmod, callee,
+                            f"called from traced `{info.qualname}` "
+                            f"({reason})", work)
+
+    def traced_in(self, module):
+        """(FuncInfo, reason) pairs for traced functions defined in
+        ``module`` — same shape CallGraph.traced_funcs had."""
+        return [(info, reason) for mod, info, reason in
+                self.traced.values() if mod is module]

@@ -66,15 +66,18 @@ def _documented_vars(docs_path):
     return out
 
 
-def _aux_reads(docs_path):
+def _aux_reads(docs_path, parsed=None):
     """MXNET_* reads across the WHOLE repo that owns the docs file.
 
     The stale-row direction ('documented but never read') must be
     judged against the full tree, not just the paths being linted —
     otherwise linting a single edited file reports every hatch read
     elsewhere as stale.  The undocumented-read direction stays scoped
-    to the scanned files (those findings carry file/line anchors)."""
+    to the scanned files (those findings carry file/line anchors).
+    ``parsed`` maps absolute paths to already-parsed trees so files in
+    the scanned set are not parsed twice."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(docs_path)))
+    parsed = parsed or {}
     vars_seen = set()
     candidates = []
     for r, dirs, names in os.walk(root):
@@ -84,6 +87,10 @@ def _aux_reads(docs_path):
         candidates.extend(os.path.join(r, n) for n in names
                           if n.endswith(".py"))
     for path in candidates:
+        tree = parsed.get(path)
+        if tree is not None:
+            vars_seen.update(v for v, _ in _reads_in_tree(tree))
+            continue
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 src = fh.read()
@@ -112,7 +119,8 @@ def check(modules, docs_path):
                 f"`{var}` is read here but has no row in "
                 f"{os.path.relpath(docs_path)} — document the hatch "
                 "(default + effect) or remove the read"))
-    all_reads = set(read_lines) | _aux_reads(docs_path)
+    all_reads = set(read_lines) | _aux_reads(
+        docs_path, {os.path.abspath(m.path): m.tree for m in modules})
     for var, line in sorted(documented.items()):
         if var not in all_reads:
             findings.append(Finding(

@@ -1,0 +1,625 @@
+"""TL006–TL009 — the sharding / multi-host discipline family.
+
+These are the bug classes the multi-process pod runtime (`kvstore='tpu'`,
+GSPMD across hosts) hits at 64-chip scale, where every one of them is a
+hang or a silent replication instead of a stack trace:
+
+* **TL006** — a collective / ``PartitionSpec`` axis name must be bound
+  by a mesh (or axis-binding ``pmap``/``vmap``) definition somewhere in
+  the lint target.  An unknown axis fails to compile at best; in a
+  ``PartitionSpec`` it silently replicates the dim.  Axis names that
+  exist only as default-``axis`` parameters are *conditionally* bound
+  (a caller-supplied mesh has to provide them) — warn, not error.
+* **TL007** — cross-host trace divergence: reads of
+  ``jax.process_index()`` / ``process_count()``, ``os.environ``,
+  wall-clock time, or host RNG inside trace-reachable code compile a
+  *different program on different hosts*; the first collective then
+  waits forever for peers that compiled something else.  Same family:
+  ``donate_argnums`` / sharding arguments derived from set iteration or
+  ``id()`` ordering (per-process hash seeds make the order differ).
+* **TL008** — a collective issued under a data- or host-dependent
+  Python branch inside a traced region: the canonical SPMD hang (some
+  shards/hosts issue the collective, the rest never arrive).
+* **TL009** — accountant discipline: every ``ACCOUNTANT.set(subsystem,
+  ...)`` ledger registration needs a ``drop``/``drop_deferred`` for the
+  same subsystem somewhere in the lint target, pinning the PR-10
+  ledger-leak class as a lint instead of a review habit.
+
+All four consume the project-wide call graph (:mod:`.project`): the
+seeds live in one module (``gluon/fused_step.py``, ``serve/engine.py``)
+and the flagged code in another (``parallel/collectives.py``,
+``models/decoding.py``) — exactly the seams the module-local engine
+could not see.
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import _JAXISH_ROOTS, dotted, iter_own
+from .core import Finding
+from .rules_trace import _arrayish_locals, _traced_branch_value
+
+__all__ = ["build_state", "check_module"]
+
+# collective name -> positional index of the axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "psum_scatter": 1, "ppermute": 1, "all_to_all": 1, "pshuffle": 1,
+    "pbroadcast": 1, "axis_index": 0,
+}
+# entry points whose axis_name= kwarg BINDS an axis (vs the collectives,
+# where axis_name= is a use)
+_AXIS_BINDERS = {"pmap", "soft_pmap", "xmap", "vmap"}
+_AXISH_PARAM = ("axis", "axis_name", "batch_axis")
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time",
+             "time_ns", "perf_counter_ns", "monotonic_ns"}
+_PYRANDOM_FNS = {"random", "randint", "randrange", "choice", "choices",
+                 "shuffle", "sample", "uniform", "getrandbits",
+                 "randbytes", "gauss", "normalvariate"}
+_SHARDING_KWARGS = {"donate_argnums", "in_shardings", "out_shardings",
+                    "in_specs", "out_specs", "static_argnums"}
+
+
+class SharedState:
+    """Project-wide facts computed once and shared by every per-module
+    pass (and, under ``--jobs``, inherited by every worker)."""
+
+    __slots__ = ("mesh_axes", "vocab", "acct_drops")
+
+    def __init__(self):
+        self.mesh_axes = {}   # axis -> "path:line" of a binding mesh def
+        self.vocab = {}       # axis -> site (mesh defs + param defaults)
+        self.acct_drops = set()   # subsystems with a release path
+
+
+# --------------------------------------------------------------------- #
+# shared detection helpers
+# --------------------------------------------------------------------- #
+
+def _jaxish_root(root, module):
+    return (root in _JAXISH_ROOTS or root in module.jax_aliases
+            or root in module.jnp_aliases)
+
+
+def _collective_name(call, module, imports):
+    """The collective's name when ``call`` is one, else None."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if last not in _COLLECTIVES:
+        return None
+    if len(parts) == 1:
+        tgt = imports.from_imports.get(last)
+        return last if tgt and tgt[0].split(".")[0] == "jax" else None
+    return last if _jaxish_root(parts[0], module) else None
+
+
+def _is_spec_ctor(call, imports):
+    d = dotted(call.func)
+    if d is None:
+        return False
+    last = d.split(".")[-1]
+    if last == "PartitionSpec":
+        return True
+    if last == "P":
+        tgt = imports.from_imports.get("P")
+        return bool(tgt) and tgt[1] in ("P", "PartitionSpec")
+    return False
+
+
+def _str_elts(expr):
+    """All string constants in a constant/tuple/list expression, or
+    None when anything non-constant appears."""
+    if isinstance(expr, ast.Constant):
+        return [expr.value] if isinstance(expr.value, str) else []
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            sub = _str_elts(e)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    return None
+
+
+def _resolve_axis_expr(expr, scopes):
+    """(values, how) for an axis argument: ``how`` is 'literal' (string
+    at the call site), 'param' (resolved through an enclosing function
+    parameter's default), or 'dynamic' (caller-supplied, not checkable).
+    """
+    vals = _str_elts(expr)
+    if vals is not None:
+        return vals, "literal"
+    if isinstance(expr, ast.Name):
+        for scope in reversed(scopes):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            a = scope.args
+            pos = a.posonlyargs + a.args
+            defaults = [None] * (len(pos) - len(a.defaults)) \
+                + list(a.defaults)
+            for arg, dflt in list(zip(pos, defaults)) + \
+                    list(zip(a.kwonlyargs, a.kw_defaults)):
+                if arg.arg != expr.id:
+                    continue
+                if isinstance(dflt, ast.Constant) and \
+                        isinstance(dflt.value, str):
+                    return [dflt.value], "param"
+                return [], "dynamic"
+    return [], "dynamic"
+
+
+# --------------------------------------------------------------------- #
+# project-wide state: axis definitions + accountant release paths
+# --------------------------------------------------------------------- #
+
+def build_state(project):
+    st = SharedState()
+    for m in project.modules:
+        idx = project.index(m)
+        for call, _scopes in idx.calls:
+            d = dotted(call.func)
+            last = d.split(".")[-1] if d else None
+            site = f"{m.path}:{call.lineno}"
+            if last == "Mesh" and len(call.args) >= 2:
+                for ax in _str_elts(call.args[1]) or []:
+                    st.mesh_axes.setdefault(ax, site)
+            if last == "make_mesh":
+                axes = call.args[0] if call.args else next(
+                    (k.value for k in call.keywords if k.arg == "axes"),
+                    None)
+                if isinstance(axes, ast.Dict):
+                    for k in axes.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            st.mesh_axes.setdefault(k.value, site)
+                elif isinstance(axes, (ast.List, ast.Tuple)):
+                    for e in axes.elts:
+                        if isinstance(e, (ast.Tuple, ast.List)) and \
+                                e.elts and \
+                                isinstance(e.elts[0], ast.Constant) and \
+                                isinstance(e.elts[0].value, str):
+                            st.mesh_axes.setdefault(
+                                e.elts[0].value, site)
+                # jax.make_mesh(axis_shapes, axis_names) style
+                if len(call.args) >= 2:
+                    for ax in _str_elts(call.args[1]) or []:
+                        st.mesh_axes.setdefault(ax, site)
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    for ax in _str_elts(kw.value) or []:
+                        st.mesh_axes.setdefault(ax, site)
+                elif kw.arg == "axis_name" and last in _AXIS_BINDERS:
+                    for ax in _str_elts(kw.value) or []:
+                        st.mesh_axes.setdefault(ax, site)
+        for info in idx.functions:
+            a = info.node.args
+            pos = a.posonlyargs + a.args
+            defaults = [None] * (len(pos) - len(a.defaults)) \
+                + list(a.defaults)
+            for arg, dflt in list(zip(pos, defaults)) + \
+                    list(zip(a.kwonlyargs, a.kw_defaults)):
+                if (arg.arg in _AXISH_PARAM
+                        or arg.arg.endswith("_axis")) and \
+                        isinstance(dflt, ast.Constant) and \
+                        isinstance(dflt.value, str):
+                    st.vocab.setdefault(
+                        dflt.value, f"{m.path}:{info.node.lineno}")
+        # accountant release paths (project-wide: the drop may live in
+        # another module than the set — Trainer vs FusedStep)
+        for n in ast.walk(m.tree):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("drop", "drop_deferred", "release"):
+                recv = dotted(n.func.value)
+                if recv and recv.split(".")[-1] == "ACCOUNTANT" and \
+                        n.args and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    st.acct_drops.add(n.args[0].value)
+    st.vocab.update(st.mesh_axes)
+    return st
+
+
+# --------------------------------------------------------------------- #
+# per-module checks
+# --------------------------------------------------------------------- #
+
+def check_module(project, state, module):
+    imports = project.imports[id(module)]
+    findings = []
+    findings.extend(_tl006(project, state, module, imports))
+    findings.extend(_tl007(project, module, imports))
+    findings.extend(_tl008(project, module, imports))
+    findings.extend(_tl009(state, module))
+    return findings
+
+
+# -- TL006: axis/mesh discipline --------------------------------------- #
+
+def _known_axes(state):
+    return ", ".join(sorted(state.vocab)) or "none defined"
+
+
+def _judge_axis(state, module, node, val, how, what):
+    if val in state.mesh_axes:
+        return None
+    if how == "param":
+        # a caller-supplied value rides the parameter; its default is
+        # only checked against the project's axis vocabulary
+        if val in state.vocab:
+            return None
+        sev, tail = "warn", (
+            "it is a parameter default no mesh in the lint target "
+            "defines, so only a caller-supplied mesh can bind it")
+    elif val in state.vocab:
+        sev, tail = "warn", (
+            "no mesh in the lint target defines it (it appears only as "
+            "a default axis parameter), so only a caller-supplied mesh "
+            "can bind it — conditionally bound")
+    else:
+        sev, tail = "error", (
+            f"known axes: {_known_axes(state)}; an unbound collective "
+            "axis fails to compile, and an unbound PartitionSpec axis "
+            "silently replicates the dim")
+    return Finding(
+        "TL006", module.path, node.lineno, node.col_offset,
+        f"{what} axis {val!r} is not bound by any mesh or shard_map "
+        f"axis definition reachable in the lint target — {tail}",
+        severity=sev)
+
+
+def _tl006(project, state, module, imports):
+    out = []
+    idx = project.index(module)
+    for call, scopes in idx.calls:
+        name = _collective_name(call, module, imports)
+        axis_exprs = []
+        if name is not None:
+            # only axis_name= carries the mesh axis; the gather family's
+            # axis= kwarg is the INTEGER array dimension, so it must not
+            # shadow the positional axis-name argument
+            kw = next((k.value for k in call.keywords
+                       if k.arg == "axis_name"), None)
+            if kw is not None:
+                axis_exprs.append(kw)
+            else:
+                p = _COLLECTIVES[name]
+                if p < len(call.args):
+                    axis_exprs.append(call.args[p])
+            what = f"collective `{name}`"
+        else:
+            d = dotted(call.func)
+            if d and d.split(".")[-1] == "partial" and call.args:
+                inner = dotted(call.args[0])
+                if inner and inner.split(".")[-1] in _COLLECTIVES and \
+                        _jaxish_root(inner.split(".")[0], module):
+                    what = f"collective `{inner.split('.')[-1]}`"
+                    axis_exprs.extend(
+                        k.value for k in call.keywords
+                        if k.arg == "axis_name")
+                else:
+                    continue
+            elif _is_spec_ctor(call, imports):
+                what = "PartitionSpec"
+                axis_exprs.extend(call.args)
+                axis_exprs.extend(k.value for k in call.keywords
+                                  if k.arg is not None)
+            else:
+                continue
+        for expr in axis_exprs:
+            vals, how = _resolve_axis_expr(expr, scopes)
+            for v in vals:
+                f = _judge_axis(state, module, expr, v, how, what)
+                if f is not None:
+                    out.append(f)
+    return out
+
+
+# -- TL007: cross-host trace divergence -------------------------------- #
+
+# modules whose from-imports we expand when classifying host reads —
+# restricting to this set keeps a project module that merely shares a
+# local name from being mistaken for the stdlib
+_HOST_STATE_ROOTS = {"os", "time", "random", "numpy", "jax", "secrets",
+                     "uuid"}
+
+
+def _host_divergent_call(call, module, imports):
+    """Message when ``call`` reads host-local state that differs across
+    pod processes, else None.  Resolves both module aliases
+    (``import os`` → ``os.getenv``) and from-imports
+    (``from os import getenv`` → ``getenv``)."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    # expand a from-imported head to its source module so `getenv(...)`
+    # and `perf_counter(...)` classify the same as the dotted forms; a
+    # head bound to anything ELSE (e.g. the repo's `from .. import
+    # random`) is known-not-stdlib and never classified
+    head = imports.from_imports.get(parts[0])
+    if head is not None:
+        if head[0].split(".")[0] not in _HOST_STATE_ROOTS:
+            return None
+        parts = head[0].split(".") + [head[1]] + parts[1:]
+    else:
+        # `import os` / `import time as _time` style: normalize the
+        # alias back to the real module name
+        tgt = imports.mod_aliases.get(parts[0])
+        if tgt is not None:
+            if tgt.split(".")[0] not in _HOST_STATE_ROOTS:
+                return None
+            parts = tgt.split(".") + parts[1:]
+    root, last = parts[0], parts[-1]
+    if last in ("process_index", "process_count") and \
+            (root == "jax" or parts[0] in module.jax_aliases):
+        return (f"`{d}()` pins the host id into the trace — each host "
+                "compiles a different program and every collective in "
+                "it can deadlock the pod; hoist it to trace time (cache "
+                "key / operand) or use lax.axis_index over a mesh axis")
+    if ("environ" in parts[:-1] and last in ("get", "__getitem__")) or \
+            (root == "os" and last in ("getenv", "environ")):
+        return ("`os.environ` read inside traced code — per-host "
+                "environment differences compile different programs on "
+                "different hosts; read the hatch at trace time and "
+                "close over the value")
+    if root == "os" and last == "urandom":
+        return ("`os.urandom` inside traced code — host entropy burned "
+                "into the trace diverges across hosts")
+    if root == "time" and last in _TIME_FNS:
+        return (f"`{d}()` inside traced code — hosts trace at different "
+                "wall-clock times, so anything derived from it (shapes, "
+                "seeds, donation choices) diverges per host")
+    if last in _PYRANDOM_FNS and (
+            root == "random"
+            or root in ("secrets",)
+            or (root in module.np_aliases and "random" in parts)
+            or (root == "numpy" and "random" in parts)):
+        return (f"`{d}()` is host RNG inside traced code — per-host "
+                "draws compile divergent programs; use jax.random with "
+                "a key operand shared by all hosts")
+    return None
+
+
+def _environ_subscript(node):
+    if isinstance(node, ast.Subscript):
+        d = dotted(node.value)
+        return bool(d) and d.endswith("environ")
+    return False
+
+
+def _order_hazard(expr):
+    """Reason when ``expr`` derives ordering from a set or ``id()`` —
+    per-process hash seeds make both differ across hosts."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d == "sorted" and not any(
+                    k.arg == "key" and dotted(k.value) == "id"
+                    for k in n.keywords):
+                continue  # sorted(...) re-establishes a host-stable order
+            if d == "sorted":
+                return "`sorted(..., key=id)` (identity order)"
+            if d == "set" or d == "frozenset":
+                return f"`{d}(...)` iteration order"
+            if d == "id":
+                return "`id(...)`-derived ordering"
+        if isinstance(n, (ast.Set, ast.SetComp)):
+            return "set iteration order"
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp)):
+            for gen in n.generators:
+                it = gen.iter
+                if isinstance(it, (ast.Set, ast.SetComp)) or (
+                        isinstance(it, ast.Call)
+                        and dotted(it.func) in ("set", "frozenset")):
+                    return "set iteration order"
+        stack.extend(ast.iter_child_nodes(n))
+    return None
+
+
+def _divergent_sources(module, imports, fn_node):
+    """Divergent-read nodes in one function, plus the local names their
+    values taint (fixed point over assignment chains)."""
+    sources = {}   # id(node) -> (node, msg)
+    for n in iter_own(fn_node):
+        msg = None
+        if isinstance(n, ast.Call):
+            msg = _host_divergent_call(n, module, imports)
+        elif _environ_subscript(n):
+            msg = ("`os.environ[...]` read inside traced code — "
+                   "per-host environment differences compile different "
+                   "programs on different hosts")
+        if msg:
+            sources[id(n)] = (n, msg)
+    tainted = {}   # local name -> (source node, msg)
+
+    def origin(expr):
+        for sub in ast.walk(expr):
+            if id(sub) in sources:
+                return sources[id(sub)]
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Load) and sub.id in tainted:
+                return tainted[sub.id]
+        return None
+
+    for _ in range(2):
+        for n in iter_own(fn_node):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                hit = origin(n.value)
+                if hit is None:
+                    continue
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            tainted.setdefault(leaf.id, hit)
+    return origin
+
+
+def _identity_only_test(test):
+    """`x is None`-style tests resolve host-uniformly at trace time."""
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+def _tl007(project, module, imports):
+    out = []
+    # host-divergent reads whose value FEEDS the trace: reaches a
+    # return, a jax/jnp call argument, or a python branch test.  Reads
+    # that stay host-side (profiler clocks, logging) are not divergence.
+    for info, reason in project.traced_in(module):
+        origin = _divergent_sources(module, imports, info.node)
+        hits = {}
+
+        def sink(expr, via):
+            found = origin(expr)
+            if found is not None:
+                node, msg = found
+                hits.setdefault(id(node), (node, msg, via))
+
+        for n in iter_own(info.node):
+            if isinstance(n, ast.Return) and n.value is not None:
+                sink(n.value, "returned from the traced function")
+            elif isinstance(n, (ast.If, ast.While)):
+                if not _identity_only_test(n.test):
+                    sink(n.test, "branches the python trace")
+            elif isinstance(n, ast.IfExp):
+                sink(n.test, "branches the python trace")
+            elif isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and _jaxish_root(d.split(".")[0], module):
+                    for a in list(n.args) + [k.value for k in n.keywords]:
+                        sink(a, f"feeds `{d}(...)`")
+        for node, msg, via in sorted(hits.values(),
+                                     key=lambda h: h[0].lineno):
+            out.append(Finding(
+                "TL007", module.path, node.lineno, node.col_offset,
+                f"{msg} — inside `{info.qualname}`, which is traced "
+                f"({reason}); the value {via}, so each host can "
+                "compile a different program"))
+    # nondeterministic ordering feeding shardings / donation
+    idx = project.index(module)
+    for call, _scopes in idx.calls:
+        d = dotted(call.func)
+        last = d.split(".")[-1] if d else None
+        if last in ("jit", "pjit", "shard_map"):
+            for kw in call.keywords:
+                if kw.arg in _SHARDING_KWARGS:
+                    why = _order_hazard(kw.value)
+                    if why:
+                        out.append(Finding(
+                            "TL007", module.path, kw.value.lineno,
+                            kw.value.col_offset,
+                            f"`{kw.arg}=` derived from {why} — set/id "
+                            "order depends on the per-process hash "
+                            "seed, so hosts disagree on which operands "
+                            "are donated/sharded and compile different "
+                            "programs; sort by a stable key instead"))
+        elif _is_spec_ctor(call, imports):
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                why = _order_hazard(arg)
+                if why:
+                    out.append(Finding(
+                        "TL007", module.path, arg.lineno, arg.col_offset,
+                        f"PartitionSpec axes derived from {why} — hosts "
+                        "disagree on the axis order and shard the same "
+                        "array differently; use a stable sequence"))
+    return out
+
+
+# -- TL008: conditional collectives ------------------------------------ #
+
+def _branch_reason(module, test, arrayish, imports):
+    """Why a branch test is unsafe to gate a collective on, or None."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            msg = _host_divergent_call(n, module, imports)
+            if msg:
+                return f"host-dependent (`{dotted(n.func)}`)"
+        elif _environ_subscript(n):
+            return "host-dependent (`os.environ[...]`)"
+    val = _traced_branch_value(module, test, arrayish)
+    if val:
+        return f"data-dependent (`{val}`)"
+    return None
+
+
+def _tl008(project, module, imports):
+    out = []
+    for info, reason in project.traced_in(module):
+        arrayish = _arrayish_locals(module, info.node)
+
+        def walk(node, why):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, (ast.If, ast.While)):
+                    sub = _branch_reason(module, child.test, arrayish,
+                                         imports) or why
+                    walk(child.test, why)
+                    for b in child.body + child.orelse:
+                        walk(b, sub)
+                    continue
+                if isinstance(child, ast.IfExp):
+                    sub = _branch_reason(module, child.test, arrayish,
+                                         imports) or why
+                    walk(child.test, why)
+                    walk(child.body, sub)
+                    walk(child.orelse, sub)
+                    continue
+                if why and isinstance(child, ast.Call):
+                    name = _collective_name(child, module, imports)
+                    if name is not None:
+                        out.append(Finding(
+                            "TL008", module.path, child.lineno,
+                            child.col_offset,
+                            f"collective `{name}` issued under a {why} "
+                            f"branch inside traced `{info.qualname}` "
+                            f"({reason}) — shards/hosts that skip the "
+                            "branch never join the collective and the "
+                            "rest wait forever (the canonical SPMD "
+                            "hang); issue it unconditionally and mask, "
+                            "or use lax.cond with a replicated "
+                            "predicate"))
+                walk(child, why)
+
+        walk(info.node, None)
+    return out
+
+
+# -- TL009: accountant discipline -------------------------------------- #
+
+def _tl009(state, module):
+    out = []
+    for n in ast.walk(module.tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "set"):
+            continue
+        recv = dotted(n.func.value)
+        if not recv or recv.split(".")[-1] != "ACCOUNTANT":
+            continue
+        if not (n.args and isinstance(n.args[0], ast.Constant)
+                and isinstance(n.args[0].value, str)):
+            continue  # dynamic subsystem: not statically checkable
+        cat = n.args[0].value
+        if cat not in state.acct_drops:
+            out.append(Finding(
+                "TL009", module.path, n.lineno, n.col_offset,
+                f"`ACCOUNTANT.set({cat!r}, ...)` has no "
+                f"`ACCOUNTANT.drop`/`drop_deferred` for {cat!r} "
+                "anywhere in the lint target — an unreleased ledger "
+                "entry reads as a reconcile() delta<0 leak forever "
+                "(the PR-10 ledger-leak class); add the release path "
+                "(see FusedStep.release_accounting) or suppress with "
+                "a justification"))
+    return out

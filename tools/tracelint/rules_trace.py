@@ -1,12 +1,18 @@
 """TL001 host-sync-in-trace, TL002 donation-after-use, TL003 retrace
 hazards — the three rules that guard the fused hot path's jit discipline.
+
+Traced-region discovery is project-wide (:mod:`.project`): a host sync
+two import hops away from the ``jax.jit`` call site is still reachable
+from traced code and still re-serializes the step.  TL002/TL003 stay
+scoped to one module's dataflow — donation locals and cache receivers
+don't cross files.
 """
 from __future__ import annotations
 
 import ast
 import re
 
-from .callgraph import CallGraph, dotted, is_tracing_entry, iter_own
+from .callgraph import dotted, iter_own
 from .core import Finding
 
 __all__ = ["check_module"]
@@ -42,12 +48,12 @@ _TEST_SKIP_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable",
                     "issubclass"}
 
 
-def check_module(module):
-    cg = CallGraph(module)
+def check_module(project, module):
+    idx = project.index(module)
     findings = []
-    findings.extend(_tl001(module, cg))
-    findings.extend(_tl002(module, cg))
-    findings.extend(_tl003(module, cg))
+    findings.extend(_tl001(module, project.traced_in(module)))
+    findings.extend(_tl002(module, idx))
+    findings.extend(_tl003(module, idx))
     return findings
 
 
@@ -159,9 +165,9 @@ def _traced_branch_value(module, test, arrayish):
     return None
 
 
-def _tl001(module, cg):
+def _tl001(module, traced):
     out = []
-    for info, reason in cg.traced_funcs():
+    for info, reason in traced:
         arrayish = _arrayish_locals(module, info.node)
         for n in iter_own(info.node):
             if isinstance(n, ast.Call):
@@ -233,9 +239,8 @@ def _resolve_positions(expr, fn_node):
     return None
 
 
-def _donation_index(module, cg):
+def _donation_index(module, idx):
     """(donating jit call-exprs, producer functions returning them)."""
-    idx = cg.index
     donating = {}  # id(call node) -> positions
     for call, scopes in idx.calls:
         if not _is_jit_call(call, module):
@@ -263,7 +268,7 @@ def _donation_index(module, cg):
             if id(value) in donating:
                 return set(donating[id(value)])
             sets = [producers[id(c.node)]
-                    for c in cg.index.resolve_call(value, scopes)
+                    for c in idx.resolve_call(value, scopes)
                     if id(c.node) in producers]
             return set.intersection(*sets) if sets else None
         if isinstance(value, ast.Name):
@@ -318,12 +323,12 @@ def _stores_and_loads(fn_node, key):
     return stores, loads
 
 
-def _tl002(module, cg):
-    donating, producers = _donation_index(module, cg)
+def _tl002(module, idx):
+    donating, producers = _donation_index(module, idx)
     if not donating and not producers:
         return []
     out = []
-    for info in cg.index.functions:
+    for info in idx.functions:
         scopes = info.scopes + (info.node,)
         local_sets = {}  # local name -> [position sets, one per assign]
         for n in iter_own(info.node):
@@ -334,7 +339,7 @@ def _tl002(module, cg):
                     pos = set(donating[id(n.value)])
                 else:
                     sets = [producers[id(c.node)]
-                            for c in cg.index.resolve_call(n.value, scopes)
+                            for c in idx.resolve_call(n.value, scopes)
                             if id(c.node) in producers]
                     pos = set.intersection(*sets) if sets else None
                 if pos is not None:
@@ -407,9 +412,8 @@ def _unhashable_reason(elem, fn_node):
     return None
 
 
-def _tl003(module, cg):
+def _tl003(module, idx):
     out = []
-    idx = cg.index
     # -- cache-key hygiene ------------------------------------------------ #
     for info in idx.functions:
         for n in iter_own(info.node):
