@@ -107,6 +107,22 @@ def env_truthy(name: str, default: bool = False) -> bool:
     return bool(get_env(name, default, bool))
 
 
+def parse_seconds(var: str, raw) -> Optional[float]:
+    """LOUD seconds-knob parsing shared by the fault-tolerance timeout
+    hatches (ISSUE 13: serve deadlines/step timeout, init/barrier
+    timeouts, heartbeat interval): a malformed value raises a clean
+    ``MXNetError`` naming the variable — never a silent fallback to a
+    default or to wait-forever, which is exactly the hang/misconfig
+    these knobs exist to prevent.  Returns ``None`` for an unset
+    value; zero-vs-None semantics stay at the call site."""
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise MXNetError(f"{var}={raw!r}: expected seconds (a number)")
+
+
 # Engine-type compat: MXNET_ENGINE_TYPE=NaiveEngine selects fully synchronous
 # dispatch (reference anchor: NaiveEngine debug mode, SURVEY.md §5.2).  On
 # TPU this means block_until_ready after every op.

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..telemetry.faults import fault_point
 
 __all__ = ["KVStore", "create"]
 
@@ -189,6 +190,7 @@ class KVStore:
         return out
 
     def push(self, key, value, priority=0):
+        fault_point("kvstore.push", store=self._kind)
         if isinstance(key, (list, tuple)):
             if self._optimizer is not None:
                 # optimizer-on-server, whole push wave at once: merge
@@ -229,6 +231,7 @@ class KVStore:
             self._store[key]._rebind(self._store[key]._data + merged)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        fault_point("kvstore.pull", store=self._kind)
         if isinstance(key, (list, tuple)) and isinstance(out, (list, tuple)) \
                 and len(key) == len(out) and isinstance(key[0], (str, int)):
             for k, o in zip(key, out):
@@ -248,6 +251,7 @@ class KVStore:
         on a pure-allreduce ``dist_*`` store is coalesced into one flat
         AllReduce per dtype (bucketing — one wire collective per push
         wave instead of one per parameter)."""
+        fault_point("kvstore.pushpull", store=self._kind)
         if isinstance(key, (list, tuple)) and not isinstance(key, str):
             vals = value
             outs = out if out is not None else [None] * len(key)
@@ -355,11 +359,15 @@ class KVStore:
             threshold=float(params.get("threshold", 0.5)))
 
     def barrier(self):
+        """Wait for local work, then sync the process group — through
+        ``parallel.mesh.barrier``, so ``MXNET_BARRIER_TIMEOUT`` bounds
+        the wait and a dead peer rank surfaces as a clean error
+        instead of an indefinite hang in the collective."""
         from ..ndarray.ndarray import waitall
         waitall()
         if self._kind.startswith("dist") and self.num_workers > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+            from ..parallel.mesh import barrier as mesh_barrier
+            mesh_barrier("kvstore_barrier")
 
     def _wait(self, keys):
         for k in (keys if isinstance(keys, (list, tuple)) else [keys]):

@@ -17,31 +17,192 @@ reference server's aggregated ``multi_sgd_update`` batching
 - ``DMLC_ROLE=worker`` (or unset): no-op, training proceeds.
 - ``DMLC_ROLE=server`` / ``scheduler``: the process joins the
   ``jax.distributed`` group (so barriers and coordination work for code
-  that still launches dedicated server ranks) and then parks in the
+  that still launches dedicated server ranks) and then runs the
   reference server loop shape until the job ends.
+
+Fault tolerance (ISSUE 13): the loop is a real request loop now, and a
+request that fails is REPORTED TO THE REQUESTING RANK as an error reply
+(``KVStoreServer.submit(...).wait()`` raises a clean ``MXNetError``
+naming the command) instead of killing the server — a dead server looks
+like a hang to every worker blocked on its next pull, which is the one
+failure mode this layer must never manufacture.  The parked server rank
+also heartbeats (``mxnet_tpu.parallel.heartbeat``) so a supervised
+launch sees it as alive, and ``stop()`` ends the loop promptly.
 """
 from __future__ import annotations
 
 import logging
 import os
-import time
+import queue
+import threading
+
+from ..base import MXNetError
+
+
+class ServerReply:
+    """The requesting rank's handle on one server request: ``wait()``
+    blocks for the result and RAISES the server-side failure as a
+    clean ``MXNetError`` (the reference's ps-lite response message,
+    collapsed to in-process form)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _resolve(self, result):
+        # first outcome wins: the submit-vs-stop race can legitimately
+        # settle one reply from two threads (server loop + the
+        # requester's own stopped-check backstop)
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._result = result
+            self._done.set()
+
+    def _reject(self, error):
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._error = error
+            self._done.set()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise MXNetError(
+                f"kvstore server reply not ready within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
 
 
 class KVStoreServer:
-    """API-compatible stand-in for the reference ``KVStoreServer``."""
+    """API-compatible stand-in for the reference ``KVStoreServer``,
+    with a real per-request loop: built-in ``init``/``push``/``pull``/
+    ``barrier`` commands against the owned store, plus custom
+    ``handlers[command] = fn(server, payload)`` (the reference's
+    ``SendCommandToServers`` controller hook)."""
 
     def __init__(self, kvstore):
         self.kvstore = kvstore
         self.handlers = {}
+        self._requests = queue.Queue()
+        self._stop = threading.Event()
 
-    def run(self):
+    # -- requesting-rank side ------------------------------------------- #
+    def submit(self, command, payload=None):
+        """Enqueue one request; returns its :class:`ServerReply`."""
+        if self._stop.is_set():
+            raise MXNetError("kvstore server is stopped")
+        reply = ServerReply()
+        self._requests.put((command, payload, reply))
+        if self._stop.is_set():
+            # stop() raced the put: the run() shutdown drain may have
+            # already emptied the queue before our entry landed, so
+            # nobody else will ever settle this reply — reject it HERE
+            # (first-outcome-wins makes a double settle harmless) so
+            # reply.wait() can never strand the requesting rank
+            reply._reject(MXNetError("kvstore server is stopped"))
+        return reply
+
+    def stop(self):
+        """End :meth:`run` promptly (clean shutdown — in-queue requests
+        are failed with a server-stopped error, not dropped; drained
+        HERE too, so a stop() with no active run() loop — the
+        serve_one-driven embedding case — strands nothing)."""
+        self._stop.set()
+        self._drain_reject()
+
+    def _drain_reject(self):
+        """Fail (not strand) everything queued; first-outcome-wins
+        replies make a concurrent run()-finally double-drain harmless."""
+        while True:
+            try:
+                _c, _p, reply = self._requests.get_nowait()
+            except queue.Empty:
+                return
+            reply._reject(MXNetError("kvstore server is stopped"))
+
+    # -- server side ----------------------------------------------------- #
+    def handle(self, command, payload):
+        """Dispatch one request (custom handlers win over built-ins)."""
+        fn = self.handlers.get(command)
+        if fn is not None:
+            return fn(self, payload)
+        if command == "init":
+            key, value = payload
+            return self.kvstore.init(key, value)
+        if command == "push":
+            key, value = payload
+            return self.kvstore.push(key, value)
+        if command == "pull":
+            key, out = payload
+            self.kvstore.pull(key, out=out)
+            return out
+        if command == "barrier":
+            return self.kvstore.barrier()
+        raise MXNetError(f"kvstore server: unknown command {command!r} "
+                         f"(handlers: {sorted(self.handlers)})")
+
+    def serve_one(self, timeout=0.2):
+        """Serve at most one queued request.  A handler exception is
+        caught, reported on the request's reply (so the REQUESTING rank
+        sees the error), counted in telemetry — and the loop lives on.
+        Returns True when a request was served."""
+        try:
+            command, payload, reply = self._requests.get(timeout=timeout)
+        except queue.Empty:
+            return False
+        if self._stop.is_set():
+            reply._reject(MXNetError("kvstore server is stopped"))
+            return True
+        try:
+            reply._resolve(self.handle(command, payload))
+        except Exception as e:   # report, don't die: a dead server is
+            from .. import telemetry   # a hang for every worker
+
+            telemetry.emit("kvstore_error", command=str(command),
+                           error=repr(e))
+            telemetry.counter("kvstore_request_errors_total",
+                              command=str(command)).inc()
+            err = e if isinstance(e, MXNetError) else MXNetError(
+                f"kvstore server: request {command!r} failed: {e!r}")
+            reply._reject(err)
+        return True
+
+    def run(self, serve_any_role=False):
+        """The server loop.  Honors the reference contract: with
+        ``DMLC_ROLE`` unset or ``worker`` the loop exits immediately
+        (no-op role) — pass ``serve_any_role=True`` to run the command
+        loop regardless (embedding/test use).  However the loop exits,
+        ``submit()`` is poisoned and the backlog failed, never
+        stranded."""
+        from ..parallel.heartbeat import start_heartbeat
+
+        start_heartbeat()
         logging.info(
             "mxnet_tpu kvstore server role: parameter-server duties are "
-            "subsumed by XLA collectives; this process idles for protocol "
-            "compatibility. Launch workers only (tools/launch.py -s 0) to "
-            "avoid paying for this process.")
-        while os.environ.get("DMLC_ROLE") in ("server", "scheduler"):
-            time.sleep(60)
+            "subsumed by XLA collectives; this process serves the "
+            "compat command loop. Launch workers only (tools/launch.py "
+            "-s 0) to avoid paying for this process.")
+        try:
+            while not self._stop.is_set() and (
+                    serve_any_role or
+                    os.environ.get("DMLC_ROLE") in ("server",
+                                                    "scheduler")):
+                self.serve_one()
+        finally:
+            # however the loop exited (stop() OR a role-env change),
+            # the server is gone: poison submit() first so a racing
+            # request raises instead of enqueueing into a queue nobody
+            # will ever serve, then fail (not strand) the backlog
+            self._stop.set()
+            self._drain_reject()
 
 
 def _init_kvstore_server_module():

@@ -21,7 +21,9 @@ collectives over a ``jax.sharding.Mesh``:
 """
 from .mesh import (Mesh, P, make_mesh, current_mesh, default_mesh,
                    use_mesh, named_sharding, data_sharding,
-                   replicated_sharding, init_distributed, local_mesh_axes)
+                   replicated_sharding, init_distributed, local_mesh_axes,
+                   barrier)
+from .heartbeat import start_heartbeat, stop_heartbeat
 from .collectives import (all_reduce, all_gather, reduce_scatter,
                           broadcast, ring_pass)
 from .spmd import ShardingRules, shard_block, SPMDTrainer
@@ -30,7 +32,8 @@ from .pipeline import gpipe_apply, stack_stage_params
 __all__ = [
     "Mesh", "P", "make_mesh", "current_mesh", "default_mesh", "use_mesh",
     "named_sharding", "data_sharding", "replicated_sharding",
-    "init_distributed", "local_mesh_axes",
+    "init_distributed", "local_mesh_axes", "barrier",
+    "start_heartbeat", "stop_heartbeat",
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ring_pass",
     "ShardingRules", "shard_block", "SPMDTrainer",
     "gpipe_apply", "stack_stage_params",

@@ -1,0 +1,107 @@
+"""Per-rank heartbeat writer — the rank half of the supervised launch.
+
+``tools/launch.py`` (the supervisor) gives every rank a private
+``MXNET_HEARTBEAT_FILE`` and watches its mtime: a rank whose file goes
+silent past ``--heartbeat-timeout`` is declared wedged and the whole
+job is torn down with a diagnostic instead of hanging in a collective
+forever (the reference tracker's dead-worker detection,
+ROADMAP "fault-tolerant rendezvous").
+
+This module is the writer: :func:`start_heartbeat` runs a daemon
+thread touching the file every ``MXNET_HEARTBEAT_INTERVAL`` seconds
+(file content = ``pid beat_count`` for post-mortems; the supervisor
+only reads mtime).  ``parallel.init_distributed`` calls it before the
+coordinator rendezvous — a rank stuck in ``jax.distributed`` init
+still beats, so the supervisor distinguishes "slow rendezvous" from
+"dead rank" — and ``kvstore_server``'s parked server role beats too.
+
+The beat loop is a ``launch.heartbeat`` fault-injection site
+(``MXNET_FAULT_INJECT=launch.heartbeat:kill:2`` is how the chaos tests
+kill one rank of a launched job mid-run).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..telemetry.faults import fault_point
+
+__all__ = ["start_heartbeat", "stop_heartbeat", "heartbeat_path",
+           "heartbeat_interval"]
+
+_lock = threading.Lock()
+_state = {"thread": None, "stop": None, "path": None}
+
+
+def heartbeat_path():
+    """The supervisor-assigned beat file (None = unsupervised run)."""
+    return os.environ.get("MXNET_HEARTBEAT_FILE") or None
+
+
+def heartbeat_interval():
+    from ..base import parse_seconds
+
+    val = parse_seconds("MXNET_HEARTBEAT_INTERVAL",
+                        os.environ.get("MXNET_HEARTBEAT_INTERVAL",
+                                       "1.0"))
+    return max(val, 0.01)
+
+
+def _beat_once(path, count):
+    fault_point("launch.heartbeat", path=path, beat=count)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(f"{os.getpid()} {count}\n")
+
+
+def start_heartbeat(path=None, interval=None):
+    """Start (idempotently) the daemon beat thread; returns it, or
+    ``None`` when no heartbeat file is configured.  The first beat is
+    written synchronously on the caller's thread, so the supervisor
+    sees a live rank the moment this returns — before any slow
+    import/rendezvous work begins."""
+    path = path or heartbeat_path()
+    if path is None:
+        return None
+    interval = interval if interval is not None else heartbeat_interval()
+    with _lock:
+        th = _state["thread"]
+        if th is not None and th.is_alive():
+            if _state["path"] == path:
+                return th
+            # re-pointed at a new file: stop the old beater first — a
+            # leaked thread would keep the OLD file fresh forever, so a
+            # supervisor watching it could never see this rank as dead
+            _state["stop"].set()
+            th.join(timeout=2.0)
+        _beat_once(path, 0)
+        stop = threading.Event()
+
+        def _loop():
+            count = 1
+            while not stop.wait(interval):
+                try:
+                    _beat_once(path, count)
+                except OSError:
+                    return   # beat dir torn down: the job is ending
+                count += 1
+
+        th = threading.Thread(target=_loop, name="mxnet-heartbeat",
+                              daemon=True)
+        _state["thread"] = th
+        _state["stop"] = stop
+        _state["path"] = path
+        th.start()
+    return th
+
+
+def stop_heartbeat():
+    """Stop the beat thread (tests / clean shutdown)."""
+    with _lock:
+        th, stop = _state["thread"], _state["stop"]
+        _state["thread"] = None
+        _state["stop"] = None
+        _state["path"] = None
+    if stop is not None:
+        stop.set()
+    if th is not None and th.is_alive():
+        th.join(timeout=2.0)

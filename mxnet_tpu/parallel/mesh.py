@@ -10,8 +10,11 @@ and XLA emits the collectives; multi-host membership comes from
 """
 from __future__ import annotations
 
+import inspect
+import math
 import os
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -22,7 +25,8 @@ from ..base import MXNetError
 
 __all__ = ["Mesh", "P", "make_mesh", "current_mesh", "default_mesh",
            "use_mesh", "named_sharding", "data_sharding",
-           "replicated_sharding", "init_distributed", "local_mesh_axes"]
+           "replicated_sharding", "init_distributed", "local_mesh_axes",
+           "barrier"]
 
 _state = threading.local()
 
@@ -121,10 +125,30 @@ def local_mesh_axes(mesh: Mesh) -> dict:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
+def _init_timeout_from_env():
+    from ..base import parse_seconds
+
+    t = parse_seconds("MXNET_INIT_TIMEOUT",
+                      os.environ.get("MXNET_INIT_TIMEOUT", "300"))
+    return t if t > 0 else None
+
+
+def _init_retries_from_env():
+    raw = os.environ.get("MXNET_INIT_RETRIES", "2")
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        # same loud-knob discipline as base.parse_seconds
+        raise MXNetError(f"MXNET_INIT_RETRIES={raw!r}: expected an "
+                         "integer")
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
-                     local_device_ids=None) -> None:
+                     local_device_ids=None,
+                     initialization_timeout: Optional[float] = None,
+                     retries: Optional[int] = None) -> None:
     """Multi-host bootstrap (replaces the reference's ps-lite scheduler
     rendezvous, SURVEY.md §4.4).
 
@@ -133,7 +157,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
     ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``), ``MXNET_NUM_WORKERS`` (or
     ``DMLC_NUM_WORKER``), ``MXNET_WORKER_ID`` (or ``DMLC_WORKER_ID``).
     No-ops when single-process and no coordinator is configured.
+
+    Fault tolerance (ISSUE 13): when supervised by ``tools/launch.py``
+    the rank starts its heartbeat BEFORE the rendezvous, so a rank
+    stuck dialing a dead coordinator still reads as alive-but-waiting.
+    The rendezvous itself is bounded — ``initialization_timeout``
+    seconds (``MXNET_INIT_TIMEOUT``, default 300; passed through to
+    ``jax.distributed`` where supported) per attempt, ``retries``
+    (``MXNET_INIT_RETRIES``, default 2) extra attempts with doubling
+    backoff — and a rendezvous that still cannot complete raises a
+    clean ``MXNetError`` naming the coordinator and rank instead of
+    blocking forever.
     """
+    from .heartbeat import start_heartbeat
+
+    start_heartbeat()
     if coordinator_address is None:
         coordinator_address = os.environ.get("MXNET_COORDINATOR")
         if coordinator_address is None:
@@ -149,8 +187,112 @@ def init_distributed(coordinator_address: Optional[str] = None,
             "MXNET_WORKER_ID", os.environ.get("DMLC_WORKER_ID", "0")))
     if coordinator_address is None and num_processes == 1:
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids)
+    if initialization_timeout is None:
+        initialization_timeout = _init_timeout_from_env()
+    if retries is None:
+        retries = _init_retries_from_env()
+    kwargs = dict(coordinator_address=coordinator_address,
+                  num_processes=num_processes,
+                  process_id=process_id,
+                  local_device_ids=local_device_ids)
+    # older jax has no bounded init — degrade to unbounded rather than
+    # TypeError (the retry loop still bounds total attempts)
+    if initialization_timeout is not None and "initialization_timeout" \
+            in inspect.signature(jax.distributed.initialize).parameters:
+        # jax takes whole seconds: round UP so a sub-second budget
+        # becomes 1s, never a truncated 0 (= immediate deadline)
+        kwargs["initialization_timeout"] = max(
+            math.ceil(float(initialization_timeout)), 1)
+    backoff, last = 1.0, None
+    for attempt in range(retries + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except Exception as e:  # rendezvous/transport failure
+            # genuine double-init is a programming error to surface
+            # verbatim, not a rendezvous failure to retry (jax's
+            # actual message is "...should only be called once.";
+            # older/other versions say "already initialized")
+            if "should only be called once" in str(e) \
+                    or "already initialized" in str(e):
+                raise
+            last = e
+            # a failed connect leaves jax's global distributed state
+            # assigned (verified against jax 0.4.x) — tear it down or
+            # every retry (including a CALLER-level one after the
+            # final attempt) dies on the double-init check instead of
+            # re-dialing the coordinator
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt < retries:
+                time.sleep(backoff)
+                backoff *= 2
+    raise MXNetError(
+        f"distributed init failed: rank {process_id}/{num_processes} "
+        f"could not rendezvous with coordinator {coordinator_address} "
+        f"after {retries + 1} attempt(s) of "
+        f"{initialization_timeout or 'unbounded'}s each "
+        f"(last error: {last!r}) — check that rank 0 is alive and the "
+        "address is reachable; MXNET_INIT_TIMEOUT / MXNET_INIT_RETRIES "
+        "tune the budget")
+
+
+def _barrier_timeout_from_env():
+    from ..base import parse_seconds
+
+    t = parse_seconds("MXNET_BARRIER_TIMEOUT",
+                      os.environ.get("MXNET_BARRIER_TIMEOUT", "0"))
+    return t if t > 0 else None
+
+
+def barrier(tag: str = "mxnet_barrier",
+            timeout: Optional[float] = None) -> None:
+    """Cross-process barrier with a bounded wait.
+
+    ``timeout`` seconds (default ``MXNET_BARRIER_TIMEOUT``; unset/0 =
+    wait forever, the pre-ISSUE-13 behavior) after which a clean
+    ``MXNetError`` names the coordinator instead of the process
+    blocking in the collective until an operator kills the job.  The
+    kvstore ``dist_sync`` barrier routes through this, so a dead peer
+    rank turns every survivor's next barrier into an error the
+    supervisor can act on.
+
+    On timeout the underlying collective cannot be cancelled — its
+    daemon thread is abandoned (it dies with the process; the process
+    group is unusable after a lost peer anyway).
+    """
+    if jax.process_count() == 1:
+        return
+    if timeout is None:
+        timeout = _barrier_timeout_from_env()
+    from jax.experimental import multihost_utils
+
+    if not timeout:
+        multihost_utils.sync_global_devices(tag)
+        return
+    done = threading.Event()
+    err = []
+
+    def _run():
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except Exception as e:
+            err.append(e)
+        finally:
+            done.set()
+
+    th = threading.Thread(target=_run, name="mxnet-barrier",
+                          daemon=True)
+    th.start()
+    if not done.wait(timeout):
+        raise MXNetError(
+            f"barrier {tag!r} timed out after {timeout}s waiting on "
+            f"the process group (rank {jax.process_index()} of "
+            f"{jax.process_count()}, coordinator "
+            f"{os.environ.get('MXNET_COORDINATOR', '?')}) — a peer "
+            "rank is dead or wedged; the collective thread is "
+            "abandoned")
+    if err:
+        raise MXNetError(f"barrier {tag!r} failed: {err[0]!r}")

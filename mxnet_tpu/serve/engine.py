@@ -3,9 +3,11 @@ batching server (``mxnet_tpu.serve.server``).
 
 One resident ``(NL, S, KV, T, D)`` K/V-cache pair is shared by all
 in-flight sequences; per-slot position / last-token / active / stop /
-sampling-key state rides as TRACED OPERANDS next to it, so admission and
-retirement are device-side masked updates — no recompile, no host sync
-in the step.  Three compiled units per pool size ``S``:
+sampling-key / wall-clock-deadline state rides as TRACED OPERANDS next
+to it, so admission and retirement — including deadline expiry against
+the step's ``now`` operand (ISSUE 13) — are device-side masked updates:
+no recompile, no host sync in the step.  Three compiled units per pool
+size ``S``:
 
 - **step** — ``_DecodeEngine.pool_token`` (the stacked-layer scan with
   per-slot positions) + per-slot sampling + retirement flags, jitted
@@ -47,8 +49,8 @@ __all__ = ["PoolPrograms", "pool_state_init", "pool_state_grow",
 
 
 # per-slot scalar state bytes: pos/tok/stop int32 (12) + active bool (1)
-# + PRNG key 2x uint32 (8) — see pool_state_init
-_SLOT_STATE_BYTES = 21
+# + PRNG key 2x uint32 (8) + deadline float32 (4) — see pool_state_init
+_SLOT_STATE_BYTES = 25
 
 
 def pool_state_bytes(eng, num_slots=None):
@@ -67,8 +69,13 @@ def pool_state_bytes(eng, num_slots=None):
 
 def pool_state_init(eng, device=None):
     """Fresh all-idle pool state for a ``PoolPrograms``' engine:
-    ``(ck, cv, pos, tok, active, stop, keys)`` — the traced-operand set
-    every step/admit executable threads through.
+    ``(ck, cv, pos, tok, active, stop, keys, deadline)`` — the
+    traced-operand set every step/admit executable threads through.
+    ``deadline`` is the per-slot wall-clock retirement budget (seconds
+    on the server's monotonic epoch; ``+inf`` = none), checked ON
+    DEVICE by the step against its ``now`` operand — deadline expiry
+    is a masked retirement exactly like EOS/budget, never an extra
+    dispatch (ISSUE 13).
 
     Every array is COMMITTED to ``device`` (default: the backend's
     first device).  jit keys its executable cache on each argument's
@@ -85,7 +92,8 @@ def pool_state_init(eng, device=None):
              jnp.zeros((S,), jnp.int32),          # tok: last sampled
              jnp.zeros((S,), jnp.bool_),          # active
              jnp.zeros((S,), jnp.int32),          # stop: retire position
-             jnp.zeros((S, 2), jnp.uint32))       # per-slot PRNG keys
+             jnp.zeros((S, 2), jnp.uint32),       # per-slot PRNG keys
+             jnp.full((S,), jnp.inf, jnp.float32))  # per-slot deadline
     return jax.device_put(state, device)
 
 
@@ -93,14 +101,16 @@ def pool_state_grow(state, new_s):
     """Pad every slot-axis array of ``state`` up to ``new_s`` slots (the
     new lanes come up idle).  Runs eagerly — pool growth happens at a
     step boundary, a handful of times per server lifetime."""
-    ck, cv, pos, tok, active, stop, keys = state
+    ck, cv, pos, tok, active, stop, keys, dl = state
     grow = new_s - ck.shape[1]
     if grow <= 0:
         raise MXNetError(f"pool can only grow: {ck.shape[1]} -> {new_s}")
     pad = lambda a, axis: jnp.pad(
         a, [(0, grow) if i == axis else (0, 0) for i in range(a.ndim)])
     grown = (pad(ck, 1), pad(cv, 1), pad(pos, 0), pad(tok, 0),
-             pad(active, 0), pad(stop, 0), pad(keys, 0))
+             pad(active, 0), pad(stop, 0), pad(keys, 0),
+             # idle-lane deadlines pad as +inf, matching pool_state_init
+             jnp.pad(dl, (0, grow), constant_values=jnp.inf))
     # committed placement, same contract as pool_state_init
     return jax.device_put(grown, list(ck.devices())[0])
 
@@ -156,41 +166,51 @@ class PoolPrograms:
 
         return jax.vmap(draw)(keys, lg, pos).astype(jnp.int32)
 
-    def _retire_flags(self, active, nxt, newpos, stop):
+    def _retire_flags(self, active, nxt, newpos, stop, now=None,
+                      deadline=None):
         done = active & (newpos >= stop)
         if self.eos_id is not None:
             done = done | (active & (nxt == self.eos_id))
+        if now is not None:
+            # wall-clock deadline expiry, folded into the SAME done
+            # mask as EOS/budget: retirement stays a masked device-side
+            # update, never an extra dispatch (inf = no deadline)
+            done = done | (active & (now >= deadline))
         return done
 
     # -- the decode step ------------------------------------------------ #
     def step_fn(self):
         """The jitted pool step (cached): ``step(param_vals, q8, sw,
-        ck, cv, pos, tok, active, stop, keys)`` → new state +
-        ``(emit_tok, emitted, done)`` readback arrays.  Caches are
-        donated — steady-state serving is one donated-buffer executable
-        dispatch per emitted token wave."""
+        now, ck, cv, pos, tok, active, stop, keys, deadline)`` → new
+        state + ``(emit_tok, emitted, done)`` readback arrays.  ``now``
+        is the host's monotonic clock (server-epoch seconds, a float32
+        scalar operand refreshed per dispatch — an operand, not a
+        constant, so it never retraces).  Caches are donated —
+        steady-state serving is one donated-buffer executable dispatch
+        per emitted token wave."""
         if self._step is not None:
             return self._step
         from ..gluon.parameter import params_swapped
 
         eng = self.eng
 
-        def step(param_vals, q8, sw, ck, cv, pos, tok, active, stop,
-                 keys):
+        def step(param_vals, q8, sw, now, ck, cv, pos, tok, active,
+                 stop, keys, dl):
             with _TRACE_LOCK, params_swapped(eng.params, param_vals):
                 logits, ck, cv = eng.pool_token(tok, pos, ck, cv, sw,
                                                 q8)
                 nxt = self._sample_slots(keys, logits, pos)
             nxt = jnp.where(active, nxt, tok)
             newpos = jnp.where(active, pos + 1, pos)
-            done = self._retire_flags(active, nxt, newpos, stop)
+            done = self._retire_flags(active, nxt, newpos, stop, now,
+                                      dl)
             emitted = active
             new_state = (ck, cv, newpos, nxt, active & ~done, stop,
-                         keys)
+                         keys, dl)
             return new_state, (nxt, emitted, done)
 
         self._step = telemetry.instrument_jit(
-            jax.jit(step, donate_argnums=(3, 4)), "serve.step",
+            jax.jit(step, donate_argnums=(4, 5)), "serve.step",
             key=(self.telemetry_label, self.S),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "cache_bytes": self.eng.cache_bytes()})
@@ -202,8 +222,9 @@ class PoolPrograms:
         ``a_bucket`` prompts right-padded to ``p_bucket`` tokens (cached
         per ``(A, P)`` bucket pair): ``admit(param_vals, prompts
         (A, P) int32, meta (A, 5) int32 rows = [valid, true_len, slot,
-        stop_pos, seed], ck, cv, pos, tok, active, stop, keys)`` →
-        new state + ``(first_tok (A,), done (A,))``.
+        stop_pos, seed], dls (A,) float32 per-row deadlines, ck, cv,
+        pos, tok, active, stop, keys, dl)`` → new state +
+        ``(first_tok (A,), done (A,))``.
 
         ONE causal prefill over the whole block fills every admitted
         slot's cache columns [0, P) via a masked device-side scatter
@@ -236,8 +257,8 @@ class PoolPrograms:
                              self.weights, "off", "auto")
         peng.take_operands()    # server-held operands are the only refs
 
-        def admit(param_vals, prompts, meta, ck, cv, pos, tok, active,
-                  stop, keys):
+        def admit(param_vals, prompts, meta, dls, ck, cv, pos, tok,
+                  active, stop, keys, dl):
             valid = meta[:, 0] != 0
             true_len, slot, stop_pos, seed = (meta[:, 1], meta[:, 2],
                                               meta[:, 3], meta[:, 4])
@@ -262,11 +283,12 @@ class PoolPrograms:
             active = active.at[tgt].set(~done, mode="drop")
             stop = stop.at[tgt].set(stop_pos, mode="drop")
             keys = keys.at[tgt].set(keys_a, mode="drop")
-            new_state = (ck, cv, pos, tok, active, stop, keys)
+            dl = dl.at[tgt].set(dls, mode="drop")
+            new_state = (ck, cv, pos, tok, active, stop, keys, dl)
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(admit, donate_argnums=(3, 4)), "serve.admit",
+            jax.jit(admit, donate_argnums=(4, 5)), "serve.admit",
             key=(self.telemetry_label, self.S, A, P),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "a_bucket": A, "p_bucket": P,

@@ -49,6 +49,19 @@ serving state: an over-budget pool growth or admission-scratch
 allocation raises a clean ``MXNetError`` naming requested vs available
 bytes instead of an allocator OOM.  ``stats()`` reports
 ``pool_bytes`` next to occupancy.
+
+Fault tolerance (ISSUE 13): ``submit(deadline=)`` /
+``MXNET_SERVE_DEADLINE`` give every request a wall-clock budget the
+STEP EXECUTABLE enforces (a per-slot deadline rides the slot-state
+vector next to the sampling keys; the step takes a ``now`` operand and
+folds expiry into the same device-side ``done`` mask as EOS — zero
+extra dispatches).  ``TokenStream.cancel()`` frees the slot at the
+next step boundary without touching co-resident lanes.  A scheduler
+watchdog fails every in-flight stream with the underlying error when
+the pump thread dies or a dispatch wedges past
+``MXNET_SERVE_STEP_TIMEOUT`` — no consumer ever blocks forever — and
+pump/admit/step are ``MXNET_FAULT_INJECT`` sites so all of it is
+exercised deterministically in tier-1 (docs/SERVING.md).
 """
 from __future__ import annotations
 
@@ -63,6 +76,7 @@ import numpy as onp
 
 from .. import telemetry
 from ..base import MXNetError
+from ..telemetry.faults import fault_point
 
 __all__ = ["DecodeServer", "TokenStream", "serve_counters",
            "reset_serve_counters"]
@@ -159,6 +173,31 @@ def _hbm_budget_from_env():
     return parse_bytes(raw, "MXNET_SERVE_HBM_BUDGET")
 
 
+def _parse_seconds(var, raw):
+    """A positive float seconds knob; unset/0 = None, malformed = loud
+    (the shared ``base.parse_seconds`` discipline)."""
+    from ..base import parse_seconds
+
+    val = parse_seconds(var, raw)
+    return val if val is not None and val > 0 else None
+
+
+def _default_deadline_from_env():
+    """``MXNET_SERVE_DEADLINE``: default per-request wall-clock budget
+    in seconds (submit(deadline=) wins); unset/0 = none."""
+    return _parse_seconds("MXNET_SERVE_DEADLINE",
+                          os.environ.get("MXNET_SERVE_DEADLINE"))
+
+
+def _step_timeout_from_env():
+    """``MXNET_SERVE_STEP_TIMEOUT``: seconds one scheduler pump
+    (admission + step dispatch + drain) may run before the watchdog
+    declares the dispatch wedged and fails all in-flight streams;
+    unset/0 = disabled."""
+    return _parse_seconds("MXNET_SERVE_STEP_TIMEOUT",
+                          os.environ.get("MXNET_SERVE_STEP_TIMEOUT"))
+
+
 def _pow2_ladder(start, top):
     """``start``, doubling, until ``top`` caps the ladder."""
     sizes, a = [], start
@@ -235,6 +274,8 @@ class TokenStream:
         self._toks = []
         self._done = threading.Event()
         self._error = None
+        self._cancel_hook = None   # wired by DecodeServer.submit
+        self._cancelled = False
 
     # -- producer side (server loop) ------------------------------------ #
     @property
@@ -246,6 +287,10 @@ class TokenStream:
         return self.times[0] - self.submit_time if self.times else None
 
     def _push(self, tok):
+        if self._done.is_set():
+            # a late in-flight readback for a cancelled / deadline-
+            # retired slot: the stream's token list is sealed
+            return
         self.times.append(time.perf_counter())
         with self._cv:
             self._toks.append(tok)
@@ -286,8 +331,35 @@ class TokenStream:
     def done(self):
         return self._done.is_set()
 
+    @property
+    def cancelled(self):
+        """True once :meth:`cancel` has taken effect (the stream is
+        done with the tokens that arrived before cancellation)."""
+        return self._cancelled
+
+    def cancel(self):
+        """Cancel this request: a queued request is dropped
+        immediately; an in-flight one has its pool slot freed at the
+        NEXT STEP BOUNDARY by the scheduler — co-resident streams are
+        untouched and no extra executable dispatch is spent (the lane
+        is simply unmapped host-side, like any retired slot).  The
+        stream finishes cleanly with the tokens received so far;
+        idempotent, and a no-op once the request already retired.
+        Returns True if the cancellation took effect."""
+        hook = self._cancel_hook
+        if hook is None:
+            raise MXNetError(
+                f"stream {self.request_id} is not cancellable "
+                "(not attached to a server)")
+        return hook()
+
     def tokens(self, timeout=None):
-        """Block until the request retires; return the full token list."""
+        """Block until the request retires; return the full token list.
+
+        A timeout raises ``MXNetError`` but consumes nothing: the
+        stream keeps filling, and the same consumer may call
+        :meth:`tokens` (or iterate) again later and still drain the
+        full stream."""
         if not self._done.wait(timeout):
             raise MXNetError(f"request {self.request_id} not finished "
                              f"within {timeout}s")
@@ -309,13 +381,21 @@ class TokenStream:
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "seed", "stream", "span")
+    __slots__ = ("prompt", "max_new", "seed", "stream", "span",
+                 "deadline", "cancelled", "retired")
 
-    def __init__(self, prompt, max_new, seed, stream):
+    def __init__(self, prompt, max_new, seed, stream, deadline=None):
         self.prompt = prompt
         self.max_new = max_new
         self.seed = seed
         self.stream = stream
+        # absolute wall-clock retirement budget on the server's
+        # monotonic clock (None = no deadline); rides the slot-state
+        # vector device-side once admitted
+        self.deadline = deadline
+        self.cancelled = False
+        self.retired = False    # span closed (guards double-observe on
+        # the cancel-vs-drain and teardown-after-failure races)
         # request-span telemetry, filled in at admission and emitted as
         # one ``serve_request`` event at retirement (docs/TELEMETRY.md)
         self.span = {}
@@ -341,11 +421,39 @@ class DecodeServer:
                  temperature=0.0, top_k=0, eos_id=None,
                  weights="native", max_pending=256, detokenize=None,
                  admit_sizes=None, prefill_buckets=None,
-                 hbm_budget=None, autostart=True):
+                 hbm_budget=None, default_deadline=None,
+                 step_timeout=None, autostart=True):
         from ..telemetry.memory import parse_bytes
         from .engine import PoolPrograms, pool_state_init
 
         self.model = model
+        # fault-tolerance knobs (ISSUE 13): the server's monotonic
+        # clock (monkeypatchable in tests for deterministic deadline
+        # expiry) and its epoch — per-slot deadlines ride the state
+        # vector as float32 seconds RELATIVE to the epoch, so float32
+        # precision is spent on the server's lifetime, not on host
+        # uptime
+        self._clock = time.monotonic
+        self._epoch = self._clock()
+        self.default_deadline = default_deadline \
+            if default_deadline is not None \
+            else _default_deadline_from_env()
+        if self.default_deadline is not None \
+                and self.default_deadline <= 0:
+            raise MXNetError("default_deadline must be positive seconds")
+        self.step_timeout = step_timeout if step_timeout is not None \
+            else _step_timeout_from_env()
+        if self.step_timeout is not None and self.step_timeout <= 0:
+            self.step_timeout = None   # 0 = wedge detection off, same
+            # as the env path (a 0 budget would hair-trigger on every
+            # in-progress pump at the watchdog's next poll)
+        self._fatal = None          # the error the scheduler died with
+        self._torn = False          # _teardown ran: the pool was
+        # released and unaccounted — a wedged dispatch completing late
+        # must not re-pin it (see _dispatch_step/_dispatch_admit)
+        self._watchdog = None
+        self._pump_t0 = None        # monotonic start of the loop's
+        # current pump (None between pumps); read by the watchdog
         self.T = int(max_total_len if max_total_len is not None
                      else model._cfg.max_length)
         self.pool_sizes = tuple(pool_sizes) if pool_sizes is not None \
@@ -481,29 +589,59 @@ class DecodeServer:
             prefill_buckets=list(self.prefill_buckets),
             max_total_len=self.T, sync_mode=self.sync_mode,
             sync_reason=self.sync_reason,
-            hbm_budget=self.hbm_budget, pool_bytes=self._pool_bytes)
+            hbm_budget=self.hbm_budget, pool_bytes=self._pool_bytes,
+            default_deadline=self.default_deadline,
+            step_timeout=self.step_timeout)
         if autostart:
             self.start()
 
     # -- public API ------------------------------------------------------ #
     def start(self):
         """Start the background scheduler thread (no-op if one is
-        already running).  ``autostart=False`` + a later ``start()``
-        lets the owner warm the compiled programs pump-driven first,
-        then hand the loop to the thread — ``benchmark/serve_bench.py``
-        uses this to keep compiles off the measured clock."""
+        already running), plus its watchdog: the watchdog fails every
+        in-flight stream with the underlying error when the scheduler
+        thread dies without cleanup, or when one pump wedges past
+        ``step_timeout`` / ``MXNET_SERVE_STEP_TIMEOUT`` — no consumer
+        ever blocks forever on a dead pump.  ``autostart=False`` + a
+        later ``start()`` lets the owner warm the compiled programs
+        pump-driven first, then hand the loop to the thread —
+        ``benchmark/serve_bench.py`` uses this to keep compiles off
+        the measured clock."""
         with self._work:
             if self._stopping:
-                raise MXNetError("server is closed")
+                raise self._closed_error()
             if self._thread is not None and self._thread.is_alive():
                 return
             self._thread = threading.Thread(
                 target=self._loop, name="mxnet-serve", daemon=True)
             self._thread.start()
+            if self._watchdog is None or not self._watchdog.is_alive():
+                self._watchdog = threading.Thread(
+                    target=self._watch, name="mxnet-serve-watchdog",
+                    daemon=True)
+                self._watchdog.start()
+
+    def _closed_error(self):
+        """The submit/start error after the server stopped: names the
+        scheduler's fatal error when it died, plain "closed" after a
+        clean close()."""
+        if self._fatal is not None:
+            return MXNetError(
+                f"server failed and stopped serving: {self._fatal}")
+        return MXNetError("server is closed")
 
     def submit(self, prompt_tokens, max_new_tokens=32, seed=0,
-               nowait=False, on_token=None):
+               nowait=False, on_token=None, deadline=None):
         """Queue one request; returns its :class:`TokenStream`.
+
+        ``deadline`` (seconds, default ``default_deadline`` /
+        ``MXNET_SERVE_DEADLINE``) is the request's wall-clock budget
+        measured from submit: when it expires the sequence is retired
+        DEVICE-SIDE at the next step boundary (the per-slot deadline
+        rides the slot-state vector; no extra dispatch) with the
+        tokens produced so far and reason ``deadline_exceeded``; a
+        request whose deadline lapses while still queued is retired at
+        the admission boundary without occupying a slot.
 
         Blocks while ``max_pending`` requests are already queued
         (``nowait=True`` raises instead — pool-full backpressure is a
@@ -538,9 +676,16 @@ class DecodeServer:
             raise MXNetError(
                 f"seed {seed} does not fit int32 — fold larger seeds "
                 "on the host before submitting")
+        if deadline is None:
+            deadline = self.default_deadline
+        if deadline is not None and deadline <= 0:
+            raise MXNetError(
+                f"deadline {deadline} must be positive seconds")
+        abs_deadline = None if deadline is None \
+            else self._clock() + deadline
         with self._work:
             if self._stopping:
-                raise MXNetError("server is closed")
+                raise self._closed_error()
             while len(self._pending) >= self.max_pending:
                 if nowait:
                     raise MXNetError(
@@ -556,12 +701,13 @@ class DecodeServer:
                         "pump() to drain, or submit(nowait=True)")
                 self._work.wait(0.05)
                 if self._stopping:
-                    raise MXNetError("server is closed")
+                    raise self._closed_error()
             stream = TokenStream(self._next_id, self._detok, on_token)
             self._next_id += 1
-            self._pending.append(
-                _Request(prompt, int(max_new_tokens), int(seed),
-                         stream))
+            req = _Request(prompt, int(max_new_tokens), int(seed),
+                           stream, deadline=abs_deadline)
+            stream._cancel_hook = lambda: self._cancel(req)
+            self._pending.append(req)
             self._work.notify_all()
         return stream
 
@@ -613,8 +759,10 @@ class DecodeServer:
     def close(self, drain=True, timeout=60.0):
         """Stop the scheduler.  ``drain=True`` serves everything already
         submitted first; otherwise queued/in-flight requests fail with
-        a server-closed error."""
-        deadline = time.time() + timeout
+        a server-closed error.  Deadline arithmetic is monotonic — a
+        wall-clock (NTP) step during the drain cannot turn the budget
+        into an instant or an infinite timeout."""
+        deadline = time.monotonic() + timeout
         if drain:
             while (self._pending or
                    any(r is not None for r in self._slots) or
@@ -627,7 +775,7 @@ class DecodeServer:
                     # "call close() again" actually finishes the drain
                     if not self.pump():
                         break
-                elif time.time() > deadline:
+                elif time.monotonic() > deadline:
                     raise MXNetError("close(drain=True) timed out")
                 else:
                     time.sleep(0.002)
@@ -635,7 +783,8 @@ class DecodeServer:
             self._stopping = True
             self._work.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=max(deadline - time.time(), 0.1))
+            self._thread.join(
+                timeout=max(deadline - time.monotonic(), 0.1))
             if self._thread.is_alive():
                 # the scheduler is mid-pump (e.g. a pool-growth retrace
                 # compiling) and owns _slots/_inflight — tearing them
@@ -647,6 +796,8 @@ class DecodeServer:
                     "thread (still inside a dispatch/retrace); it "
                     "stops at the next step boundary — call close() "
                     "again to finish teardown")
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=1.0)   # exits on _stopping
         self._flush_drain(final=True)
         self._emit_stats()
         self._teardown(MXNetError("server closed"), reason="closed")
@@ -669,12 +820,18 @@ class DecodeServer:
 
     # -- scheduler ------------------------------------------------------- #
     def pump(self):
-        """One scheduler round: admissions, one step dispatch, drain.
-        Returns True if any work happened (False = fully idle: nothing
-        pending, nothing in flight — the loop thread sleeps on that)."""
+        """One scheduler round: cancellations, admissions, one step
+        dispatch, drain.  Returns True if any work happened (False =
+        fully idle: nothing pending, nothing in flight — the loop
+        thread sleeps on that)."""
+        fault_point("serve.pump", server=self.telemetry_label)
+        # cancellations FIRST: a cancelled slot frees at this step
+        # boundary, so the admission below can re-fill it in the same
+        # pump — no wasted masked lane, no extra dispatch
+        worked = self._process_cancels()
         if self.sync_mode:
-            return self._pump_sync()
-        worked = self._admit_pending()
+            return self._pump_sync() or worked
+        worked |= self._admit_pending()
         stepped = False
         if any(r is not None for r in self._slots):
             self._dispatch_step()
@@ -691,6 +848,7 @@ class DecodeServer:
             with self._work:
                 if self._stopping:
                     return
+            self._pump_t0 = self._clock()   # the watchdog's wedge gauge
             try:
                 worked = self.pump()
             except Exception as e:
@@ -700,6 +858,8 @@ class DecodeServer:
                 # streams with the error and stop serving
                 self._fail_all(e)
                 return
+            finally:
+                self._pump_t0 = None
             if not worked:
                 with self._work:
                     if self._stopping:
@@ -707,14 +867,135 @@ class DecodeServer:
                     if not self._pending and not self._inflight:
                         self._work.wait(0.05)
 
+    def _watch_dispatch(self, fn):
+        """Re-arm the wedge gauge for one dispatch — or SUSPEND it when
+        ``fn`` has never compiled: a legitimate first-request /
+        pool-growth jit compile can take far longer than any sane
+        ``step_timeout``, and the watchdog must not kill a healthy
+        server for it.  (Run on the scheduler thread only; _pump_t0 is
+        cleared by _loop after the pump either way.)"""
+        if self._pump_t0 is None:
+            return   # pump-driven (no loop thread): nothing to gauge
+        cache_size = getattr(fn, "_cache_size", None)
+        if cache_size is not None and cache_size() == 0:
+            self._pump_t0 = None     # cold program: compile, not wedge
+        else:
+            self._pump_t0 = self._clock()   # per-dispatch budget
+
+    def _watch(self):
+        """Scheduler watchdog (daemon, started next to the loop
+        thread): fails all in-flight streams when the pump thread DIES
+        without running its own failure path (a BaseException, a
+        crashed C extension — an Exception inside pump() is already
+        handled by ``_loop``), or when one pump WEDGES past
+        ``step_timeout`` (a hung dispatch: the thread cannot be
+        recovered, but every consumer gets the error instead of
+        blocking forever).  Exits when the server stops."""
+        while True:
+            with self._work:
+                if self._stopping:
+                    return
+            th = self._thread
+            if th is not None and not th.is_alive():
+                with self._work:
+                    if self._stopping:
+                        return   # clean close() raced the aliveness
+                        # check: the thread exited BECAUSE we stopped
+                self._watchdog_fire("scheduler thread died without "
+                                    "running its failure path")
+                return
+            t0 = self._pump_t0
+            if self.step_timeout is not None and t0 is not None \
+                    and self._clock() - t0 > self.step_timeout:
+                self._watchdog_fire(
+                    f"scheduler pump wedged for more than "
+                    f"step_timeout={self.step_timeout}s "
+                    "(MXNET_SERVE_STEP_TIMEOUT) — a dispatch is hung")
+                return
+            time.sleep(0.05)
+
+    def _watchdog_fire(self, why):
+        telemetry.emit("watchdog_fired", server=self.telemetry_label,
+                       reason=why)
+        telemetry.counter("serve_watchdog_fired_total",
+                          server=self.telemetry_label).inc()
+        self._fail_all(MXNetError(
+            f"serve watchdog fired: {why}; all in-flight streams "
+            "failed"))
+
     def _fail_all(self, exc):
         err = exc if isinstance(exc, MXNetError) else \
             MXNetError(f"serving loop failed: {exc!r}")
+        self._fatal = err   # submit()/start() raise this from now on
         with self._work:
             self._stopping = True
             self._work.notify_all()
         self._inflight.clear()   # readbacks are dropped, not routed
         self._teardown(err)
+
+    # cancellation --------------------------------------------------------- #
+    def _cancel(self, req):
+        """Cross-thread cancellation entry (``TokenStream.cancel``).
+        A queued request is dropped and finished HERE; an admitted one
+        is only FLAGGED — its slot frees on the scheduler thread at
+        the next step boundary (``_process_cancels``), so co-resident
+        lanes never see a mid-step state edit.  Idempotent; False once
+        the request already retired."""
+        with self._work:
+            if req.retired or req.stream.done:
+                return False
+            queued = req in self._pending
+            if self.sync_mode and not queued:
+                # sync fallback mid-kv_generate: there are no step
+                # boundaries to retire at, so cancellation cannot take
+                # effect — report failure rather than lie (the
+                # slot-pool path is where cancel is real;
+                # docs/SERVING.md)
+                return False
+            already = req.cancelled
+            req.cancelled = True
+            in_queue = False
+            if not already and queued:
+                self._pending.remove(req)
+                in_queue = True
+            self._work.notify_all()
+        if in_queue:
+            self._retire_aside(req, "cancelled")
+        return True
+
+    def _process_cancels(self):
+        """Free cancelled requests' slots at the step boundary (the
+        scheduler thread; also the pump-driven path).  The device lane
+        itself is left alone — like any retired slot it keeps
+        computing masked until re-admission overwrites it — so the
+        retirement costs ZERO extra dispatches and cannot perturb
+        co-resident streams."""
+        with self._lock:
+            hit = [(i, r) for i, r in enumerate(self._slots)
+                   if r is not None and r.cancelled]
+            for i, _r in hit:
+                self._slots[i] = None
+            if hit:
+                self._work.notify_all()
+        for _i, r in hit:
+            self._retire_aside(r, "cancelled")
+        # queued cancellations normally drop in _cancel; this sweeps
+        # any that raced the pending-pop
+        with self._lock:
+            stale = [r for r in self._pending if r.cancelled]
+            for r in stale:
+                self._pending.remove(r)
+        for r in stale:
+            self._retire_aside(r, "cancelled")
+        return bool(hit) or bool(stale)
+
+    def _retire_aside(self, req, reason):
+        """Finish a stream OUTSIDE the normal drain path (cancelled, or
+        deadline-lapsed while queued): the stream seals with whatever
+        tokens arrived, the span closes with ``reason``."""
+        req.stream._cancelled = reason == "cancelled"
+        req.stream._finish()
+        self._observe_retire(req, reason)
 
     def _teardown(self, err, reason="error"):
         """Fail every queued and in-flight request with ``err``.  The
@@ -723,6 +1004,10 @@ class DecodeServer:
         callers) that may immediately re-enter submit()/stats()."""
         from ..telemetry.memory import ACCOUNTANT
 
+        # ordering matters: flag FIRST, then release — a concurrent
+        # wedged dispatch that assigns self._state after our None sees
+        # the flag and releases its own result (no re-pin window)
+        self._torn = True
         # the pool buffers die with the server: RELEASE them (drop the
         # state refs so the device memory is actually freed, not just
         # unaccounted) and retire the ledger entry + stats() mirror
@@ -824,6 +1109,11 @@ class DecodeServer:
         # not a condition to silently serve degraded through
         self._check_budget(new_s, scratch=self._pool_bytes,
                            what=f"pool growth {S} -> {new_s} slots")
+        # growth compiles (eager state pad now, fresh step/admit
+        # programs at their first dispatch): suspend the watchdog's
+        # wedge gauge for the rest of this pump — a retrace is slow,
+        # not wedged
+        self._pump_t0 = None
         progs = PoolPrograms(self.model, new_s, self.T,
                              self.temperature, self.top_k, self.eos_id,
                              self.weights,
@@ -888,17 +1178,31 @@ class DecodeServer:
             # pop + record into the slot table ATOMICALLY: a request
             # must never be invisible to close(drain=True)'s "anything
             # outstanding?" predicate (or to _fail_all) while its
-            # admission dispatch is still being built
-            wave = []
+            # admission dispatch is still being built.  Cancelled or
+            # already-deadline-lapsed requests retire HERE, at the
+            # admission boundary, without ever occupying a slot.
+            wave, dropped = [], []
+            now = self._clock()
             with self._lock:
                 while self._pending and len(wave) < limit:
                     req = self._pending.popleft()
+                    if req.cancelled or (req.deadline is not None
+                                         and now >= req.deadline):
+                        dropped.append(req)
+                        continue
                     slot = free[len(wave)]
                     self._slots[slot] = req
                     wave.append((slot, req))
-                if wave:
+                if wave or dropped:
                     self._work.notify_all()
+            for req in dropped:
+                self._retire_aside(
+                    req, "cancelled" if req.cancelled
+                    else "deadline_exceeded")
+            admitted |= bool(dropped)
             if not wave:
+                if dropped:
+                    continue   # the backlog behind the drops may fit
                 break
             self._dispatch_admit(wave)
             admitted = True
@@ -919,6 +1223,8 @@ class DecodeServer:
         fits the wave, P = smallest pinned prompt bucket that fits the
         wave's longest prompt (submit() already guaranteed the fit).
         Rows beyond the wave are masked no-ops on device."""
+        fault_point("serve.admit", server=self.telemetry_label,
+                    wave=len(wave))
         A = _bucket_for(self.admit_sizes, len(wave))
         P = _bucket_for(self.prefill_buckets,
                         max(req.prompt.size for _, req in wave))
@@ -927,15 +1233,22 @@ class DecodeServer:
         # table (wave size <= the priced limit, so A here never
         # exceeds the checked bucket)
         fn = self._progs.admit_fn(A, P)
+        self._watch_dispatch(fn)
         prompts = onp.zeros((A, P), onp.int32)
         # idle rows: valid=0 (their scatter drops on device); true_len
         # stays 1 so the per-row last-index gather reads a real column
         meta = onp.zeros((A, 5), onp.int32)
         meta[:, 1] = 1
+        # per-row wall-clock deadlines (server-epoch seconds; +inf =
+        # none), scattered into the slot-state deadline vector the
+        # step checks device-side
+        dls = onp.full((A,), onp.inf, onp.float32)
         for i, (slot, req) in enumerate(wave):
             n = req.prompt.size
             prompts[i, :n] = req.prompt
             meta[i] = (1, n, slot, n + req.max_new - 1, req.seed)
+            if req.deadline is not None:
+                dls[i] = req.deadline - self._epoch
         # request-span admission fields + one serve_admit event per
         # dispatch (waves are step-boundary-rare, not per-token)
         now = time.perf_counter()
@@ -954,18 +1267,36 @@ class DecodeServer:
         param_vals, q8, sw = self._progs.operands
         with telemetry.annotation("mx:serve:admit"):
             new_state, (first, done) = fn(param_vals, prompts, meta,
-                                          *self._state)
+                                          dls, *self._state)
         self._state = new_state
+        if self._torn:
+            # the watchdog tore the server down while this dispatch was
+            # wedged: the accountant already reported the pool freed —
+            # drop the late result instead of re-pinning it
+            self._state = None
+            return
         self._count("admit_dispatches")
         self._inflight.append(("admit", (first, done), list(wave)))
 
     # the step ------------------------------------------------------------ #
     def _dispatch_step(self):
+        fault_point("serve.step", server=self.telemetry_label)
+        self._watch_dispatch(self._progs.step_fn())
         param_vals, q8, sw = self._progs.operands
+        # the step's wall clock: a float32 OPERAND (same aval every
+        # call — never a retrace), against which the executable checks
+        # every slot's deadline
+        now = onp.float32(self._clock() - self._epoch)
         with telemetry.annotation("mx:serve:step"):
             new_state, out = self._progs.step_fn()(
-                param_vals, q8, sw, *self._state)
+                param_vals, q8, sw, now, *self._state)
         self._state = new_state
+        if self._torn:
+            # late completion of a wedged dispatch after watchdog
+            # teardown: don't re-pin the released pool (the gauge and
+            # stats() already report 0 bytes)
+            self._state = None
+            return
         self._count("step_dispatches")
         self._steps += 1
         busy = sum(r is not None for r in self._slots)
@@ -995,11 +1326,14 @@ class DecodeServer:
         first = onp.asarray(arrays[0])
         done = onp.asarray(arrays[1])
         for i, (slot, req) in enumerate(wave):
+            if req.cancelled:
+                continue   # retired aside; the lane's output is void
             tok = int(first[i])
             req.stream._push(tok)
             if done[i]:
                 req.stream._finish()
-                self._observe_retire(req, self._retire_reason(tok))
+                self._observe_retire(req,
+                                     self._retire_reason(req, tok))
                 with self._lock:
                     if self._slots[slot] is req:
                         self._slots[slot] = None
@@ -1022,32 +1356,58 @@ class DecodeServer:
                 toks, emitted, done = (onp.asarray(a) for a in arrays)
                 snapshot = meta
                 for slot, req in enumerate(snapshot):
-                    if req is None or not emitted[slot]:
+                    if req is None or req.cancelled \
+                            or not emitted[slot]:
                         continue
                     tok = int(toks[slot])
                     req.stream._push(tok)
                     if done[slot]:
                         req.stream._finish()
-                        self._observe_retire(req,
-                                             self._retire_reason(tok))
+                        self._observe_retire(
+                            req, self._retire_reason(req, tok))
                         with self._lock:
                             if self._slots[slot] is req:
                                 self._slots[slot] = None
         return worked
 
     # request-span telemetry ------------------------------------------------ #
-    def _retire_reason(self, last_tok):
-        """The step/admit executables fold EOS and budget exhaustion
-        into one ``done`` flag; the host recovers which fired from the
-        final token (EOS wins when both land on the same token)."""
-        return "eos" if self.eos_id is not None \
-            and last_tok == self.eos_id else "max_len"
+    def _retire_reason(self, req, last_tok):
+        """The step/admit executables fold EOS, budget exhaustion and
+        deadline expiry into one ``done`` flag; the host recovers
+        which fired from the final token and the emitted count (EOS
+        wins when several land on the same token; a full budget is
+        ``max_len`` whether or not a deadline was also set)."""
+        if self.eos_id is not None and last_tok == self.eos_id:
+            return "eos"
+        if len(req.stream._toks) >= req.max_new:
+            return "max_len"
+        if req.deadline is not None:
+            return "deadline_exceeded"
+        return "max_len"
 
     def _observe_retire(self, req, reason):
         """Close a request's span: registry observations (TTFT,
         inter-token gaps, requests-by-reason) + one ``serve_request``
-        event.  Runs on the drain path at retirement only — never per
-        token, never under ``_lock``."""
+        event, plus the dedicated failure-cause events
+        (``deadline_exceeded`` / ``request_cancelled``) the failure
+        report aggregates.  Runs at retirement only — never per token,
+        never under ``_lock`` — and exactly once per request (the
+        ``retired`` flag guards the cancel-vs-drain and
+        teardown-after-failure races)."""
+        if req.retired:
+            return
+        req.retired = True
+        if reason == "deadline_exceeded":
+            telemetry.emit("deadline_exceeded",
+                           server=self.telemetry_label,
+                           request_id=req.stream.request_id,
+                           tokens=len(req.stream._toks),
+                           max_new=req.max_new)
+        elif reason == "cancelled":
+            telemetry.emit("request_cancelled",
+                           server=self.telemetry_label,
+                           request_id=req.stream.request_id,
+                           tokens=len(req.stream._toks))
         st = req.stream
         sp = req.span
         ttft = st.ttft
@@ -1078,6 +1438,15 @@ class DecodeServer:
         req = self._take_pending()
         if req is None:
             return False
+        if req.cancelled:
+            self._retire_aside(req, "cancelled")
+            return True
+        if req.deadline is not None and self._clock() >= req.deadline:
+            # queue-lapsed deadline; the sync fallback cannot retire
+            # MID-generation (no step boundaries), so this pre-check
+            # is the whole deadline story here (docs/SERVING.md)
+            self._retire_aside(req, "deadline_exceeded")
+            return True
         self._count("sync_requests")
         wait = time.perf_counter() - req.stream.submit_time
         req.span["queue_wait_s"] = wait
@@ -1104,7 +1473,7 @@ class DecodeServer:
                 req.stream._finish()
             self._observe_retire(
                 req, "max_len" if last is None
-                else self._retire_reason(last))
+                else self._retire_reason(req, last))
         except Exception as e:                 # surface, don't hang
             req.stream._finish(e)
             self._observe_retire(req, "error")

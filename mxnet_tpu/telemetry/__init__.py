@@ -30,6 +30,12 @@ code, hand-called ``profiler_xla.hlo_op_count``):
   arithmetic behind ``MXNET_SERVE_HBM_BUDGET`` / ``tools/
   memory_report.py``.
 
+- **fault injection** (:mod:`.faults`, ISSUE 13): deterministic
+  env-armed failures (``MXNET_FAULT_INJECT=site:kind:after_n``) at
+  named sites in the serve scheduler, kvstore, and launch heartbeats,
+  so every recovery path is exercisable in tier-1 on CPU; each firing
+  emits a ``fault_injected`` event.  Free when unset.
+
 ``MXNET_TELEMETRY=0`` disables event emission and un-wraps the compile
 watch (the registry itself stays live — ``DecodeServer.counters`` and
 friends are views over it).  See docs/TELEMETRY.md.
@@ -43,6 +49,7 @@ from . import memory
 from .compile import instrument_jit
 from .events import (JsonlSink, add_jsonl_sink, add_sink, clear_events,
                      emit, events, remove_sink, telemetry_enabled)
+from .faults import fault_point, parse_fault_spec, reset_faults
 from .memory import (ACCOUNTANT, MemoryAccountant, format_bytes,
                      live_device_bytes, mem_enabled, memory_analysis,
                      nbytes_of, parse_bytes, per_device_bytes, reconcile)
@@ -56,6 +63,7 @@ __all__ = [
     "reset_metrics", "DEFAULT_LATENCY_BUCKETS",
     "emit", "events", "clear_events", "add_sink", "remove_sink",
     "add_jsonl_sink", "JsonlSink", "telemetry_enabled",
+    "fault_point", "parse_fault_spec", "reset_faults",
     "instrument_jit", "annotation", "span",
     "memory", "ACCOUNTANT", "MemoryAccountant", "memory_analysis",
     "mem_enabled", "nbytes_of", "per_device_bytes", "live_device_bytes",

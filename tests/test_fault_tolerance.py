@@ -1,0 +1,916 @@
+"""Fault-tolerant runtime (ISSUE 13): the fault-injection harness,
+serving deadlines / cancellation / the scheduler watchdog, bounded
+distributed init + barriers, the kvstore server's per-request error
+replies, and the failure-cause report.
+
+The serving chaos gauntlet pins the acceptance bars: a fault-injected
+scheduler death fails all in-flight streams with the underlying error
+while submit() raises cleanly afterward; a deadline-expired and a
+cancelled request each free their pool slot at a step boundary with
+co-resident streams token-identical to an undisturbed run (greedy and
+sampled), at ONE executable dispatch per decode step — retirement
+costs zero extra dispatches (the launch-supervisor half of the
+gauntlet lives in tests/test_launch_supervised.py).
+"""
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry import faults
+
+
+def _gpt(layers=2, units=32, heads=4, hidden=64, vocab=97,
+         max_length=64):
+    from mxnet_tpu.models import GPT, GPTConfig
+    mx.random.seed(0)
+    net = GPT(GPTConfig(vocab_size=vocab, max_length=max_length,
+                        num_layers=layers, units=units, num_heads=heads,
+                        hidden_size=hidden))
+    net.initialize(mx.init.Normal(0.02))
+    return net
+
+
+def _prompt(seed, n, vocab=97):
+    return onp.random.RandomState(seed).randint(0, vocab, (n,))
+
+
+def _drain(server):
+    while server.pump():
+        pass
+
+
+def _ref(net, prompt, n, **kw):
+    from mxnet_tpu.models import kv_generate
+    kw.setdefault("temperature", 0.0)
+    return list(kv_generate(net, prompt[None], max_new_tokens=n,
+                            **kw)[0, prompt.size:])
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _gpt()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    """Every test starts with zeroed fault counters (the spec env is
+    per-test via monkeypatch; the hit counts are process-global)."""
+    faults.reset_faults()
+    yield
+    faults.reset_faults()
+
+
+class _FakeClock:
+    """Deterministic stand-in for DecodeServer._clock: deadline expiry
+    becomes a scripted event, not a wall-clock race."""
+
+    def __init__(self, start):
+        self.t = float(start)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------------- #
+# the injection harness itself
+# --------------------------------------------------------------------- #
+
+class TestFaultHarness:
+    def test_parse_spec(self):
+        rules = faults.parse_fault_spec(
+            "serve.step:raise:3, kvstore.push:delay:1:0.5")
+        assert rules[0] == ("serve.step", "raise", 3, None)
+        assert rules[1] == ("kvstore.push", "delay", 1, 0.5)
+
+    @pytest.mark.parametrize("bad", ["serve.step", "x:boom:1",
+                                     "x:raise:zero", "x:raise:0",
+                                     ":raise:1"])
+    def test_malformed_spec_rejected(self, bad):
+        with pytest.raises(MXNetError, match="MXNET_FAULT_INJECT"):
+            faults.parse_fault_spec(bad)
+
+    def test_unset_is_noop(self, monkeypatch):
+        monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+        telemetry.clear_events()
+        for _ in range(3):
+            faults.fault_point("anywhere")   # no raise, no event
+        assert telemetry.events("fault_injected") == []
+
+    def test_fires_once_on_nth_hit(self, monkeypatch):
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "t.site:raise:3")
+        faults.reset_faults()
+        telemetry.clear_events()
+        faults.fault_point("t.site")
+        faults.fault_point("t.other")        # other sites don't count
+        faults.fault_point("t.site")
+        with pytest.raises(MXNetError, match="injected fault at t.site"):
+            faults.fault_point("t.site")
+        faults.fault_point("t.site")         # single-shot: hit 4 passes
+        evs = telemetry.events("fault_injected")
+        assert len(evs) == 1
+        assert evs[0]["site"] == "t.site"
+        assert evs[0]["fault_kind"] == "raise"
+
+    def test_kvstore_site_fires_with_context(self, monkeypatch):
+        """Post-review regression: the kvstore sites pass store-kind
+        context; an armed rule there must inject the fault (and emit
+        its event), not die on an emit() kwarg collision."""
+        from mxnet_tpu.kvstore import create
+
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "kvstore.push:raise:1")
+        faults.reset_faults()
+        telemetry.clear_events()
+        kv = create("local")
+        kv.init("k", mx.nd.zeros(2))
+        with pytest.raises(MXNetError, match="injected fault at "
+                                             "kvstore.push"):
+            kv.push("k", mx.nd.ones(2))
+        evs = telemetry.events("fault_injected")
+        assert evs and evs[0]["site"] == "kvstore.push"
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        kv.push("k", mx.nd.ones(2))          # store still healthy
+
+    def test_reserved_context_keys_are_prefixed(self, monkeypatch):
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "t.ctx:delay:1:0.001")
+        faults.reset_faults()
+        telemetry.clear_events()
+        faults.fault_point("t.ctx", kind="colliding", ts="also")
+        ev = telemetry.events("fault_injected")[-1]
+        assert ev["site"] == "t.ctx"         # the rule's site wins
+        assert ev["fault_kind"] == "delay"   # ...and the rule's kind
+        assert ev["ctx_kind"] == "colliding"
+        assert ev["ctx_ts"] == "also"
+
+    def test_unset_then_rearm_same_spec_fires_again(self, monkeypatch):
+        """Post-review regression: unsetting the spec drops the cache,
+        so re-arming the IDENTICAL spec later (a second chaos run in
+        one process) fires instead of inheriting the stale fired-set."""
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "t.re:raise:1")
+        faults.reset_faults()
+        with pytest.raises(MXNetError, match="injected fault"):
+            faults.fault_point("t.re")
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        faults.fault_point("t.re")           # unset: no-op, cache drops
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "t.re:raise:1")
+        with pytest.raises(MXNetError, match="injected fault"):
+            faults.fault_point("t.re")       # same spec re-fires
+
+    def test_delay_and_counter(self, monkeypatch):
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "t.slow:delay:1:0.05")
+        faults.reset_faults()
+        t0 = time.monotonic()
+        faults.fault_point("t.slow")
+        assert time.monotonic() - t0 >= 0.04
+        rows = telemetry.snapshot().get("faults_injected_total", [])
+        assert any(r["labels"].get("site") == "t.slow"
+                   and r["value"] >= 1 for r in rows)
+
+
+# --------------------------------------------------------------------- #
+# serving: deadlines
+# --------------------------------------------------------------------- #
+
+class TestServeDeadline:
+    def test_queue_lapsed_deadline_retires_without_slot(self, net):
+        """A deadline that expires while the request is still queued
+        retires at the admission boundary: zero slots, zero tokens,
+        reason deadline_exceeded."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        clk = _FakeClock(srv._epoch)
+        srv._clock = clk
+        telemetry.clear_events()
+        pA, pB = _prompt(0, 4), _prompt(1, 4)
+        sA = srv.submit(pA, max_new_tokens=4)
+        sB = srv.submit(pB, max_new_tokens=4, deadline=1.0)
+        clk.advance(5.0)                   # B lapses before admission
+        _drain(srv)
+        assert sA.tokens(5) == _ref(net, pA, 4)
+        assert sB.done and sB.tokens(5) == []
+        evs = telemetry.events("deadline_exceeded")
+        assert any(e["request_id"] == sB.request_id for e in evs)
+        assert srv.stats()["in_flight"] == 0
+        srv.close()
+
+    @pytest.mark.parametrize("sampled", [False, True])
+    def test_device_side_expiry_frees_slot_coresident_exact(
+            self, net, sampled):
+        """THE deadline acceptance bar: expiry retires the sequence
+        DEVICE-SIDE at a step boundary; the co-resident stream is
+        token-identical to the undisturbed run (greedy and sampled),
+        admission cost one dispatch, and every decode step is exactly
+        one executable dispatch — retirement adds none."""
+        from mxnet_tpu.serve import DecodeServer
+        kw = dict(temperature=0.7, top_k=7) if sampled else {}
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False, **kw)
+        clk = _FakeClock(srv._epoch)
+        srv._clock = clk
+        N = 10
+        pA, pB = _prompt(2, 5), _prompt(3, 4)
+        sA = srv.submit(pA, max_new_tokens=N, seed=11)
+        sB = srv.submit(pB, max_new_tokens=N, seed=42, deadline=3.5)
+        srv.reset_counters()
+        while srv.pump():
+            clk.advance(1.0)     # steps dispatch at now = 1, 2, 3, ...
+        refA = _ref(net, pA, N, seed=11, **kw)
+        refB = _ref(net, pB, N, seed=42, **kw)
+        assert sA.tokens(5) == refA          # co-resident: exact
+        got = sB.tokens(5)
+        assert 0 < len(got) < N              # retired early, mid-decode
+        assert got == refB[:len(got)]        # a prefix of its own run
+        # dispatch accounting: 1 admit for the wave, one executable
+        # dispatch per decode step (A runs its full budget), nothing
+        # extra for the deadline retirement
+        assert srv.counters["admit_dispatches"] == 1
+        assert srv.counters["step_dispatches"] == (N - 1) + 1
+        assert srv._progs.step_fn()._cache_size() == 1
+        # the freed slot is reusable
+        pC = _prompt(4, 3)
+        sC = srv.submit(pC, max_new_tokens=3, seed=7)
+        _drain(srv)
+        assert sC.tokens(5) == _ref(net, pC, 3, seed=7, **kw)
+        srv.close()
+
+    def test_env_default_deadline(self, net, monkeypatch):
+        from mxnet_tpu.serve import DecodeServer
+        monkeypatch.setenv("MXNET_SERVE_DEADLINE", "0.000001")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        assert srv.default_deadline == pytest.approx(1e-6)
+        s = srv.submit(_prompt(5, 4), max_new_tokens=4)
+        time.sleep(0.01)
+        _drain(srv)
+        assert s.done and s.tokens(5) == []
+        # explicit submit(deadline=) overrides the env default
+        s2 = srv.submit(_prompt(5, 4), max_new_tokens=3, deadline=60.0)
+        _drain(srv)
+        assert s2.tokens(5) == _ref(net, _prompt(5, 4), 3)
+        srv.close()
+
+    def test_bad_deadline_rejected(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        with pytest.raises(MXNetError, match="deadline"):
+            srv.submit(_prompt(6, 3), max_new_tokens=2, deadline=-1.0)
+        srv.close()
+
+    def test_step_timeout_zero_kwarg_disables_watchdog(self, net):
+        """Post-review regression: step_timeout=0 via the KWARG means
+        'wedge detection off' (matching the env contract), not a
+        0-second hair-trigger that kills the first pump."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           step_timeout=0, autostart=False)
+        assert srv.step_timeout is None
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# serving: cancellation
+# --------------------------------------------------------------------- #
+
+class TestServeCancel:
+    @pytest.mark.parametrize("sampled", [False, True])
+    def test_cancel_mid_decode_frees_slot_coresident_exact(self, net,
+                                                           sampled):
+        """THE cancellation acceptance bar: cancel() frees the pool
+        slot at the next step boundary, the co-resident stream is
+        token-identical to an undisturbed run (greedy and sampled),
+        and no extra dispatch is spent."""
+        from mxnet_tpu.serve import DecodeServer
+        kw = dict(temperature=0.7, top_k=7) if sampled else {}
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False, **kw)
+        N = 10
+        pA, pB = _prompt(10, 5), _prompt(11, 4)
+        telemetry.clear_events()
+        sA = srv.submit(pA, max_new_tokens=N, seed=11)
+        sB = srv.submit(pB, max_new_tokens=N, seed=42)
+        srv.reset_counters()
+        for _ in range(3):
+            srv.pump()
+        assert not sB.done
+        assert sB.cancel() is True
+        assert sB.cancel() is True           # idempotent while closing
+        _drain(srv)
+        refB = _ref(net, pB, N, seed=42, **kw)
+        assert sA.tokens(5) == _ref(net, pA, N, seed=11, **kw)
+        assert sB.done and sB.cancelled
+        got = sB.tokens(5)                   # sealed, partial, exact
+        assert 0 < len(got) < N and got == refB[:len(got)]
+        assert srv.counters["admit_dispatches"] == 1
+        assert srv.counters["step_dispatches"] == (N - 1) + 1
+        assert srv._progs.step_fn()._cache_size() == 1
+        evs = telemetry.events("request_cancelled")
+        assert any(e["request_id"] == sB.request_id for e in evs)
+        # the freed slot re-admits
+        pC = _prompt(12, 3)
+        sC = srv.submit(pC, max_new_tokens=4, seed=7)
+        _drain(srv)
+        assert sC.tokens(5) == _ref(net, pC, 4, seed=7, **kw)
+        srv.close()
+
+    def test_cancel_queued_request_is_immediate(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        pA, pB = _prompt(13, 4), _prompt(14, 4)
+        sA = srv.submit(pA, max_new_tokens=6)
+        sB = srv.submit(pB, max_new_tokens=6)   # queued: 1 slot
+        assert sB.cancel() is True
+        assert sB.done and sB.cancelled and sB.tokens(1) == []
+        _drain(srv)
+        assert sA.tokens(5) == _ref(net, pA, 6)
+        assert srv.stats()["pending"] == 0
+        srv.close()
+
+    def test_sync_mode_cancel_mid_generation_reports_failure(
+            self, net, monkeypatch):
+        """Post-review regression: the sync fallback has no step
+        boundaries — cancel() of a request already inside kv_generate
+        must return False (and leave the stream intact), not claim a
+        cancellation that never happens.  Queued requests still cancel
+        for real."""
+        from mxnet_tpu.models import decoding
+        from mxnet_tpu.serve import DecodeServer
+
+        monkeypatch.setenv("MXNET_SERVE_SYNC", "1")
+        srv = DecodeServer(net, max_total_len=64, autostart=False)
+        started, release = threading.Event(), threading.Event()
+        real = decoding.kv_generate
+
+        def slow(*a, **k):
+            started.set()
+            release.wait(10)
+            return real(*a, **k)
+
+        monkeypatch.setattr(decoding, "kv_generate", slow)
+        p, p2 = _prompt(70, 4), _prompt(71, 4)
+        s = srv.submit(p, max_new_tokens=3)
+        s2 = srv.submit(p2, max_new_tokens=3)   # stays queued
+        th = threading.Thread(target=srv.pump)
+        th.start()
+        assert started.wait(10)
+        assert s.cancel() is False          # mid-generation: no effect
+        assert s2.cancel() is True          # queued: real cancel
+        release.set()
+        th.join(10)
+        assert s.tokens(10) == _ref(net, p, 3)   # ran to completion
+        assert not s.cancelled
+        assert s2.cancelled and s2.tokens(1) == []
+        srv.close()
+
+    def test_cancel_after_done_is_noop(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        p = _prompt(15, 4)
+        s = srv.submit(p, max_new_tokens=3)
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 3)
+        assert s.cancel() is False
+        assert not s.cancelled               # it completed normally
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# serving: scheduler death + watchdog
+# --------------------------------------------------------------------- #
+
+class TestSchedulerFailure:
+    def test_injected_scheduler_death_fails_all_streams(self, net,
+                                                        monkeypatch):
+        """Acceptance bar (b): a fault-injected dispatch failure on the
+        scheduler thread fails EVERY in-flight stream with the
+        underlying error, and submit() afterwards raises cleanly
+        naming it."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        p1, p2 = _prompt(20, 4), _prompt(21, 5)
+        s1 = srv.submit(p1, max_new_tokens=8)
+        s2 = srv.submit(p2, max_new_tokens=8)
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "serve.step:raise:2")
+        faults.reset_faults()
+        srv.start()
+        with pytest.raises(MXNetError, match="injected fault"):
+            s1.tokens(30)
+        with pytest.raises(MXNetError, match="injected fault"):
+            s2.tokens(30)
+        with pytest.raises(MXNetError, match="server failed"):
+            srv.submit(p1, max_new_tokens=2)
+
+    def test_watchdog_fires_on_wedged_pump(self, net):
+        """A dispatch wedged past step_timeout cannot be recovered,
+        but every consumer gets the watchdog's error instead of
+        blocking forever."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           step_timeout=0.25, autostart=False)
+        telemetry.clear_events()
+        real_pump = srv.pump
+
+        def wedged_pump():
+            time.sleep(1.2)
+            return real_pump()
+
+        srv.pump = wedged_pump
+        s = srv.submit(_prompt(22, 4), max_new_tokens=6)
+        srv.start()
+        with pytest.raises(MXNetError, match="watchdog"):
+            s.tokens(30)
+        with pytest.raises(MXNetError, match="server failed"):
+            srv.submit(_prompt(22, 4), max_new_tokens=2)
+        assert any(e.get("server") == srv.telemetry_label
+                   for e in telemetry.events("watchdog_fired"))
+
+    def test_late_wedged_dispatch_does_not_repin_pool(self, net):
+        """Post-review regression: a wedged STEP dispatch that finally
+        completes after the watchdog tore the server down must not
+        re-assign the pool state — the accountant/gauges already
+        report those bytes freed, and stats() must agree with the
+        allocator."""
+        from mxnet_tpu.serve import DecodeServer
+
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           step_timeout=0.25, autostart=False)
+        # warm the admit/step programs pump-driven first: the wedge
+        # gauge covers whole pumps, and a first-request COMPILE would
+        # trip the 0.25s timeout before the wedged step ever runs
+        w = srv.submit(_prompt(24, 4), max_new_tokens=3)
+        _drain(srv)
+        assert w.tokens(5) == _ref(net, _prompt(24, 4), 3)
+        real_step = srv._progs.step_fn()
+        entered, release = threading.Event(), threading.Event()
+
+        def wedged(*a, **k):
+            entered.set()
+            release.wait(10)
+            return real_step(*a, **k)
+
+        srv._progs._step = wedged
+        s = srv.submit(_prompt(25, 4), max_new_tokens=6)
+        srv.start()
+        assert entered.wait(10)
+        with pytest.raises(MXNetError, match="watchdog"):
+            s.tokens(30)
+        assert srv._state is None
+        release.set()                  # the wedged dispatch completes
+        srv._thread.join(10)
+        assert srv._state is None      # ...without re-pinning the pool
+        assert telemetry.ACCOUNTANT.bytes(
+            subsystem="serve.kv_pool", key=srv.telemetry_label) == 0
+        assert srv.stats()["pool_bytes"] == 0
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_watchdog_fires_on_dead_pump_thread(self, net):
+        """A pump thread that dies WITHOUT running its failure path
+        (BaseException) is caught by the watchdog — no consumer hangs."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        s = srv.submit(_prompt(23, 4), max_new_tokens=6)
+
+        def die():
+            raise SystemExit("thread torn down")
+
+        srv.pump = die
+        srv.start()
+        with pytest.raises(MXNetError, match="watchdog"):
+            s.tokens(30)
+        with pytest.raises(MXNetError, match="server failed"):
+            srv.submit(_prompt(23, 4), max_new_tokens=2)
+
+    def test_cold_compile_does_not_trip_step_timeout(self, net):
+        """Post-review regression: the first request's jit compiles
+        run far longer than a tight step_timeout — the watchdog must
+        treat a cold program as a compile, not a wedged dispatch, and
+        the request must serve on the healthy server."""
+        from mxnet_tpu.serve import DecodeServer
+
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           step_timeout=0.2, autostart=True)
+        p = _prompt(26, 4)
+        s = srv.submit(p, max_new_tokens=4)
+        assert s.tokens(60) == _ref(net, p, 4)   # served, not killed
+        p2 = _prompt(27, 3)                      # warm path too
+        s2 = srv.submit(p2, max_new_tokens=3)
+        assert s2.tokens(60) == _ref(net, p2, 3)
+        srv.close()
+
+    def test_pump_mode_injected_fault_surfaces_to_caller(self, net,
+                                                         monkeypatch):
+        """autostart=False: the injected error propagates to the
+        pump() caller (no scheduler thread to kill)."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        srv.submit(_prompt(24, 4), max_new_tokens=4)
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "serve.admit:raise:1")
+        faults.reset_faults()
+        with pytest.raises(MXNetError, match="injected fault"):
+            srv.pump()
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        srv.close(drain=False)
+
+
+# --------------------------------------------------------------------- #
+# TokenStream.tokens(timeout=) reuse-after-timeout (satellite)
+# --------------------------------------------------------------------- #
+
+class TestTokensTimeoutReuse:
+    def test_timed_out_consumer_can_retry_and_drain(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        p = _prompt(30, 4)
+        s = srv.submit(p, max_new_tokens=5)
+        with pytest.raises(MXNetError, match="not finished"):
+            s.tokens(timeout=0.02)
+        srv.pump()                           # partial progress
+        with pytest.raises(MXNetError, match="not finished"):
+            s.tokens(timeout=0.02)
+        _drain(srv)
+        ref = _ref(net, p, 5)
+        assert s.tokens(5) == ref            # same consumer, full drain
+        assert s.tokens(5) == ref            # and again
+        # a second consumer that timed out earlier also drains
+        got = []
+        th = threading.Thread(target=lambda: got.append(s.tokens(5)))
+        th.start()
+        th.join(5.0)
+        assert not th.is_alive() and got == [ref]
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# bounded distributed init + barrier
+# --------------------------------------------------------------------- #
+
+class TestBoundedInit:
+    def test_rendezvous_failure_is_clean_error(self, monkeypatch):
+        import jax
+        from mxnet_tpu.parallel import mesh
+
+        calls = []
+        shutdowns = []
+
+        def failing_init(**kw):
+            calls.append(kw)
+            raise RuntimeError("connection refused")
+
+        monkeypatch.setattr(jax.distributed, "initialize", failing_init)
+        monkeypatch.setattr(jax.distributed, "shutdown",
+                            lambda: shutdowns.append(1))
+        monkeypatch.setattr(mesh.time, "sleep", lambda s: None)
+        with pytest.raises(MXNetError, match="coordinator 127.0.0.1:1"):
+            mesh.init_distributed(coordinator_address="127.0.0.1:1",
+                                  num_processes=2, process_id=0,
+                                  retries=2)
+        assert len(calls) == 3               # 1 + 2 retries
+        # EVERY failed attempt (including the last) tears the
+        # partially-assigned jax state down, so both internal retries
+        # and a caller-level retry genuinely re-dial (post-review
+        # regression)
+        assert len(shutdowns) == 3
+        msg = None
+        try:
+            mesh.init_distributed(coordinator_address="127.0.0.1:1",
+                                  num_processes=2, process_id=1,
+                                  retries=0)
+        except MXNetError as e:
+            msg = str(e)
+        assert "rank 1/2" in msg and "MXNET_INIT_TIMEOUT" in msg
+
+    def test_subsecond_init_timeout_rounds_up(self, monkeypatch):
+        """Post-review regression: a 0.5s timeout must reach jax as
+        1 (ceil), never int-truncated to 0 = an immediate deadline."""
+        import jax
+        from mxnet_tpu.parallel import mesh
+
+        seen = {}
+
+        def fake(coordinator_address=None, num_processes=None,
+                 process_id=None, local_device_ids=None,
+                 initialization_timeout=None):
+            seen["t"] = initialization_timeout
+            raise RuntimeError("stop here")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake)
+        with pytest.raises(MXNetError, match="rendezvous"):
+            mesh.init_distributed(coordinator_address="127.0.0.1:1",
+                                  num_processes=2, process_id=0,
+                                  initialization_timeout=0.5,
+                                  retries=0)
+        assert seen["t"] == 1
+
+    def test_already_initialized_passes_through(self, monkeypatch):
+        import jax
+        from mxnet_tpu.parallel import mesh
+
+        def already(**kw):
+            # the message real jax (0.4.x) emits on double-init
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+
+        monkeypatch.setattr(jax.distributed, "initialize", already)
+        with pytest.raises(RuntimeError, match="only be called once"):
+            mesh.init_distributed(coordinator_address="127.0.0.1:1",
+                                  num_processes=2, process_id=0,
+                                  retries=3)
+
+    def test_single_process_noop(self):
+        from mxnet_tpu.parallel import mesh
+        mesh.init_distributed()              # no coordinator: no-op
+
+    @pytest.mark.parametrize("var", ["MXNET_INIT_TIMEOUT",
+                                     "MXNET_INIT_RETRIES",
+                                     "MXNET_BARRIER_TIMEOUT"])
+    def test_malformed_timeout_knobs_are_loud(self, var, monkeypatch):
+        """Post-review regression: a typo'd timeout knob (e.g. '60s')
+        must raise, not silently fall back to wait-forever/defaults —
+        the hang these knobs exist to prevent."""
+        from mxnet_tpu.parallel import mesh
+
+        monkeypatch.setenv(var, "60s")
+        with pytest.raises(MXNetError, match=var):
+            if var == "MXNET_BARRIER_TIMEOUT":
+                mesh._barrier_timeout_from_env()
+            elif var == "MXNET_INIT_TIMEOUT":
+                mesh._init_timeout_from_env()
+            else:
+                mesh._init_retries_from_env()
+
+
+class TestBarrierTimeout:
+    def test_single_process_returns(self):
+        from mxnet_tpu.parallel import mesh
+        mesh.barrier("t", timeout=0.1)       # trivially passes
+
+    def test_timeout_names_the_hang(self, monkeypatch):
+        import jax
+        from jax.experimental import multihost_utils
+        from mxnet_tpu.parallel import mesh
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                            lambda tag: time.sleep(5))
+        with pytest.raises(MXNetError, match="timed out"):
+            mesh.barrier("t_hang", timeout=0.2)
+
+    def test_peer_error_surfaces(self, monkeypatch):
+        import jax
+        from jax.experimental import multihost_utils
+        from mxnet_tpu.parallel import mesh
+
+        def boom(tag):
+            raise RuntimeError("peer went away")
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                            boom)
+        with pytest.raises(MXNetError, match="peer went away"):
+            mesh.barrier("t_err", timeout=1.0)
+
+
+# --------------------------------------------------------------------- #
+# heartbeat writer
+# --------------------------------------------------------------------- #
+
+class TestHeartbeat:
+    def test_writer_beats_and_stops(self, tmp_path):
+        from mxnet_tpu.parallel import heartbeat as hb
+
+        path = tmp_path / "rank0.hb"
+        th = hb.start_heartbeat(str(path), interval=0.05)
+        try:
+            assert th is not None and path.exists()
+            pid, count = path.read_text().split()
+            assert int(pid) == os.getpid()
+            m1 = path.stat().st_mtime_ns
+            time.sleep(0.2)
+            assert path.stat().st_mtime_ns > m1
+        finally:
+            hb.stop_heartbeat()
+        m2 = path.stat().st_mtime_ns
+        time.sleep(0.15)
+        assert path.stat().st_mtime_ns == m2   # stopped = silent
+
+    def test_noop_without_config(self, monkeypatch):
+        from mxnet_tpu.parallel import heartbeat as hb
+
+        monkeypatch.delenv("MXNET_HEARTBEAT_FILE", raising=False)
+        assert hb.start_heartbeat() is None
+
+    def test_malformed_interval_is_loud(self, monkeypatch):
+        from mxnet_tpu.parallel import heartbeat as hb
+
+        monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "1s")
+        with pytest.raises(MXNetError, match="MXNET_HEARTBEAT_INTERVAL"):
+            hb.heartbeat_interval()
+
+    def test_repoint_stops_the_old_beater(self, tmp_path):
+        """Post-review regression: re-pointing the heartbeat at a new
+        file must stop the old thread — a leaked beater would keep the
+        OLD file fresh forever, hiding a wedged rank from its
+        supervisor."""
+        from mxnet_tpu.parallel import heartbeat as hb
+
+        a, b = tmp_path / "a.hb", tmp_path / "b.hb"
+        hb.start_heartbeat(str(a), interval=0.03)
+        try:
+            hb.start_heartbeat(str(b), interval=0.03)
+            assert b.exists()
+            m_a = a.stat().st_mtime_ns
+            time.sleep(0.15)
+            assert a.stat().st_mtime_ns == m_a   # old file went silent
+            assert b.stat().st_mtime_ns          # new file beats
+        finally:
+            hb.stop_heartbeat()
+
+
+# --------------------------------------------------------------------- #
+# kvstore server: per-request error replies
+# --------------------------------------------------------------------- #
+
+class TestKVStoreServerLoop:
+    def test_request_error_reported_not_fatal(self):
+        """Satellite (f): a failing request comes back to the
+        REQUESTING rank as an error reply; the server loop survives
+        and keeps serving — its death would look like a hang to every
+        worker."""
+        from mxnet_tpu.kvstore import create
+        from mxnet_tpu.kvstore.kvstore_server import KVStoreServer
+
+        telemetry.clear_events()
+        srv = KVStoreServer(create("local"))
+        th = threading.Thread(target=srv.run, daemon=True,
+                              kwargs={"serve_any_role": True})
+        th.start()
+        try:
+            # a push to an uninitialized key fails THE REQUEST, loudly
+            rep = srv.submit("push", ("nope", mx.nd.ones(2)))
+            with pytest.raises(MXNetError, match="not initialized"):
+                rep.wait(10)
+            assert th.is_alive()             # the loop survived
+            # ...and the next requests serve normally
+            srv.submit("init", ("w", mx.nd.zeros(2))).wait(10)
+            srv.submit("push", ("w", mx.nd.ones(2))).wait(10)
+            out = srv.submit("pull", ("w", mx.nd.zeros(2))).wait(10)
+            onp.testing.assert_allclose(out.asnumpy(), [1.0, 1.0])
+            # unknown commands are an error reply too
+            with pytest.raises(MXNetError, match="unknown command"):
+                srv.submit("frobnicate").wait(10)
+            assert th.is_alive()
+            evs = telemetry.events("kvstore_error")
+            assert any(e["command"] == "push" for e in evs)
+            assert any(e["command"] == "frobnicate" for e in evs)
+        finally:
+            srv.stop()
+            th.join(5.0)
+        assert not th.is_alive()
+
+    def test_custom_handler_and_stop_fails_queued(self):
+        from mxnet_tpu.kvstore import create
+        from mxnet_tpu.kvstore.kvstore_server import KVStoreServer
+
+        srv = KVStoreServer(create("local"))
+        srv.handlers["echo"] = lambda server, payload: payload * 2
+        rep = srv.submit("echo", 21)
+        assert rep.done is False
+        assert srv.serve_one(timeout=0.1) is True
+        assert rep.wait(1) == 42
+        assert srv.serve_one(timeout=0.01) is False   # queue empty
+        # a request queued when stop() lands with NO run() loop active
+        # must be drain-rejected by stop() itself, never stranded
+        queued = srv.submit("echo", 2)
+        srv.stop()
+        with pytest.raises(MXNetError, match="stopped"):
+            queued.wait(1)
+        with pytest.raises(MXNetError, match="stopped"):
+            srv.submit("echo", 1)
+
+    def test_submit_racing_stop_never_strands_a_reply(self):
+        """Post-review regression: a submit whose queue-put lands after
+        run()'s shutdown drain must still settle its reply (rejected) —
+        reply.wait() can never block the requesting rank forever."""
+        from mxnet_tpu.kvstore import create
+        from mxnet_tpu.kvstore.kvstore_server import KVStoreServer
+
+        srv = KVStoreServer(create("local"))
+        real_put = srv._requests.put
+
+        def stop_then_put(item):
+            srv._stop.set()        # stop() wins the race mid-submit
+            real_put(item)
+
+        srv._requests.put = stop_then_put
+        rep = srv.submit("barrier")
+        with pytest.raises(MXNetError, match="stopped"):
+            rep.wait(1)
+
+    def test_run_exit_via_role_change_poisons_submit(self, monkeypatch):
+        """Post-review regression: run() exiting through the DMLC_ROLE
+        env check (not stop()) must still poison submit() — otherwise
+        later requests enqueue into a queue nobody serves and wait()
+        strands the rank."""
+        from mxnet_tpu.kvstore import create
+        from mxnet_tpu.kvstore.kvstore_server import KVStoreServer
+
+        monkeypatch.setenv("DMLC_ROLE", "server")
+        srv = KVStoreServer(create("local"))
+        th = threading.Thread(target=srv.run, daemon=True)
+        th.start()
+        assert srv.submit("barrier").wait(10) is None   # serving
+        monkeypatch.setenv("DMLC_ROLE", "worker")       # role flips
+        th.join(10)
+        assert not th.is_alive()
+        with pytest.raises(MXNetError, match="stopped"):
+            srv.submit("barrier")
+
+    def test_unset_role_is_noop_and_poisons(self, monkeypatch):
+        """The reference contract: run() with DMLC_ROLE unset/worker
+        returns immediately (after which submit() raises rather than
+        stranding a reply); serve_any_role=True opts into the loop."""
+        from mxnet_tpu.kvstore import create
+        from mxnet_tpu.kvstore.kvstore_server import KVStoreServer
+
+        monkeypatch.delenv("DMLC_ROLE", raising=False)
+        srv = KVStoreServer(create("local"))
+        srv.run()                            # no role: immediate return
+        with pytest.raises(MXNetError, match="stopped"):
+            srv.submit("barrier")
+
+
+# --------------------------------------------------------------------- #
+# failure-cause reporting
+# --------------------------------------------------------------------- #
+
+class TestFailureReport:
+    def test_failure_summary_aggregates_causes(self):
+        from tools.telemetry_report import failure_summary
+
+        events = [
+            {"ts": 1, "kind": "fault_injected", "site": "serve.step",
+             "fault_kind": "raise"},
+            {"ts": 2, "kind": "fault_injected", "site": "serve.step",
+             "fault_kind": "raise"},
+            {"ts": 3, "kind": "watchdog_fired", "server": "srv0",
+             "reason": "wedged"},
+            {"ts": 4, "kind": "deadline_exceeded", "server": "srv0",
+             "request_id": 3},
+            {"ts": 5, "kind": "request_cancelled", "server": "srv0",
+             "request_id": 4},
+            {"ts": 6, "kind": "worker_dead", "rank": 1,
+             "why": "died with signal 9"},
+            {"ts": 7, "kind": "kvstore_error", "command": "push",
+             "error": "MXNetError('x')"},
+            {"ts": 8, "kind": "serve_request", "reason": "eos"},
+        ]
+        rows = failure_summary(events)
+        by_kind = {r["kind"]: r for r in rows}
+        assert by_kind["fault_injected"]["count"] == 2
+        assert by_kind["fault_injected"]["detail"] == {
+            "serve.step: raise": 2}
+        assert by_kind["watchdog_fired"]["count"] == 1
+        assert by_kind["deadline_exceeded"]["count"] == 1
+        assert by_kind["request_cancelled"]["count"] == 1
+        assert by_kind["worker_dead"]["detail"] == {
+            "rank 1: died with signal 9": 1}
+        assert by_kind["kvstore_error"]["count"] == 1
+        assert "serve_request" not in by_kind
+
+    def test_report_renders_failures_section(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        import json
+
+        path = tmp_path / "rec.jsonl"
+        with open(path, "w") as fh:
+            for ev in ({"ts": 1, "kind": "fault_injected",
+                        "site": "kvstore.push", "fault_kind": "raise"},
+                       {"ts": 2, "kind": "worker_dead", "rank": 2,
+                        "why": "exited with code 7"}):
+                fh.write(json.dumps(ev) + "\n")
+        r = subprocess.run(
+            [_sys.executable, "tools/telemetry_report.py", str(path)],
+            capture_output=True, text=True, cwd="/root/repo",
+            timeout=60)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "failure causes" in r.stdout
+        assert "fault_injected" in r.stdout
+        assert "worker_dead" in r.stdout

@@ -1,0 +1,137 @@
+"""Supervised launch chaos gauntlet (ISSUE 13): ``tools/launch.py`` as
+a real supervisor — a dead or wedged rank produces a clean nonzero
+exit on ALL ranks within the timeout, never a hang.
+
+Acceptance bar (a): killing one of 3 launched ranks tears the job down
+with a diagnostic naming the failed rank; the supervisor forwards the
+first failing rank's exit code (128+signal for signal deaths) and no
+sibling survives.  The fast tier-1 arms use a no-import script (exit
+code forwarding) and the fault-injected SIGKILL (the ISSUE's smoke);
+the heartbeat-silence matrix arm is slow.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_LAUNCH = [sys.executable, "tools/launch.py"]
+
+
+def _run(args, timeout):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    env.pop("MXNET_FAULT_INJECT", None)
+    t0 = time.monotonic()
+    r = subprocess.run(args, capture_output=True, text=True,
+                       cwd="/root/repo", env=env, timeout=timeout)
+    return r, time.monotonic() - t0
+
+
+class TestSupervisedLaunch:
+    def test_failing_rank_exit_code_forwarded_fast(self, tmp_path):
+        """No-mxnet script: rank 1 exits 7; the siblings (parked in a
+        long sleep) are killed, the supervisor exits 7 — the first
+        failing rank's code, not a swallowed generic 1 — and the whole
+        teardown is fast (satellite: _kill_all hardening)."""
+        script = tmp_path / "rank_prog.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "rank = os.environ['MXNET_WORKER_ID']\n"
+            "print('RANK%s_UP' % rank, flush=True)\n"
+            "if rank == '1':\n"
+            "    time.sleep(0.3)\n"
+            "    sys.exit(7)\n"
+            "time.sleep(120)\n"
+            "print('RANK%s_DONE' % rank, flush=True)\n")
+        r, dt = _run(_LAUNCH + ["-n", "3", "--kill-grace", "1",
+                                sys.executable, str(script)],
+                     timeout=60)
+        assert r.returncode == 7, (r.returncode, r.stderr[-800:])
+        assert "rank 1" in r.stderr and "exited with code 7" in r.stderr
+        assert "RANK0_UP" in r.stdout and "RANK2_UP" in r.stdout
+        assert "RANK0_DONE" not in r.stdout     # killed, not finished
+        assert dt < 30, f"teardown took {dt:.1f}s"
+
+    def test_fault_injected_kill_tears_job_down(self, tmp_path):
+        """THE tier-1 chaos smoke: 3 ranks beating via the library
+        heartbeat, rank 1 fault-injected to die with SIGKILL mid-run
+        (MXNET_FAULT_INJECT=launch.heartbeat:kill:2).  The supervisor
+        must exit 137 (128+SIGKILL) with a diagnostic naming rank 1,
+        and no rank may hang."""
+        script = tmp_path / "beat_prog.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "rank = os.environ['MXNET_WORKER_ID']\n"
+            "if rank == '1':\n"
+            "    os.environ['MXNET_FAULT_INJECT'] = "
+            "'launch.heartbeat:kill:2'\n"
+            "from mxnet_tpu.parallel.heartbeat import start_heartbeat\n"
+            "start_heartbeat()\n"
+            "print('RANK%s_BEATING' % rank, flush=True)\n"
+            "time.sleep(120)\n"
+            "print('RANK%s_DONE' % rank, flush=True)\n")
+        r, dt = _run(_LAUNCH + ["-n", "3", "--heartbeat-interval",
+                                "0.2", "--heartbeat-timeout", "60",
+                                "--kill-grace", "2",
+                                sys.executable, str(script)],
+                     timeout=240)
+        assert r.returncode == 137, (r.returncode, r.stderr[-800:])
+        assert "rank 1" in r.stderr
+        assert "signal 9" in r.stderr
+        assert "RANK1_BEATING" in r.stdout      # it was up, then died
+        assert "_DONE" not in r.stdout          # nobody ran to the end
+        assert dt < 180, f"no-hang bar: {dt:.1f}s"
+
+    @pytest.mark.slow
+    def test_heartbeat_silence_detected(self, tmp_path):
+        """Full-matrix arm: a rank that stops beating (fault-injected
+        hang in the beat loop) without dying is declared wedged after
+        --heartbeat-timeout and the job tears down nonzero — the
+        'silent rank' half of dead-worker detection."""
+        script = tmp_path / "wedge_prog.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "rank = os.environ['MXNET_WORKER_ID']\n"
+            "if rank == '2':\n"
+            "    os.environ['MXNET_FAULT_INJECT'] = "
+            "'launch.heartbeat:hang:3:120'\n"
+            "from mxnet_tpu.parallel.heartbeat import start_heartbeat\n"
+            "start_heartbeat()\n"
+            "print('RANK%s_BEATING' % rank, flush=True)\n"
+            "time.sleep(120)\n")
+        r, dt = _run(_LAUNCH + ["-n", "3", "--heartbeat-interval",
+                                "0.2", "--heartbeat-timeout", "3",
+                                "--kill-grace", "1",
+                                sys.executable, str(script)],
+                     timeout=240)
+        assert r.returncode != 0
+        assert "rank 2" in r.stderr
+        assert "heartbeat silent" in r.stderr
+        assert dt < 180, f"no-hang bar: {dt:.1f}s"
+
+    def test_interval_incompatible_with_timeout_rejected(self):
+        """Post-review regression: an interval the timeout cannot
+        tolerate (healthy rank would be declared silent) is a CLI
+        error up front, not a job-killing misconfiguration."""
+        r, _dt = _run(_LAUNCH + ["-n", "1", "--heartbeat-interval",
+                                 "120", "--heartbeat-timeout", "60",
+                                 "python", "-c", "pass"], timeout=30)
+        assert r.returncode != 0
+        assert "must exceed" in r.stderr
+
+    def test_clean_three_rank_run_still_exits_zero(self, tmp_path):
+        """Supervision must not break the happy path: 3 ranks exiting
+        zero -> supervisor exits zero with all output passed through."""
+        script = tmp_path / "ok_prog.py"
+        script.write_text(
+            "import os\n"
+            "print('RANK%s_OK' % os.environ['MXNET_WORKER_ID'],"
+            " flush=True)\n")
+        r, _dt = _run(_LAUNCH + ["-n", "3", sys.executable,
+                                 str(script)], timeout=60)
+        assert r.returncode == 0, r.stderr[-500:]
+        for i in range(3):
+            assert f"RANK{i}_OK" in r.stdout

@@ -19,18 +19,37 @@ and sets, for each rank:
                             mxnet_tpu.parallel.init_distributed)
     MXNET_NUM_WORKERS       total ranks
     MXNET_WORKER_ID         this rank
+    MXNET_HEARTBEAT_FILE    per-rank beat file (local mode; written by
+                            mxnet_tpu.parallel.heartbeat)
     DMLC_ROLE=worker        reference compat (server/scheduler ranks can be
                             requested with -s but are deprecated no-ops)
+
+Supervision (ISSUE 13, the reference tracker's dead-worker detection):
+in local mode the launcher is a real supervisor, not a wait() loop.  It
+collects every rank's heartbeat file and last log lines, and on a
+failed rank — nonzero/signal exit, or a heartbeat silent past
+``--heartbeat-timeout`` once the rank has started beating — it prints
+a diagnostic naming the rank and its last output, kills the remaining
+ranks (SIGTERM, then SIGKILL after ``--kill-grace``), reaps them, and
+exits with the FIRST failing rank's code (``128+signal`` for signal
+deaths) instead of hanging in a half-dead rendezvous.  Ranks that
+never beat (commands that don't import mxnet_tpu) are supervised on
+process exit alone, so plain commands behave exactly as before.
 """
 from __future__ import annotations
 
 import argparse
 import os
 import shlex
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
+import threading
+import time
+from collections import deque
 
 
 def _free_port():
@@ -57,30 +76,209 @@ def _rank_env(args, coordinator, rank):
     return env
 
 
+def _emit(kind, **fields):
+    """Best-effort telemetry from the supervisor process (lands in the
+    ring / an attached ``MXNET_TELEMETRY_JSONL`` sink).  The supervisor
+    must stay usable without the library importable, so a failed import
+    is silence, not a crash."""
+    try:
+        from mxnet_tpu import telemetry
+    except Exception:
+        return
+    telemetry.emit(kind, **fields)
+    if kind == "worker_dead":
+        telemetry.counter("launch_worker_dead_total").inc()
+
+
+class _Rank:
+    """One supervised local rank: process + heartbeat file + a tail of
+    its interleaved stdout/stderr for the failure diagnostic."""
+
+    def __init__(self, rank, proc, hb_path):
+        self.rank = rank
+        self.proc = proc
+        self.hb_path = hb_path
+        self.last_mtime = None       # wall-clock mtime last observed
+        self.last_beat_mono = None   # monotonic instant it changed
+        self.tail = deque(maxlen=40)
+        self.reader = threading.Thread(
+            target=self._read, name=f"launch-rank{rank}-log",
+            daemon=True)
+        self.reader.start()
+
+    def _read(self):
+        # line-for-line passthrough (tests and operators read the
+        # ranks' prints from the launcher's stdout, as before) + a
+        # bounded tail kept for the post-mortem
+        for line in self.proc.stdout:
+            self.tail.append(line)
+            sys.stdout.write(line)
+            sys.stdout.flush()
+
+    def heartbeat_age(self):
+        """Monotonic seconds since this rank's beat file last CHANGED
+        (None until the first beat is seen).  mtime values are only
+        compared for equality against each other, never against a
+        clock — the age itself comes from ``time.monotonic()``, so an
+        NTP step cannot fake a stale (or fresh) heartbeat."""
+        try:
+            mt = os.path.getmtime(self.hb_path)
+        except OSError:
+            return None   # not beating (or beat dir already gone)
+        if mt != self.last_mtime:
+            self.last_mtime = mt
+            self.last_beat_mono = time.monotonic()
+        return time.monotonic() - self.last_beat_mono
+
+
+def _kill_all(ranks, grace=5.0):
+    """SIGTERM every live rank, escalate to SIGKILL after ``grace``
+    seconds, and reap everything — no zombies, no survivors holding
+    the coordinator port.  Accepts ``_Rank`` objects or bare Popens
+    (the ssh branch)."""
+    procs = [getattr(r, "proc", r) for r in ranks]
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + max(grace, 0.0)
+    while time.monotonic() < deadline and \
+            any(p.poll() is None for p in procs):
+        time.sleep(0.05)
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+
+def _exit_code(returncode):
+    """Shell convention: a signal death (negative Popen returncode)
+    forwards as 128+signal; anything else forwards as-is."""
+    if returncode is None:
+        return 1
+    return 128 - returncode if returncode < 0 else returncode
+
+
+def _fail(ranks, bad, why, detail, grace):
+    # the reader thread may still be appending (a wedged-but-chatty
+    # rank): give it a moment to drain, then snapshot with a retry —
+    # a concurrent deque append mid-iteration raises RuntimeError,
+    # and the diagnostic path must never crash the supervisor
+    bad.reader.join(timeout=1.0)
+    last = None
+    for _ in range(5):
+        try:
+            last = "".join(bad.tail)
+            break
+        except RuntimeError:
+            time.sleep(0.05)
+    if last is None:
+        last = "(output still streaming)\n"
+    last = last or "(no output captured)\n"
+    print(f"[launch] rank {bad.rank} {detail}; killing the remaining "
+          f"ranks.\n[launch] rank {bad.rank} last output:\n"
+          + "".join(f"  | {line}" for line in
+                    last.splitlines(keepends=True)),
+          file=sys.stderr, flush=True)
+    # the event carries a STABLE why code (telemetry_report's
+    # failure-cause section buckets on it); the measured details stay
+    # in their own field + the printed diagnostic
+    _emit("worker_dead", rank=bad.rank, why=why, detail=detail,
+          returncode=bad.proc.returncode)
+    _kill_all(ranks, grace)
+    return _exit_code(bad.proc.returncode)
+
+
+def _supervise(ranks, heartbeat_timeout, grace):
+    """Watch rank processes and heartbeats until everyone exits zero,
+    one rank fails, or a beating rank goes silent."""
+    stop = {"sig": None}
+
+    def _on_signal(signum, _frame):
+        stop["sig"] = signum
+
+    old = {}
+    for signum in (signal.SIGINT, signal.SIGTERM):
+        old[signum] = signal.signal(signum, _on_signal)
+    try:
+        pending = list(ranks)
+        while pending:
+            if stop["sig"] is not None:
+                print(f"[launch] received signal {stop['sig']}; "
+                      "killing all ranks", file=sys.stderr, flush=True)
+                _kill_all(ranks, grace)
+                return 128 + stop["sig"]
+            for r in list(pending):
+                rc = r.proc.poll()
+                if rc is not None:
+                    if rc != 0:
+                        sig = -rc if rc < 0 else None
+                        detail = (f"died with signal {sig}" if sig
+                                  else f"exited with code {rc}")
+                        return _fail(
+                            ranks, r,
+                            "died_signal" if sig else "exited_nonzero",
+                            detail, grace)
+                    pending.remove(r)
+                    continue
+                if heartbeat_timeout:
+                    age = r.heartbeat_age()
+                    if age is not None and age > heartbeat_timeout:
+                        _fail(ranks, r, "heartbeat_silent",
+                              f"heartbeat silent for {age:.1f}s "
+                              f"(--heartbeat-timeout {heartbeat_timeout}"
+                              "s): wedged or livelocked", grace)
+                        return 1
+            time.sleep(0.1)
+        return 0
+    finally:
+        for signum, handler in old.items():
+            signal.signal(signum, handler)
+        for r in ranks:
+            r.reader.join(timeout=2.0)
+
+
 def launch_local(args, command):
     coordinator = f"127.0.0.1:{_free_port()}"
-    procs = []
-    for rank in range(args.num_workers):
-        env = _rank_env(args, coordinator, rank)
-        if args.dry_run:
+    if args.dry_run:
+        for rank in range(args.num_workers):
+            env = _rank_env(args, coordinator, rank)
             kv = " ".join(f"{k}={env[k]}" for k in sorted(env)
                           if k.startswith(("MXNET_", "DMLC")))
             print(f"[rank {rank}] {kv} {' '.join(command)}")
-            continue
-        procs.append(subprocess.Popen(command, env=env))
-    if args.dry_run:
         return 0
-    code = 0
-
-    def _kill_all(*_a):
-        for p in procs:
-            p.terminate()
-
-    signal.signal(signal.SIGINT, _kill_all)
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
-    return code
+    hb_dir = tempfile.mkdtemp(prefix="mxnet_launch_hb_")
+    ranks = []
+    try:
+        for rank in range(args.num_workers):
+            env = _rank_env(args, coordinator, rank)
+            hb_path = os.path.join(hb_dir, f"rank{rank}.hb")
+            env["MXNET_HEARTBEAT_FILE"] = hb_path
+            env["MXNET_HEARTBEAT_INTERVAL"] = str(
+                args.heartbeat_interval)
+            # piped stdout makes python ranks BLOCK-buffered: without
+            # this, a hard-killed rank takes its last ~8KB of output
+            # to the grave and the post-mortem tail prints stale lines
+            env["PYTHONUNBUFFERED"] = "1"
+            proc = subprocess.Popen(command, env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT,
+                                    text=True, errors="replace")
+            ranks.append(_Rank(rank, proc, hb_path))
+        return _supervise(ranks, args.heartbeat_timeout,
+                          args.kill_grace)
+    finally:
+        _kill_all(ranks, grace=0.0)   # no-op when all reaped already
+        shutil.rmtree(hb_dir, ignore_errors=True)
 
 
 def launch_ssh(args, command):
@@ -107,10 +305,29 @@ def launch_ssh(args, command):
         procs.append(subprocess.Popen(full))
     if args.dry_run:
         return 0
+    # ssh mode has no heartbeat channel (the beat files are remote);
+    # supervise on exit codes alone, with the same first-failure
+    # fail-fast + hardened SIGTERM -> SIGKILL teardown as local mode
     code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
+    pending = list(procs)
+    try:
+        while pending:
+            for p in list(pending):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                pending.remove(p)
+                if rc != 0 and code == 0:
+                    code = _exit_code(rc)
+                    rank = procs.index(p)
+                    print(f"[launch] rank {rank} failed "
+                          f"(exit {rc}); killing the remaining ranks",
+                          file=sys.stderr, flush=True)
+                    _kill_all(pending, args.kill_grace)
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        code = 130
+    _kill_all(procs, args.kill_grace if code else 0.0)
     return code
 
 
@@ -129,6 +346,18 @@ def main(argv=None):
                         help="hostfile for --launcher ssh")
     parser.add_argument("--port", type=int, default=None,
                         help="coordinator port (ssh mode)")
+    parser.add_argument("--heartbeat-timeout", type=float, default=60.0,
+                        help="seconds a rank's heartbeat may go silent "
+                             "before the job is torn down (0 disables; "
+                             "only enforced once a rank has started "
+                             "beating, so non-mxnet commands are "
+                             "unaffected)")
+    parser.add_argument("--heartbeat-interval", type=float, default=1.0,
+                        help="seconds between rank heartbeats "
+                             "(MXNET_HEARTBEAT_INTERVAL for the ranks)")
+    parser.add_argument("--kill-grace", type=float, default=5.0,
+                        help="seconds between SIGTERM and SIGKILL when "
+                             "tearing down surviving ranks")
     parser.add_argument("--dry-run", action="store_true",
                         help="print the per-rank commands without running")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -139,6 +368,12 @@ def main(argv=None):
     if args.num_servers:
         print("note: -s/--num-servers is a no-op on TPU (parameter-server "
               "roles are subsumed by XLA collectives)", file=sys.stderr)
+    if args.heartbeat_timeout and \
+            args.heartbeat_timeout <= 2 * args.heartbeat_interval:
+        parser.error(
+            f"--heartbeat-timeout {args.heartbeat_timeout} must exceed "
+            f"2x --heartbeat-interval {args.heartbeat_interval} — a "
+            "healthy rank beating on schedule would be declared silent")
     if args.launcher == "ssh":
         if not args.hostfile:
             parser.error("--launcher ssh requires -H/--hostfile")

@@ -15,6 +15,10 @@ Sections (each skipped when the file has no events of that kind):
   token totals, p50/p99 TTFT and queue wait, admission wave stats.
 - **serve stats** — the per-server close() snapshot: steps, dispatch
   counters, occupancy.
+- **failure causes** — the fault-tolerance events (ISSUE 13):
+  ``worker_dead`` / ``deadline_exceeded`` / ``request_cancelled`` /
+  ``fault_injected`` / ``watchdog_fired`` / ``kvstore_error``, counted
+  per kind with a per-site/server/reason breakdown.
 - **bench rows** — ``kind=bench`` events (serve_bench / step_profile
   measured rows) passed through as a table.
 
@@ -134,6 +138,35 @@ def serve_summary(events):
             "mean_admit_wave": (round(sum(waves) / len(waves), 2)
                                 if waves else None),
         })
+    return rows
+
+
+FAILURE_KINDS = ("worker_dead", "deadline_exceeded", "request_cancelled",
+                 "fault_injected", "watchdog_fired", "kvstore_error")
+
+
+def failure_summary(events):
+    """Aggregate the failure-cause events (ISSUE 13) per kind: count +
+    the per-site/server/reason breakdown, so one recording answers
+    "what failed, where, how often" next to the perf tables."""
+    rows = []
+    by_kind = defaultdict(list)
+    for e in events:
+        if e.get("kind") in FAILURE_KINDS:
+            by_kind[e["kind"]].append(e)
+    for kind in FAILURE_KINDS:
+        evs = by_kind.get(kind)
+        if not evs:
+            continue
+        detail = defaultdict(int)
+        for e in evs:
+            where = e.get("site") or e.get("server") or \
+                (f"rank {e['rank']}" if "rank" in e else "?")
+            what = e.get("fault_kind") or e.get("reason") or \
+                e.get("why") or e.get("command") or e.get("error")
+            detail[f"{where}" + (f": {what}" if what else "")] += 1
+        rows.append({"kind": kind, "count": len(evs),
+                     "detail": dict(sorted(detail.items()))})
     return rows
 
 
@@ -265,6 +298,14 @@ def render(events):
                 f"admit_dispatches={c.get('admit_dispatches')} "
                 f"pool_grows={c.get('pool_grows')} "
                 f"sync_requests={c.get('sync_requests')}")
+    fails = failure_summary(events)
+    if fails:
+        lines.append("")
+        lines.append("failure causes")
+        for r in fails:
+            lines.append(f"  {r['kind']:<20}{r['count']:>6}")
+            for where, n in r["detail"].items():
+                lines.append(f"    {n:>4}x {where}")
     bench = [e for e in events if e.get("kind") == "bench"]
     if bench:
         lines.append("")
@@ -303,6 +344,7 @@ def main(argv=None):
             "events": len(events),
             "compile": compile_summary(events),
             "serve": serve_summary(events),
+            "failures": failure_summary(events),
             "bench": [e for e in events if e.get("kind") == "bench"],
         }, indent=2, sort_keys=True))
     else:
