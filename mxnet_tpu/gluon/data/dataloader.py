@@ -180,6 +180,12 @@ class _MultiWorkerIter:
         or finds the ring closed and stops); runs from ``__del__`` so an
         epoch abandoned mid-way (``break``) doesn't leak the executor or
         its futures."""
+        # RLock, and every holder's critical section is short and
+        # non-blocking: a GC-triggered __del__ on the holding thread
+        # re-enters reentrantly, and one on another thread waits a
+        # bounded few instructions — not the non-reentrant-accountant
+        # deadlock TL012 exists for.
+        # tracelint: disable=TL012 -- RLock + short non-blocking critical sections; finalizer re-entry is reentrant, cross-thread wait is bounded
         with self._lock:
             if self._closed:
                 return
@@ -432,6 +438,7 @@ class DevicePrefetchIter:
                         self._queue.get_nowait()
                     except _queue.Empty:
                         pass
+        # tracelint: disable=TL012 -- RLock + short non-blocking critical sections; finalizer re-entry is reentrant, cross-thread wait is bounded
         with self._lock:
             self._ring.clear()
         from ...telemetry.memory import ACCOUNTANT

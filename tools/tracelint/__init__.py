@@ -16,7 +16,12 @@ ring) is fast because of invariants the code cannot express in types:
 The multi-host pod runtime adds a harsher class — collective axes no
 mesh binds, traces that diverge per host, collectives under
 data-dependent branches, device-ledger entries nobody releases — each
-a 64-chip hang or a silent leak instead of a stack trace.
+a 64-chip hang or a silent leak instead of a stack trace.  And the
+fault-tolerant runtime (supervised launch, serve deadlines, finalizer
+ledger drops) adds the concurrency-contract class: wall-clock deadline
+math, finalizers taking non-reentrant locks, callbacks fired under a
+held lock, stranded threads, telemetry schemas drifting from the
+stream (v3: TL011–TL015).
 
 tracelint checks those invariants with ``ast`` only (no third-party
 dependencies) so CI fails the moment a change reintroduces the
@@ -26,7 +31,7 @@ graph (imports, re-exports, cross-module class families — see
 cannot be resolved.  Run it as::
 
     python -m tools.tracelint mxnet_tpu/ tools/ benchmark/ \
-        [--format=json] [--jobs N] [--baseline f]
+        [--format=json|sarif] [--jobs N] [--baseline f]
 
 Rules (see docs/TRACELINT.md for the full catalog):
 
@@ -43,6 +48,13 @@ TL007    cross-host trace divergence (process id / env / time / RNG
 TL008    collective under a data- or host-dependent branch
 TL009    ``ACCOUNTANT.set`` without a reachable drop/release path
 TL010    stale suppression (opt-in via ``--select TL010``)
+TL011    ``time.time()`` in deadline/timeout arithmetic (NTP hazard)
+TL012    lock acquisition reachable from a GC finalizer
+TL013    user callback invoked while a lock is held
+TL014    thread without daemon/join lifecycle; blocking ``queue.get``
+         with no poison-pill wakeup
+TL015    telemetry event/metric/fault-site out of sync with
+         docs/TELEMETRY.md / docs/ENV_VARS.md
 =======  ==========================================================
 
 Suppress a deliberate violation with a justified comment on the same

@@ -8,8 +8,8 @@ import argparse
 import json
 import sys
 
-from .core import (RULES, apply_baseline, load_baseline, run_paths,
-                   write_baseline)
+from .core import (RULES, apply_baseline, load_baseline, render_sarif,
+                   run_paths, write_baseline)
 
 
 def main(argv=None):
@@ -19,7 +19,10 @@ def main(argv=None):
                     "donation, retrace, lock-order, env-hatch checks)")
     ap.add_argument("paths", nargs="+",
                     help="python files or directories to analyze")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="sarif = SARIF 2.1.0 for CI annotations; all "
+                         "formats are byte-identical across --jobs")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule ids (default: all)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
@@ -29,7 +32,10 @@ def main(argv=None):
                     help="write current findings to FILE and exit 0")
     ap.add_argument("--env-docs", default=None, metavar="FILE",
                     help="override the docs/ENV_VARS.md location for "
-                         "TL005 (auto-discovered by default)")
+                         "TL005/TL015 (auto-discovered by default)")
+    ap.add_argument("--telemetry-docs", default=None, metavar="FILE",
+                    help="override the docs/TELEMETRY.md location for "
+                         "TL015 (auto-discovered by default)")
     ap.add_argument("--jobs", type=int, default=None, metavar="N",
                     help="distribute per-module rule passes over N "
                          "forked workers (identical output to serial)")
@@ -46,7 +52,8 @@ def main(argv=None):
 
     try:
         findings = run_paths(args.paths, select=select,
-                             env_docs=args.env_docs, jobs=args.jobs)
+                             env_docs=args.env_docs, jobs=args.jobs,
+                             telemetry_docs=args.telemetry_docs)
     except FileNotFoundError as e:
         print(f"tracelint: no such path: {e}", file=sys.stderr)
         return 2
@@ -60,7 +67,9 @@ def main(argv=None):
         findings = apply_baseline(findings, load_baseline(args.baseline))
 
     errors = [f for f in findings if f.severity != "warn"]
-    if args.format == "json":
+    if args.format == "sarif":
+        print(render_sarif(findings))
+    elif args.format == "json":
         counts: dict = {}
         for f in findings:
             counts[f.rule] = counts.get(f.rule, 0) + 1

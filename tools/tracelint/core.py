@@ -27,6 +27,12 @@ RULES = {
     "TL008": "collective under a data- or host-dependent branch",
     "TL009": "ACCOUNTANT.set without a reachable drop/release path",
     "TL010": "stale suppression: disabled rule no longer fires here",
+    "TL011": "wall-clock time.time() in deadline/timeout arithmetic",
+    "TL012": "lock acquisition reachable from a GC finalizer",
+    "TL013": "user callback invoked while holding a lock",
+    "TL014": "thread without daemon/join lifecycle, or blocking "
+             "queue.get with no close wakeup",
+    "TL015": "telemetry event/metric/fault-site out of sync with docs",
 }
 
 # `# tracelint: disable=TL001[,TL004] -- justification`
@@ -166,14 +172,14 @@ def load_modules(files):
     return modules, findings
 
 
-def find_repo_docs(paths, explicit=None):
-    """Locate docs/ENV_VARS.md by walking up from the scanned paths."""
+def find_repo_docs(paths, explicit=None, name="ENV_VARS.md"):
+    """Locate docs/<name> by walking up from the scanned paths."""
     if explicit:
         return explicit if os.path.isfile(explicit) else None
     for p in paths:
         d = os.path.abspath(p if os.path.isdir(p) else os.path.dirname(p))
         while True:
-            cand = os.path.join(d, "docs", "ENV_VARS.md")
+            cand = os.path.join(d, "docs", name)
             if os.path.isfile(cand):
                 return cand
             parent = os.path.dirname(d)
@@ -208,12 +214,14 @@ def _validate_suppressions(module: Module):
 def _module_findings(project, shared, module):
     """Every per-module rule pass over one module (the unit of work
     ``--jobs`` distributes)."""
-    from . import rules_sharding, rules_threading, rules_trace
+    from . import (rules_runtime, rules_sharding, rules_threading,
+                   rules_trace)
 
     out = list(_validate_suppressions(module))
     out.extend(rules_trace.check_module(project, module))
-    out.extend(rules_threading.check_module(module))
+    out.extend(rules_threading.check_module(shared, module))
     out.extend(rules_sharding.check_module(project, shared, module))
+    out.extend(rules_runtime.check_module(project, shared, module))
     return out
 
 
@@ -280,7 +288,8 @@ def _unused_suppressions(modules, findings):
     return out
 
 
-def run_paths(paths, select=None, env_docs=None, jobs=None):
+def run_paths(paths, select=None, env_docs=None, jobs=None,
+              telemetry_docs=None):
     """Run every rule over ``paths``; returns the surviving findings.
 
     ``select`` restricts to an iterable of rule ids (and is the opt-in
@@ -289,7 +298,7 @@ def run_paths(paths, select=None, env_docs=None, jobs=None):
     Suppressions with a justification remove matching findings;
     reasonless suppressions do not (and raise TL000 themselves).
     """
-    from . import rules_env
+    from . import rules_env, rules_runtime
     from .project import Project
     from .rules_sharding import build_state
 
@@ -301,7 +310,26 @@ def run_paths(paths, select=None, env_docs=None, jobs=None):
     shared = build_state(project)
     findings.extend(_run_modules(project, shared, modules, jobs))
     docs = find_repo_docs(paths, env_docs)
-    findings.extend(rules_env.check(modules, docs))
+    tele = find_repo_docs(paths, telemetry_docs, name="TELEMETRY.md")
+    # one repo scan per distinct docs ROOT: the stale directions must
+    # be judged against the tree that owns each docs file (an
+    # --env-docs override pointing elsewhere must not blind the
+    # TELEMETRY.md reconciliation to the real repo, or vice versa)
+    parsed = {os.path.abspath(m.path): m.tree for m in modules}
+    scans = {}
+
+    def _aux_for(doc_path):
+        if doc_path is None:
+            return None
+        root = os.path.dirname(os.path.dirname(os.path.abspath(doc_path)))
+        if root not in scans:
+            scans[root] = rules_env.repo_scan(root, parsed)
+        return scans[root]
+
+    findings.extend(rules_env.check(modules, docs, _aux_for(docs)))
+    findings.extend(rules_runtime.check_contract(
+        modules, tele, docs, _aux_for(tele), _aux_for(docs)))
+    findings.extend(rules_runtime.check_project(project, shared))
     findings.extend(_unused_suppressions(modules, findings))
 
     if select:
@@ -324,6 +352,45 @@ def run_paths(paths, select=None, env_docs=None, jobs=None):
         out.append(f)
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
+
+
+# -- SARIF output -------------------------------------------------------- #
+
+def render_sarif(findings):
+    """SARIF 2.1.0 for CI annotation surfaces (GitHub code scanning et
+    al.).  Deterministic: findings arrive sorted from run_paths and the
+    rule table is emitted in id order, so serial and ``--jobs`` runs
+    produce byte-identical documents."""
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning" if f.severity == "warn" else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": os.path.normpath(f.path).replace(
+                            os.sep, "/")},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col, 0) + 1},
+                },
+            }],
+        })
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tracelint",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": desc}}
+                          for rid, desc in sorted(RULES.items())],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True)
 
 
 # -- baseline ----------------------------------------------------------- #

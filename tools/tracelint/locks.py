@@ -1,0 +1,310 @@
+"""Shared held-lock region analysis — computed ONCE per module and
+consumed by three rule families:
+
+* TL004 (``rules_threading``) — unlocked mutations of lock-protected
+  state and lock-order inversions;
+* TL012 (``rules_runtime``) — lock acquisitions reachable from GC
+  finalizers;
+* TL013 (``rules_runtime``) — user callbacks invoked while a lock is
+  held.
+
+One AST walk per function records, with the held-lock stack threaded
+through it: every shared-state mutation, every call site, every lock
+acquisition (``with`` items and bare ``.acquire()``), and every nested
+acquisition pair.  Lock *keys* carry their scope kind so each rule sees
+exactly the locks it reasons about:
+
+* ``class``  — ``self._lock``-family attributes assigned a
+  ``threading.Lock/RLock/Condition/...`` inside the class's methods;
+* ``module`` — module-level ``_lock = threading.Lock()`` globals;
+* ``ext``    — module-level locks *imported from another project
+  module* (``from ..parameter import _TRACE_LOCK``).  TL013 treats
+  them as held; TL004 deliberately ignores them so its findings stay
+  scoped to the module that owns the lock (the pre-v3 semantics).
+
+Nested-acquisition pairs are recorded per scope kind (the innermost
+held lock *of the same kind*), which reproduces TL004's historical
+two-pass behavior exactly.
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import dotted, iter_own
+
+__all__ = ["LockAnalysis", "Mutation", "build_locks", "LOCK_CTORS"]
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+_MUTATORS = {"append", "appendleft", "pop", "popleft", "clear", "extend",
+             "extendleft", "remove", "insert", "add", "discard", "update",
+             "setdefault", "popitem", "sort", "reverse"}
+
+
+def is_lock_ctor(expr):
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted(expr.func)
+    if d and d.split(".")[-1] in LOCK_CTORS:
+        return d.split(".")[-1]
+    return None
+
+
+def _self_attr(expr):
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+class Mutation:
+    __slots__ = ("field", "line", "col", "held", "method", "scope")
+
+    def __init__(self, field, line, col, held, method, scope):
+        self.field = field
+        self.line = line
+        self.col = col
+        self.held = held       # lock keys of this mutation's own scope
+        self.method = method
+        self.scope = scope     # id(ClassDef) or "module"
+
+
+class LockAnalysis:
+    """Per-module result; see the module docstring for the shape."""
+
+    __slots__ = ("module", "class_locks", "module_locks", "class_muts",
+                 "module_muts", "acquisitions", "fn_calls", "fn_acquires",
+                 "lock_ctor")
+
+    def __init__(self, module):
+        self.module = module
+        self.class_locks = {}    # id(ClassDef) -> {attr: ctor}
+        self.module_locks = {}   # name -> ctor
+        self.class_muts = {}     # id(ClassDef) -> (ClassDef, [Mutation])
+        self.module_muts = []    # [Mutation]
+        self.acquisitions = []   # (outer key, inner key, line) same-kind
+        self.fn_calls = {}       # id(fn) -> [(Call, held full-key tuple)]
+        self.fn_acquires = {}    # id(fn) -> [(kind, key, ctor, node)]
+        self.lock_ctor = {}      # full key -> ctor name
+
+
+def _class_methods(cls):
+    """Methods + their nested closures, excluding nested ClassDefs
+    (an inner class owns its own lock discipline)."""
+    out, stack = [], list(ast.iter_child_nodes(cls))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.ClassDef):
+            continue
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _class_fields(node, lock_attrs):
+    """Mutated self-field names in one statement (TL004's class scope)."""
+    out = []
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr and attr not in lock_attrs:
+                out.append(attr)
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr:
+                    out.append(attr)
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    attr = _self_attr(e)
+                    if attr and attr not in lock_attrs:
+                        out.append(attr)
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        attr = _self_attr(node.func.value)
+        if attr:
+            out.append(attr)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr:
+                out.append(attr)
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr:
+                    out.append(attr)
+    return out
+
+
+def _module_fields(node, mod_names):
+    """Mutated module-global names in one statement (TL004's module
+    scope: subscript stores, container mutators, dels)."""
+    out = []
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id in mod_names:
+                out.append(t.value.id)
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS and \
+            isinstance(node.func.value, ast.Name) and \
+            node.func.value.id in mod_names:
+        out.append(node.func.value.id)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id in mod_names:
+                out.append(t.value.id)
+    return out
+
+
+def build_locks(module, imports, module_lock_defs):
+    """One-pass lock analysis of ``module``.
+
+    ``imports`` is the module's :class:`project.Imports`;
+    ``module_lock_defs`` maps ``(modname, varname) -> ctor`` for every
+    module-level lock in the project (for the ``ext`` scope kind).
+    """
+    la = LockAnalysis(module)
+    tree = module.tree
+
+    # -- lock definitions ------------------------------------------------- #
+    owner = {}           # id(fn node) -> ClassDef
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            attrs = {}
+            for m in _class_methods(node):
+                owner.setdefault(id(m), node)
+                for n in iter_own(m):
+                    if isinstance(n, ast.Assign):
+                        ctor = is_lock_ctor(n.value)
+                        if ctor:
+                            for t in n.targets:
+                                attr = _self_attr(t)
+                                if attr:
+                                    attrs[attr] = ctor
+            if attrs:
+                la.class_locks[id(node)] = attrs
+                la.class_muts[id(node)] = (node, [])
+
+    mod_names = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+            mod_names.update(names)
+            ctor = is_lock_ctor(stmt.value)
+            if ctor:
+                for n in names:
+                    la.module_locks[n] = ctor
+
+    def ext_lock(name):
+        """(key, ctor) for a name bound to another project module's
+        lock global, else None."""
+        if name in imports.from_imports:
+            tgt, remote = imports.from_imports[name]
+            ctor = module_lock_defs.get((tgt, remote))
+            if ctor:
+                return f"{tgt}:{remote}", ctor
+        return None
+
+    def classify(expr, cls):
+        """(kind, key, ctor) when ``expr`` names a known lock."""
+        attr = _self_attr(expr)
+        lock_attrs = la.class_locks.get(id(cls), {}) if cls else {}
+        if attr and attr in lock_attrs:
+            return "class", f"{cls.name}.{attr}", lock_attrs[attr]
+        d = dotted(expr.func) if isinstance(expr, ast.Call) else None
+        if d and d.startswith("self.") and cls is not None:
+            parts = d.split(".")
+            if len(parts) >= 2 and parts[1] in lock_attrs:
+                return ("class", f"{cls.name}.{parts[1]}",
+                        lock_attrs[parts[1]])
+        d = dotted(expr)
+        if d in la.module_locks:
+            return "module", f"{module.path}:{d}", la.module_locks[d]
+        if d is not None and "." not in d:
+            hit = ext_lock(d)
+            if hit:
+                return "ext", hit[0], hit[1]
+        elif d is not None:
+            parts = d.split(".")
+            tgt = imports.mod_aliases.get(parts[0])
+            if tgt is not None and len(parts) == 2:
+                ctor = module_lock_defs.get((tgt, parts[1]))
+                if ctor:
+                    return "ext", f"{tgt}:{parts[1]}", ctor
+        return None
+
+    # -- the one walk per function ---------------------------------------- #
+    def walk_fn(fn):
+        cls = owner.get(id(fn))
+        lock_attrs = la.class_locks.get(id(cls), {}) if cls else {}
+        calls, acquires = [], []
+        cmuts = la.class_muts.get(id(cls), (None, []))[1] \
+            if cls is not None and id(cls) in la.class_locks else None
+        want_mod = bool(la.module_locks)
+
+        def walk(node, held):
+            # held: tuple of (kind, key)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                new_held = held
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        hit = classify(item.context_expr, cls)
+                        if hit is not None:
+                            kind, key, ctor = hit
+                            la.lock_ctor.setdefault(key, ctor)
+                            same = [k for kd, k in new_held if kd == kind]
+                            if same and kind != "ext":
+                                la.acquisitions.append(
+                                    (same[-1], key, child.lineno))
+                            acquires.append((kind, key, ctor, child))
+                            new_held = new_held + ((kind, key),)
+                if isinstance(child, ast.Call):
+                    calls.append((child, new_held))
+                    if isinstance(child.func, ast.Attribute) and \
+                            child.func.attr == "acquire":
+                        hit = classify(child.func.value, cls)
+                        if hit is not None:
+                            kind, key, ctor = hit
+                            la.lock_ctor.setdefault(key, ctor)
+                            acquires.append((kind, key, ctor, child))
+                if cmuts is not None:
+                    for field in _class_fields(child, lock_attrs):
+                        cmuts.append(Mutation(
+                            field, child.lineno,
+                            getattr(child, "col_offset", 0),
+                            tuple(k for kd, k in new_held
+                                  if kd == "class"),
+                            fn.name, id(cls)))
+                if want_mod:
+                    for field in _module_fields(child, mod_names):
+                        la.module_muts.append(Mutation(
+                            field, child.lineno,
+                            getattr(child, "col_offset", 0),
+                            tuple(k for kd, k in new_held
+                                  if kd == "module"),
+                            fn.name, "module"))
+                walk(child, new_held)
+
+        walk(fn, ())
+        if calls:
+            la.fn_calls[id(fn)] = calls
+        if acquires:
+            la.fn_acquires[id(fn)] = acquires
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node)
+    return la

@@ -19,7 +19,7 @@ import re
 from .callgraph import dotted
 from .core import Finding
 
-__all__ = ["check"]
+__all__ = ["check", "repo_scan", "RepoScan"]
 
 _VAR_RE = re.compile(r"MXNET_[A-Z0-9_]+")
 _DOC_ROW_RE = re.compile(r"^\s*\|([^|]*)\|")
@@ -66,19 +66,44 @@ def _documented_vars(docs_path):
     return out
 
 
-def _aux_reads(docs_path, parsed=None):
-    """MXNET_* reads across the WHOLE repo that owns the docs file.
+# directories the TL015 contract scan skips: tests emit fixture kinds
+# ("t.site") that must never count as the library's contract surface,
+# and examples are demo code, not producers
+_NON_CONTRACT_DIRS = {"tests", "test", "example", "examples", "fixtures"}
 
-    The stale-row direction ('documented but never read') must be
+
+class RepoScan:
+    """One walk over the repo that owns the docs files, shared by the
+    repo-wide reconciliation directions of TL005 (env vars) and TL015
+    (event kinds / metric names / fault sites).
+
+    The stale-row direction ('documented but never used') must be
     judged against the full tree, not just the paths being linted —
-    otherwise linting a single edited file reports every hatch read
-    elsewhere as stale.  The undocumented-read direction stays scoped
-    to the scanned files (those findings carry file/line anchors).
-    ``parsed`` maps absolute paths to already-parsed trees so files in
-    the scanned set are not parsed twice."""
-    root = os.path.dirname(os.path.dirname(os.path.abspath(docs_path)))
+    otherwise linting a single edited file reports every contract
+    satisfied elsewhere as stale.  The undocumented-use direction stays
+    scoped to the scanned files (those findings carry file/line
+    anchors).  Env-var reads are collected everywhere (a hatch read
+    only by a test is still real); telemetry uses skip test/example
+    trees (a fixture kind is not a contract)."""
+
+    __slots__ = ("env_vars", "emit_kinds", "metric_lits", "metric_pats",
+                 "fault_sites")
+
+    def __init__(self):
+        self.env_vars = set()
+        self.emit_kinds = set()
+        self.metric_lits = set()
+        self.metric_pats = set()
+        self.fault_sites = set()
+
+
+def repo_scan(root, parsed=None):
+    """Walk ``root`` once, parsing each .py file at most once (reusing
+    already-parsed trees via ``parsed``: abs path -> ast)."""
+    from .rules_runtime import telemetry_uses
+
     parsed = parsed or {}
-    vars_seen = set()
+    scan = RepoScan()
     candidates = []
     for r, dirs, names in os.walk(root):
         dirs[:] = [x for x in dirs
@@ -88,22 +113,30 @@ def _aux_reads(docs_path, parsed=None):
                           if n.endswith(".py"))
     for path in candidates:
         tree = parsed.get(path)
-        if tree is not None:
-            vars_seen.update(v for v, _ in _reads_in_tree(tree))
+        if tree is None:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                scan.env_vars.update(_AUX_READ_RE.findall(src))
+                continue
+        scan.env_vars.update(v for v, _ in _reads_in_tree(tree))
+        rel_parts = set(os.path.relpath(path, root).split(os.sep))
+        if rel_parts & _NON_CONTRACT_DIRS:
             continue
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                src = fh.read()
-        except OSError:
-            continue
-        try:
-            vars_seen.update(v for v, _ in _reads_in_tree(ast.parse(src)))
-        except SyntaxError:
-            vars_seen.update(_AUX_READ_RE.findall(src))
-    return vars_seen
+        uses = telemetry_uses(tree)
+        scan.emit_kinds.update(k for k, _ in uses.emits)
+        scan.metric_lits.update(n for n, _ in uses.metric_lits)
+        scan.metric_pats.update(p for p, _ in uses.metric_pats)
+        scan.fault_sites.update(s for s, _ in uses.sites)
+    return scan
 
 
-def check(modules, docs_path):
+def check(modules, docs_path, aux=None):
     if docs_path is None or not modules:
         return []  # nothing to reconcile against (fixture runs)
     findings = []
@@ -119,8 +152,11 @@ def check(modules, docs_path):
                 f"`{var}` is read here but has no row in "
                 f"{os.path.relpath(docs_path)} — document the hatch "
                 "(default + effect) or remove the read"))
-    all_reads = set(read_lines) | _aux_reads(
-        docs_path, {os.path.abspath(m.path): m.tree for m in modules})
+    if aux is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(docs_path)))
+        aux = repo_scan(root, {os.path.abspath(m.path): m.tree
+                               for m in modules})
+    all_reads = set(read_lines) | aux.env_vars
     for var, line in sorted(documented.items()):
         if var not in all_reads:
             findings.append(Finding(

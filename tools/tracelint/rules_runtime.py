@@ -1,0 +1,909 @@
+"""TL011–TL015 — the concurrency & runtime-contract family.
+
+These are the bug classes the fault-tolerant runtime (supervised
+launch, serve deadlines/watchdog, finalizer-driven ledger drops) was
+hand-reviewed for across PRs 7/10/13 — each one now a lint instead of
+a review round:
+
+* **TL011 clock discipline** — a ``time.time()`` value that flows into
+  deadline/timeout arithmetic (compared against a ``*deadline*`` /
+  ``*timeout*`` name, added to one, passed as a ``timeout=`` argument,
+  or stored into a deadline-named field) is an NTP hazard: a wall-clock
+  step turns the budget into an instant or an infinite timeout.  Use
+  ``time.monotonic()``.  Pure elapsed *logging* (``t0 = time.time();
+  ...; log(time.time() - t0)`` — the ``event_handler.py`` /
+  ``callback.py`` / telemetry-timestamp pattern) stays clean: the rule
+  fires on the deadline-shaped *use*, not on the read.
+* **TL012 finalizer lock safety** — a ``threading.Lock``/``RLock``
+  acquisition reachable (project call graph, including module-level
+  singletons like ``ACCOUNTANT``) from a ``__del__`` or
+  ``weakref.finalize`` callback: a GC pass can run the finalizer
+  inside a thread that already holds the lock and self-deadlock (the
+  PR-10 accountant bug).  Route finalizer-side cleanup through a
+  lock-free deferral (the ``drop_deferred`` pattern) — or suppress
+  with the reentrancy argument where the lock is an ``RLock`` held
+  only by short non-blocking sections.
+* **TL013 callback-under-lock** — a user-supplied callable (``on_*`` /
+  ``*callback*`` / ``*hook*`` attributes, names, or parameters that
+  don't resolve to a project-internal function) invoked while a
+  ``self._lock``-family lock is held: the callback can re-enter the
+  owner (``submit()`` from ``on_token``) and deadlock, or block every
+  other client of the lock (the ``_push``-outside-``_lock`` discipline
+  PR 7 established).
+* **TL014 thread lifecycle** — a ``threading.Thread`` started by a
+  class must be ``daemon=True`` or joined on some close/stop/teardown
+  path of the class family; and a class that owns a producer thread
+  and a blocking ``queue.get()`` must have a poison-pill wakeup (a
+  ``put(None)`` / sentinel put) outside the thread's own target, so a
+  parked consumer wakes when the producer dies (the ``_END`` pill
+  pattern).
+* **TL015 telemetry schema drift** — ``emit(kind)`` literals and
+  registry counter/gauge/histogram names must appear in
+  ``docs/TELEMETRY.md``'s schema tables and vice versa, and
+  ``fault_point("site")`` literals must match the documented
+  ``MXNET_FAULT_INJECT`` site list in ``docs/ENV_VARS.md`` (the TL005
+  pattern applied to the two newer contract surfaces).
+
+TL011/TL013/TL014 are per-module passes; TL012 and TL015 run once over
+the whole lint target (their facts cross modules).  TL012/TL013 consume
+the shared held-lock analysis from :mod:`.locks` (computed once,
+shared with TL004).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .callgraph import dotted, iter_own
+from .core import Finding
+from .locks import _self_attr
+
+__all__ = ["check_module", "check_project", "check_contract"]
+
+_DEADLINE_RE = re.compile(r"deadline|timeout|expir|time_limit",
+                          re.IGNORECASE)
+_CALLBACK_RE = re.compile(r"(^|_)on_[a-z0-9_]+$|callback|hook",
+                          re.IGNORECASE)
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "JoinableQueue"}
+
+
+def check_module(project, shared, module):
+    findings = []
+    findings.extend(_tl011(project, module))
+    findings.extend(_tl013(project, shared, module))
+    findings.extend(_tl014(project, module))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# TL011 — clock discipline
+# --------------------------------------------------------------------- #
+
+def _is_wall_call(call, imports):
+    """True when ``call`` reads the wall clock (``time.time()``,
+    ``datetime.now()``/``utcnow()``), resolving module aliases and
+    from-imports so ``from time import time`` classifies the same."""
+    d = dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    head = imports.from_imports.get(parts[0])
+    if head is not None:
+        parts = head[0].split(".") + [head[1]] + parts[1:]
+    else:
+        tgt = imports.mod_aliases.get(parts[0])
+        if tgt is not None:
+            parts = tgt.split(".") + parts[1:]
+    if parts[0] == "time" and parts[-1] == "time" and len(parts) == 2:
+        return True
+    if parts[0] == "datetime" and parts[-1] in ("now", "utcnow"):
+        return True
+    return False
+
+
+def _deadline_name(expr):
+    """An identifier matching the deadline/timeout vocabulary inside
+    ``expr``, or None."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and _DEADLINE_RE.search(sub.id):
+            return sub.id
+        if isinstance(sub, ast.Attribute) and \
+                _DEADLINE_RE.search(sub.attr):
+            return sub.attr
+    return None
+
+
+def _wall_attrs_by_class(module, imports):
+    """Per-class set of self-attributes assigned from a wall-clock read
+    in ANY method (``self.tic = time.time()``), so cross-method elapsed
+    math still sees the taint."""
+    out = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign):
+                if any(isinstance(sub, ast.Call)
+                       and _is_wall_call(sub, imports)
+                       for sub in ast.walk(n.value)):
+                    for t in n.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            attrs.add(attr)
+        if attrs:
+            out[id(node)] = attrs
+    return out
+
+
+def _tl011(project, module):
+    imports = project.imports[id(module)]
+    if "time" not in module.source:
+        return []   # fast path: no clock reads at all
+    idx = project.index(module)
+    wall_attrs = _wall_attrs_by_class(module, imports)
+    out = []
+    for info in idx.functions:
+        cls_attrs = wall_attrs.get(id(info.cls), set()) \
+            if info.cls is not None else set()
+        sources = {id(n) for n in iter_own(info.node)
+                   if isinstance(n, ast.Call)
+                   and _is_wall_call(n, imports)}
+        if not sources and not cls_attrs:
+            continue
+        tainted = set()
+
+        def is_tainted(expr):
+            for sub in ast.walk(expr):
+                if id(sub) in sources:
+                    return True
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id in tainted:
+                    return True
+                attr = _self_attr(sub)
+                if attr in cls_attrs and \
+                        isinstance(getattr(sub, "ctx", None), ast.Load):
+                    return True
+            return False
+
+        for _ in range(2):
+            for n in iter_own(info.node):
+                if isinstance(n, (ast.Assign, ast.AugAssign)) and \
+                        is_tainted(n.value):
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                tainted.add(leaf.id)
+
+        hits = {}
+
+        def flag(node, via, assign_value=None):
+            hits.setdefault(id(node), (node, via, assign_value))
+
+        for n in iter_own(info.node):
+            if isinstance(n, ast.Compare):
+                sides = [n.left] + list(n.comparators)
+                for i, s in enumerate(sides):
+                    if not is_tainted(s):
+                        continue
+                    for j, other in enumerate(sides):
+                        if j == i:
+                            continue
+                        name = _deadline_name(other)
+                        if name:
+                            flag(n, f"compared against `{name}`")
+            elif isinstance(n, ast.BinOp) and \
+                    isinstance(n.op, (ast.Add, ast.Sub)):
+                for a, b in ((n.left, n.right), (n.right, n.left)):
+                    if is_tainted(a):
+                        name = _deadline_name(b)
+                        if name:
+                            flag(n, f"combined with `{name}`")
+            elif isinstance(n, ast.Call):
+                for kw in n.keywords:
+                    if kw.arg in ("timeout", "deadline") and \
+                            is_tainted(kw.value):
+                        flag(kw.value, f"passed as `{kw.arg}=`")
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in ("wait", "join") and n.args and \
+                        is_tainted(n.args[0]):
+                    flag(n.args[0], f"passed to `.{n.func.attr}(...)` "
+                                    "as its timeout")
+            elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                if n.value is not None and is_tainted(n.value):
+                    for t in targets:
+                        name = None
+                        if isinstance(t, ast.Name):
+                            name = t.id
+                        elif isinstance(t, ast.Attribute):
+                            name = t.attr
+                        if name and _DEADLINE_RE.search(name):
+                            flag(n, f"stored into `{name}`",
+                                 assign_value=n.value)
+        # one finding per defect: an Assign whose VALUE expression was
+        # already flagged (`deadline = time.time() + timeout` hits both
+        # the BinOp and the store) reports only once
+        for hid, (node, _via, value) in list(hits.items()):
+            if value is not None and any(
+                    id(sub) in hits and id(sub) != hid
+                    for sub in ast.walk(value)):
+                del hits[hid]
+        for node, via, _value in sorted(hits.values(),
+                                        key=lambda h: (h[0].lineno,
+                                                       h[0].col_offset)):
+            out.append(Finding(
+                "TL011", module.path, node.lineno, node.col_offset,
+                f"wall-clock `time.time()` value {via} inside "
+                f"`{info.qualname}` — deadline/timeout arithmetic on "
+                "the wall clock breaks under an NTP step (instant or "
+                "infinite budget); use time.monotonic() (elapsed-only "
+                "logging is exempt and not flagged)"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL012 — finalizer lock safety (project-wide; run once in the parent)
+# --------------------------------------------------------------------- #
+
+def _resolve_instance_method(project, shared, module, call):
+    """``NAME.meth(...)`` where NAME is bound (locally or via import)
+    to a module-level singleton (``ACCOUNTANT = MemoryAccountant()``):
+    resolve to the class's method so the finalizer walk sees through
+    the instance."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)):
+        return []
+    head, meth = func.value.id, func.attr
+    imp = project.imports[id(module)]
+    keys = []
+    if head in imp.from_imports:
+        keys.append(imp.from_imports[head])
+    keys.append((project.names[id(module)] or module.path, head))
+    for key in keys:
+        hit = shared.instances.get(key)
+        if hit is None:
+            continue
+        imod, icls = hit
+        info = project.indexes[id(imod)].class_methods.get(
+            id(icls), {}).get(meth)
+        if info is not None:
+            return [(imod, info)]
+    return []
+
+
+def _finalizer_roots(project):
+    """(module, FuncInfo, label) for every ``__del__`` and every
+    resolvable ``weakref.finalize(obj, cb, ...)`` callback."""
+    roots = []
+    for m in project.modules:
+        idx = project.index(m)
+        for info in idx.functions:
+            if info.name == "__del__" and info.cls is not None:
+                roots.append((m, info, f"{info.cls.name}.__del__"))
+        imp = project.imports[id(m)]
+        for call, scopes in idx.calls:
+            d = dotted(call.func)
+            if not d or d.split(".")[-1] != "finalize":
+                continue
+            head = d.split(".")[0]
+            if d == "finalize":
+                # bare name: only counts when from-imported from weakref
+                # (a project helper that happens to be named finalize
+                # must not seed the walk)
+                if imp.from_imports.get("finalize", ("",))[0] != \
+                        "weakref":
+                    continue
+            elif head != "weakref" and \
+                    imp.mod_aliases.get(head) != "weakref":
+                continue
+            if len(call.args) < 2:
+                continue
+            cb = call.args[1]
+            hit = None
+            if isinstance(cb, ast.Name):
+                local = idx.resolve_name(cb.id, scopes)
+                if local is not None:
+                    hit = (m, local)
+                else:
+                    imp = project.imports[id(m)]
+                    if cb.id in imp.from_imports:
+                        tgt, remote = imp.from_imports[cb.id]
+                        hit = project._module_func(
+                            project.by_name.get(tgt), remote)
+            elif isinstance(cb, ast.Attribute):
+                dd = dotted(cb)
+                if dd:
+                    mod, rest = project._resolve_module_prefix(
+                        m, dd.split("."))
+                    if mod is not None and len(rest) == 1:
+                        hit = project._module_func(mod, rest[0])
+            if hit is not None:
+                roots.append((hit[0], hit[1],
+                              f"weakref.finalize callback "
+                              f"`{hit[1].qualname}`"))
+    return roots
+
+
+def check_project(project, shared):
+    """TL012 over the whole lint target."""
+    out, seen_sites = [], set()
+    for rmod, rinfo, label in _finalizer_roots(project):
+        seen_fns = {id(rinfo.node)}
+        work = [(rmod, rinfo, label)]
+        while work:
+            mod, info, chain = work.pop(0)
+            la = shared.locks.get(id(mod))
+            for kind, key, ctor, node in (
+                    la.fn_acquires.get(id(info.node), ())
+                    if la is not None else ()):
+                site = (mod.path, node.lineno, key)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                out.append(Finding(
+                    "TL012", mod.path, node.lineno,
+                    getattr(node, "col_offset", 0),
+                    f"{ctor} `{key}` is acquired here, reachable from "
+                    f"GC finalizer {chain} — a finalizer can run via "
+                    "GC inside a thread that already holds the lock "
+                    "and deadlock (the ACCOUNTANT finalizer bug); "
+                    "route finalizer-side cleanup through a lock-free "
+                    "deferral (the drop_deferred pattern), or suppress "
+                    "with the reentrancy argument"))
+            scopes = info.scopes + (info.node,)
+            for n in iter_own(info.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                targets = project.resolve_call(mod, n, scopes)
+                if not targets:
+                    targets = _resolve_instance_method(
+                        project, shared, mod, n)
+                for cmod, callee in targets:
+                    if id(callee.node) in seen_fns:
+                        continue
+                    seen_fns.add(id(callee.node))
+                    work.append((cmod, callee,
+                                 f"{chain} -> {callee.qualname}"))
+    out.sort(key=lambda f: (f.path, f.line))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL013 — callback invoked under a held lock
+# --------------------------------------------------------------------- #
+
+def _tl013(project, shared, module):
+    la = shared.locks.get(id(module))
+    if la is None or not la.fn_calls:
+        return []
+    idx = project.index(module)
+    out = []
+    for info in idx.functions:
+        calls = la.fn_calls.get(id(info.node))
+        if not calls:
+            continue
+        scopes = info.scopes + (info.node,)
+        for call, held in calls:
+            if not held:
+                continue
+            func = call.func
+            if isinstance(func, ast.Name):
+                name = func.id
+            elif isinstance(func, ast.Attribute):
+                name = func.attr
+            else:
+                continue
+            if not _CALLBACK_RE.search(name):
+                continue
+            if project.resolve_call(module, call, scopes):
+                continue   # resolves to a project function: internal,
+                # not a user-supplied callable
+            _kind, key = held[-1]
+            out.append(Finding(
+                "TL013", module.path, call.lineno, call.col_offset,
+                f"user callback `{dotted(func) or name}(...)` invoked "
+                f"while `{key}` is held (in `{info.qualname}`) — a "
+                "callback that re-enters the owner (submit/close from "
+                "on_token) deadlocks, and a slow one blocks every "
+                "other client of the lock; move the invocation outside "
+                "the critical section (the _push-outside-_lock "
+                "discipline)"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL014 — thread lifecycle
+# --------------------------------------------------------------------- #
+
+def _is_thread_ctor(call, imports):
+    d = dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    if parts[-1] != "Thread":
+        return False
+    if len(parts) == 1:
+        tgt = imports.from_imports.get("Thread")
+        return bool(tgt) and tgt[0] == "threading"
+    return parts[0] == "threading" or \
+        imports.mod_aliases.get(parts[0]) == "threading"
+
+
+def _is_queue_ctor(call):
+    d = dotted(call.func)
+    return bool(d) and d.split(".")[-1] in _QUEUE_CTORS
+
+
+def _daemon_kwarg(call):
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return isinstance(kw.value, ast.Constant) and \
+                kw.value.value is True
+    return False
+
+
+def _module_sentinels(module):
+    """Module-level names usable as poison pills: ALL-CAPS constants
+    and names bound to ``object()`` or ``None``."""
+    out = set()
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign):
+            names = [t.id for t in stmt.targets
+                     if isinstance(t, ast.Name)]
+            sentinel = (isinstance(stmt.value, ast.Call)
+                        and dotted(stmt.value.func) == "object") or \
+                (isinstance(stmt.value, ast.Constant)
+                 and stmt.value.value is None)
+            for n in names:
+                if sentinel or n.isupper() or \
+                        (n.startswith("_") and n[1:].isupper()):
+                    out.add(n)
+    return out
+
+
+def _family_methods(project, module, cls):
+    """(owner_module, method fn node) across the project-wide family."""
+    from .locks import _class_methods
+
+    out = []
+    for fmod, fcls in project._class_family(module, cls):
+        for m in _class_methods(fcls):
+            out.append((fmod, m))
+    return out
+
+
+def _blocking_get(call):
+    """True when ``call`` is an unbounded blocking ``.get()``."""
+    if call.args:
+        a0 = call.args[0]
+        if not (isinstance(a0, ast.Constant) and a0.value is True):
+            return False
+        if len(call.args) >= 2:
+            # positional timeout: get(True, 1.0) wakes on its own —
+            # only an explicit None timeout stays unbounded
+            a1 = call.args[1]
+            if not (isinstance(a1, ast.Constant) and a1.value is None):
+                return False
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None):
+            return False
+        if kw.arg == "block" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is True):
+            return False
+    return True
+
+
+def _tl014(project, module):
+    imports = project.imports[id(module)]
+    threads_present = "Thread" in module.source
+    idx = project.index(module)
+    out = []
+
+    # -- per-class: threads bound to self attributes + queue pills ------- #
+    for cls in idx.classes.values():
+        fam = _family_methods(project, module, cls) if threads_present \
+            or "Queue" in module.source else []
+        thread_attrs = {}     # attr -> (ctor call, daemon)
+        queue_attrs = set()
+        for fmod, m in fam:
+            for n in iter_own(m):
+                if isinstance(n, ast.Assign):
+                    attr = _self_attr(n.targets[0]) \
+                        if len(n.targets) == 1 else None
+                    if attr and isinstance(n.value, ast.Call):
+                        fimp = project.imports[id(fmod)]
+                        if _is_thread_ctor(n.value, fimp):
+                            thread_attrs.setdefault(
+                                attr, (fmod, n.value,
+                                       _daemon_kwarg(n.value)))
+                        elif _is_queue_ctor(n.value):
+                            queue_attrs.add(attr)
+        if not thread_attrs and not queue_attrs:
+            continue
+        joined, daemoned, pills, gets = set(), set(), set(), []
+        sentinels = set()
+        for fmod in {id(fm): fm for fm, _m in fam}.values():
+            sentinels |= _module_sentinels(fmod)
+        for fmod, m in fam:
+            for n in iter_own(m):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute):
+                    recv = _self_attr(n.func.value)
+                    if recv and n.func.attr == "join":
+                        joined.add(recv)
+                    elif recv and n.func.attr == "setDaemon":
+                        daemoned.add(recv)
+                    elif recv in queue_attrs and \
+                            n.func.attr in ("put", "put_nowait") and \
+                            n.args:
+                        a0 = n.args[0]
+                        if (isinstance(a0, ast.Constant)
+                                and a0.value is None) or \
+                                (isinstance(a0, ast.Name)
+                                 and a0.id in sentinels):
+                            pills.add(recv)
+                    elif recv in queue_attrs and n.func.attr == "get" \
+                            and _blocking_get(n) and fmod is module:
+                        gets.append((recv, n, m.name))
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                t.attr == "daemon":
+                            recv = _self_attr(t.value)
+                            if recv and isinstance(n.value, ast.Constant) \
+                                    and n.value.value is True:
+                                daemoned.add(recv)
+        for attr, (fmod, call, daemon) in sorted(thread_attrs.items()):
+            if daemon or attr in daemoned or attr in joined:
+                continue
+            if fmod is not module:
+                continue   # reported where the ctor lives
+            out.append(Finding(
+                "TL014", module.path, call.lineno, call.col_offset,
+                f"`self.{attr}` thread started by `{cls.name}` is not "
+                "daemon=True and is never joined on any close/stop/"
+                "teardown path of the class family — an abandoned "
+                "instance strands the thread (and a non-daemon thread "
+                "blocks interpreter exit); mark it daemon or join it "
+                "in close()"))
+        if thread_attrs:
+            for recv, n, meth in gets:
+                if recv in pills:
+                    continue
+                out.append(Finding(
+                    "TL014", module.path, n.lineno, n.col_offset,
+                    f"unbounded `self.{recv}.get()` in "
+                    f"`{cls.name}.{meth}` with no poison-pill wakeup "
+                    "reachable: the class owns a producer thread, and "
+                    "when it dies (or close() runs) a consumer parked "
+                    "here blocks forever — put a sentinel (the _END "
+                    "pill pattern) on every close path, or use "
+                    "get(timeout=...)"))
+
+    # -- local threads inside plain functions ----------------------------- #
+    if threads_present:
+        for info in idx.functions:
+            local_threads = {}   # name -> ctor call
+            started, joined, daemoned = set(), set(), set()
+            returned = set()     # ownership handed to the caller
+            for n in iter_own(info.node):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name) and \
+                        isinstance(n.value, ast.Call) and \
+                        _is_thread_ctor(n.value, imports):
+                    if not _daemon_kwarg(n.value):
+                        local_threads[n.targets[0].id] = n.value
+                elif isinstance(n, ast.Call):
+                    if isinstance(n.func, ast.Attribute) and \
+                            isinstance(n.func.value, ast.Name):
+                        if n.func.attr == "start":
+                            started.add(n.func.value.id)
+                            continue
+                        if n.func.attr == "join":
+                            joined.add(n.func.value.id)
+                            continue
+                    # a handle passed to any other call escapes —
+                    # self._workers.append(t), registry.add(t): the
+                    # callee owns the join-on-teardown story now
+                    for a in list(n.args) + [k.value for k in n.keywords]:
+                        if isinstance(a, ast.Name):
+                            returned.add(a.id)
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                t.attr == "daemon" and \
+                                isinstance(t.value, ast.Name) and \
+                                isinstance(n.value, ast.Constant) and \
+                                n.value.value is True:
+                            daemoned.add(t.value.id)
+                        elif isinstance(t, (ast.Attribute,
+                                            ast.Subscript)):
+                            # stored into an attribute/container:
+                            # ownership transferred to that structure
+                            for leaf in ast.walk(n.value):
+                                if isinstance(leaf, ast.Name):
+                                    returned.add(leaf.id)
+                elif isinstance(n, ast.Return) and n.value is not None:
+                    for leaf in ast.walk(n.value):
+                        if isinstance(leaf, ast.Name):
+                            returned.add(leaf.id)
+            for name, call in sorted(local_threads.items()):
+                if name in started and name not in joined and \
+                        name not in daemoned and name not in returned:
+                    out.append(Finding(
+                        "TL014", module.path, call.lineno,
+                        call.col_offset,
+                        f"thread `{name}` started in "
+                        f"`{info.qualname}` is neither daemon=True "
+                        "nor joined before the function returns — it "
+                        "outlives its owner with no teardown path; "
+                        "mark it daemon or join it"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL015 — telemetry / fault-site contract (run once in the parent)
+# --------------------------------------------------------------------- #
+
+_DOC_TOKEN_RE = re.compile(r"`([A-Za-z_][\w.]*)")
+_SITE_RE = re.compile(r"^[a-z_]+(\.[a-z_]+)+$")
+_EMIT_RECEIVERS = {"telemetry", "events", "_events"}
+_METRIC_RECEIVERS = {"telemetry", "REGISTRY"}
+_METRIC_FNS = {"counter", "gauge", "histogram"}
+
+
+def _emit_forwarders(tree):
+    """Module functions that forward their FIRST parameter as an event
+    kind (``tools/launch.py``'s ``_emit(kind, **fields)`` wrapper) —
+    calls to them with a literal count as emits of that kind."""
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args.posonlyargs + node.args.args
+        if not args:
+            continue
+        first = args[0].arg
+        for n in iter_own(node):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and d.split(".")[-1] == "emit" and n.args and \
+                        isinstance(n.args[0], ast.Name) and \
+                        n.args[0].id == first:
+                    names.add(node.name)
+    return names
+
+
+def _bare_imports(tree):
+    """Locally-bound bare names for emit / metric fns, resolved from
+    the tree's own import statements (no project machinery, so the aux
+    repo walk can use this too)."""
+    emit_names, metric_names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            src = node.module
+            telemetryish = "telemetry" in src or \
+                src.split(".")[-1] in ("events", "registry")
+            if not telemetryish:
+                continue
+            for a in node.names:
+                if a.name == "emit":
+                    emit_names.add(a.asname or a.name)
+                elif a.name in _METRIC_FNS:
+                    metric_names.add(a.asname or a.name)
+    return emit_names, metric_names
+
+
+class TelemetryUses:
+    __slots__ = ("emits", "metric_lits", "metric_pats", "sites")
+
+    def __init__(self):
+        self.emits = []        # (kind, line)
+        self.metric_lits = []  # (name, line)
+        self.metric_pats = []  # (regex string, line)
+        self.sites = []        # (site, line)
+
+
+def telemetry_uses(tree):
+    """All telemetry-contract uses in one parsed file."""
+    uses = TelemetryUses()
+    forwarders = _emit_forwarders(tree)
+    emit_bare, metric_bare = _bare_imports(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d is None:
+            continue
+        parts = d.split(".")
+        last = parts[-1]
+        arg0 = node.args[0] if node.args else None
+        lit = arg0.value if isinstance(arg0, ast.Constant) and \
+            isinstance(arg0.value, str) else None
+        if last == "fault_point":
+            if lit:
+                uses.sites.append((lit, node.lineno))
+        elif last == "emit" or (len(parts) == 1
+                                and last in forwarders):
+            ok = (len(parts) > 1 and parts[-2] in _EMIT_RECEIVERS) or \
+                (len(parts) == 1 and (last in emit_bare
+                                      or last in forwarders))
+            if ok and lit:
+                uses.emits.append((lit, node.lineno))
+        elif last in _METRIC_FNS:
+            ok = (len(parts) > 1 and parts[-2] in _METRIC_RECEIVERS) or \
+                (len(parts) == 1 and last in metric_bare)
+            if not ok:
+                continue
+            if lit:
+                uses.metric_lits.append((lit, node.lineno))
+            elif isinstance(arg0, ast.JoinedStr):
+                pat, has_const = "", False
+                for v in arg0.values:
+                    if isinstance(v, ast.Constant):
+                        pat += re.escape(str(v.value))
+                        has_const = True
+                    else:
+                        pat += ".+"
+                if has_const:
+                    uses.metric_pats.append((pat, node.lineno))
+    return uses
+
+
+def _doc_schema(path):
+    """(kinds, metrics) documented in TELEMETRY.md: backticked tokens
+    in the FIRST cell of table rows, namespaced by the enclosing
+    heading ('event' tables document kinds, 'metric' tables document
+    instrument names)."""
+    kinds, metrics = {}, {}
+    heading = ""
+    in_code = False
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            if line.lstrip().startswith("```"):
+                in_code = not in_code
+                continue
+            if in_code:
+                continue
+            if line.startswith("#"):
+                heading = line.lower()
+                continue
+            s = line.strip()
+            if not s.startswith("|"):
+                continue
+            cells = s.split("|")
+            if len(cells) < 2:
+                continue
+            first = cells[1]
+            if set(first.strip()) <= set("-: "):
+                continue   # the |---|---| separator row
+            toks = [t.split("{")[0] for t in _DOC_TOKEN_RE.findall(first)]
+            if "event" in heading:
+                for t in toks:
+                    kinds.setdefault(t, i)
+            elif "metric" in heading:
+                for t in toks:
+                    metrics.setdefault(t, i)
+    return kinds, metrics
+
+
+def _doc_fault_sites(path):
+    """Documented fault-injection sites: the backticked ``a.b`` tokens
+    between 'Sites:' and 'Kinds:' in the ``MXNET_FAULT_INJECT`` doc
+    row.  None when the row (or the Sites: marker) is absent — the
+    contract is then unchecked rather than vacuously failed."""
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            cells = line.strip().split("|")
+            if len(cells) < 2 or "MXNET_FAULT_INJECT" not in cells[1]:
+                continue
+            lo = line.find("Sites:")
+            if lo < 0:
+                return None
+            hi = line.find("Kinds:", lo)
+            seg = line[lo:hi if hi > lo else len(line)]
+            sites = {t for t in _DOC_TOKEN_RE.findall(seg)
+                     if _SITE_RE.match(t)}
+            return sites, i
+    return None
+
+
+def check_contract(modules, telemetry_docs, env_docs, aux_tele,
+                   aux_env=None):
+    """TL015 over the scanned modules (code-side anchors) + the docs
+    (stale-row anchors).  ``aux_tele``/``aux_env`` are repo scans
+    (``rules_env.repo_scan``) rooted at the tree owning each docs file
+    — the reverse directions are judged against the WHOLE owning repo
+    (minus tests/examples) so partial-path lints don't report
+    contracts satisfied elsewhere as stale."""
+    findings = []
+    if not modules:
+        return findings
+    if aux_env is None:
+        aux_env = aux_tele
+    uses = {id(m): telemetry_uses(m.tree) for m in modules}
+
+    if telemetry_docs is not None:
+        kinds_doc, metrics_doc = _doc_schema(telemetry_docs)
+        rel = os.path.relpath(telemetry_docs)
+        for m in modules:
+            u = uses[id(m)]
+            for kind, line in u.emits:
+                if kind not in kinds_doc:
+                    findings.append(Finding(
+                        "TL015", m.path, line, 0,
+                        f"event kind `{kind}` is emitted here but has "
+                        f"no row in {rel}'s event-schema table — "
+                        "document the event (producer + fields) or "
+                        "rename the emit"))
+            for name, line in u.metric_lits:
+                if name not in metrics_doc:
+                    findings.append(Finding(
+                        "TL015", m.path, line, 0,
+                        f"metric `{name}` is created here but has no "
+                        f"row in {rel}'s metrics table — document the "
+                        "instrument (kind + labels) or rename it"))
+        aux_kinds = aux_tele.emit_kinds if aux_tele is not None else \
+            {k for u in uses.values() for k, _ in u.emits}
+        aux_lits = aux_tele.metric_lits if aux_tele is not None else \
+            {k for u in uses.values() for k, _ in u.metric_lits}
+        aux_pats = aux_tele.metric_pats if aux_tele is not None else \
+            {p for u in uses.values() for p, _ in u.metric_pats}
+        for kind, line in sorted(kinds_doc.items()):
+            if kind not in aux_kinds:
+                findings.append(Finding(
+                    "TL015", telemetry_docs, line, 0,
+                    f"event kind `{kind}` is documented but never "
+                    "emitted anywhere in the library or tooling — "
+                    "stale row; delete it or wire the emit up",
+                    snippet=f"event-schema row for {kind}"))
+        for name, line in sorted(metrics_doc.items()):
+            if name in aux_lits:
+                continue
+            if any(re.fullmatch(p, name) for p in aux_pats):
+                continue
+            findings.append(Finding(
+                "TL015", telemetry_docs, line, 0,
+                f"metric `{name}` is documented but never created "
+                "anywhere in the library or tooling — stale row; "
+                "delete it or wire the instrument up",
+                snippet=f"metrics row for {name}"))
+
+    if env_docs is not None:
+        doc_sites = _doc_fault_sites(env_docs)
+        if doc_sites is not None:
+            sites, row_line = doc_sites
+            rel = os.path.relpath(env_docs)
+            for m in modules:
+                for site, line in uses[id(m)].sites:
+                    if site not in sites:
+                        findings.append(Finding(
+                            "TL015", m.path, line, 0,
+                            f"fault-injection site `{site}` is not in "
+                            f"the MXNET_FAULT_INJECT site list in "
+                            f"{rel} — document it (operators can only "
+                            "arm sites they can discover) or rename "
+                            "the fault_point"))
+            aux_sites = aux_env.fault_sites if aux_env is not None else \
+                {s for u in uses.values() for s, _ in u.sites}
+            for site in sorted(sites):
+                if site not in aux_sites:
+                    findings.append(Finding(
+                        "TL015", env_docs, row_line, 0,
+                        f"fault-injection site `{site}` is documented "
+                        "in the MXNET_FAULT_INJECT row but no "
+                        "fault_point with that name exists — stale; "
+                        "delete it or add the site",
+                        snippet=f"MXNET_FAULT_INJECT site {site}"))
+    return findings

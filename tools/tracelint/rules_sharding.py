@@ -64,12 +64,20 @@ class SharedState:
     """Project-wide facts computed once and shared by every per-module
     pass (and, under ``--jobs``, inherited by every worker)."""
 
-    __slots__ = ("mesh_axes", "vocab", "acct_drops")
+    __slots__ = ("mesh_axes", "vocab", "acct_drops", "module_lock_defs",
+                 "locks", "instances")
 
     def __init__(self):
         self.mesh_axes = {}   # axis -> "path:line" of a binding mesh def
         self.vocab = {}       # axis -> site (mesh defs + param defaults)
         self.acct_drops = set()   # subsystems with a release path
+        # lock analysis shared by TL004/TL012/TL013 (see locks.py):
+        self.module_lock_defs = {}   # (modname, varname) -> ctor name
+        self.locks = {}              # id(module) -> LockAnalysis
+        # module-level singleton bindings (`ACCOUNTANT =
+        # MemoryAccountant()`), so TL012 can resolve `ACCOUNTANT.drop`
+        # through the instance to the class's method
+        self.instances = {}          # (modname, varname) -> (mod, ClassDef)
 
 
 # --------------------------------------------------------------------- #
@@ -158,7 +166,34 @@ def _resolve_axis_expr(expr, scopes):
 # --------------------------------------------------------------------- #
 
 def build_state(project):
+    from .locks import build_locks, is_lock_ctor
+
     st = SharedState()
+    # module-level lock globals + singleton instance bindings, needed
+    # before the per-module lock analyses can resolve imported locks
+    for m in project.modules:
+        modname = project.names[id(m)] or m.path
+        for stmt in m.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            names = [t.id for t in stmt.targets
+                     if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            ctor = is_lock_ctor(stmt.value)
+            if ctor:
+                for n in names:
+                    st.module_lock_defs[(modname, n)] = ctor
+            elif isinstance(stmt.value, ast.Call):
+                ckey = project._resolve_class_ref(m, stmt.value.func)
+                if ckey is not None:
+                    hit = project._class_key.get(ckey)
+                    if hit is not None:
+                        for n in names:
+                            st.instances[(modname, n)] = hit
+    for m in project.modules:
+        st.locks[id(m)] = build_locks(m, project.imports[id(m)],
+                                      st.module_lock_defs)
     for m in project.modules:
         idx = project.index(m)
         for call, _scopes in idx.calls:

@@ -13,249 +13,46 @@ enforces consistency, not adoption):
 
 Works at class level (``self.X = threading.Lock()``) and module level
 (``_lock = threading.Lock()`` guarding module globals).
+
+Since tracelint v3 the held-lock region walk itself lives in
+:mod:`.locks` — computed once per module in ``build_state`` and shared
+with TL012 (finalizer lock safety) and TL013 (callback-under-lock), so
+the three rules pay for one analysis.
 """
 from __future__ import annotations
 
-import ast
-
-from .callgraph import dotted, iter_own
 from .core import Finding
 
 __all__ = ["check_module"]
 
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
-               "BoundedSemaphore"}
-_MUTATORS = {"append", "appendleft", "pop", "popleft", "clear", "extend",
-             "extendleft", "remove", "insert", "add", "discard", "update",
-             "setdefault", "popitem", "sort", "reverse"}
 
-
-def _is_lock_ctor(expr):
-    if not isinstance(expr, ast.Call):
-        return False
-    d = dotted(expr.func)
-    return bool(d) and d.split(".")[-1] in _LOCK_CTORS
-
-
-class _Mutation:
-    __slots__ = ("field", "line", "col", "held", "method")
-
-    def __init__(self, field, line, col, held, method):
-        self.field = field
-        self.line = line
-        self.col = col
-        self.held = held       # tuple of lock keys held at this point
-        self.method = method
-
-
-def _walk_mutations(fn_node, lock_of_expr, field_of_node, method_name,
-                    acquisitions):
-    """Collect mutations + lock-acquisition order pairs in one method.
-
-    ``lock_of_expr(expr)`` -> lock key for a with-item, or None.
-    ``field_of_node(node)`` -> iterable of mutated field keys.
-    """
-    muts = []
-
-    def walk(node, held):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                continue
-            new_held = held
-            if isinstance(child, (ast.With, ast.AsyncWith)):
-                for item in child.items:
-                    lock = lock_of_expr(item.context_expr)
-                    if lock is not None:
-                        if new_held:
-                            acquisitions.append(
-                                (new_held[-1], lock, child.lineno))
-                        new_held = new_held + (lock,)
-            for field in field_of_node(child):
-                muts.append(_Mutation(field, child.lineno,
-                                      getattr(child, "col_offset", 0),
-                                      new_held, method_name))
-            walk(child, new_held)
-
-    walk(fn_node, ())
-    return muts
-
-
-def _self_attr(expr):
-    if isinstance(expr, ast.Attribute) and \
-            isinstance(expr.value, ast.Name) and expr.value.id == "self":
-        return expr.attr
-    return None
-
-
-def _class_field_of_node(lock_attrs):
-    def fields(node):
-        out = []
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
-            for t in targets:
-                attr = _self_attr(t)
-                if attr and attr not in lock_attrs:
-                    out.append(attr)
-                if isinstance(t, ast.Subscript):
-                    attr = _self_attr(t.value)
-                    if attr:
-                        out.append(attr)
-                if isinstance(t, (ast.Tuple, ast.List)):
-                    for e in t.elts:
-                        attr = _self_attr(e)
-                        if attr and attr not in lock_attrs:
-                            out.append(attr)
-        elif isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr in _MUTATORS:
-            attr = _self_attr(node.func.value)
-            if attr:
-                out.append(attr)
-        elif isinstance(node, ast.Delete):
-            for t in node.targets:
-                attr = _self_attr(t)
-                if attr:
-                    out.append(attr)
-                if isinstance(t, ast.Subscript):
-                    attr = _self_attr(t.value)
-                    if attr:
-                        out.append(attr)
-        return out
-    return fields
-
-
-def _class_methods(cls):
-    """Every function belonging to ``cls`` — methods and their nested
-    closures, but NOT anything inside a nested ClassDef (the inner
-    class owns its own lock discipline and is checked separately)."""
-    out, stack = [], list(ast.iter_child_nodes(cls))
-    while stack:
-        n = stack.pop()
-        if isinstance(n, ast.ClassDef):
-            continue
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.append(n)
-        stack.extend(ast.iter_child_nodes(n))
-    return out
-
-
-def _check_class(module, cls, acquisitions):
-    methods = _class_methods(cls)
-    lock_attrs = set()
-    for m in methods:
-        for n in iter_own(m):
-            if isinstance(n, ast.Assign) and _is_lock_ctor(n.value):
-                for t in n.targets:
-                    attr = _self_attr(t)
-                    if attr:
-                        lock_attrs.add(attr)
-    if not lock_attrs:
-        return []
-
-    def lock_of(expr):
-        attr = _self_attr(expr)
-        if attr in lock_attrs:
-            return f"{cls.name}.{attr}"
-        # with self._lock.acquire_timeout(...) style — attribute chains
-        d = dotted(expr.func) if isinstance(expr, ast.Call) else None
-        if d and d.startswith("self."):
-            parts = d.split(".")
-            if len(parts) >= 2 and parts[1] in lock_attrs:
-                return f"{cls.name}.{parts[1]}"
-        return None
-
-    muts = []
-    for m in methods:
-        muts.extend(_walk_mutations(m, lock_of,
-                                    _class_field_of_node(lock_attrs),
-                                    m.name, acquisitions))
-    protected = {mu.field for mu in muts if mu.held}
-    out = []
-    for mu in muts:
-        if mu.field in protected and not mu.held and \
-                mu.method != "__init__":
-            out.append(Finding(
-                "TL004", module.path, mu.line, mu.col,
-                f"`self.{mu.field}` is mutated under the lock elsewhere "
-                f"in `{cls.name}` but `{mu.method}` mutates it without "
-                "holding it — take the lock or document why this "
-                "mutation cannot race"))
-    return out
-
-
-def _check_module_level(module, acquisitions):
-    tree = module.tree
-    mod_locks = set()
-    mod_names = set()
-    for stmt in tree.body:
-        if isinstance(stmt, ast.Assign):
-            names = [t.id for t in stmt.targets
-                     if isinstance(t, ast.Name)]
-            mod_names.update(names)
-            if _is_lock_ctor(stmt.value):
-                mod_locks.update(names)
-    if not mod_locks:
-        return []
-
-    def lock_of(expr):
-        d = dotted(expr)
-        if d in mod_locks:
-            return f"{module.path}:{d}"
-        return None
-
-    def fields(node):
-        out = []
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
-            for t in targets:
-                if isinstance(t, ast.Subscript) and \
-                        isinstance(t.value, ast.Name) and \
-                        t.value.id in mod_names:
-                    out.append(t.value.id)
-        elif isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr in _MUTATORS and \
-                isinstance(node.func.value, ast.Name) and \
-                node.func.value.id in mod_names:
-            out.append(node.func.value.id)
-        elif isinstance(node, ast.Delete):
-            for t in node.targets:
-                if isinstance(t, ast.Subscript) and \
-                        isinstance(t.value, ast.Name) and \
-                        t.value.id in mod_names:
-                    out.append(t.value.id)
-        return out
-
-    muts = []
-    for fn in ast.walk(tree):
-        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            muts.extend(_walk_mutations(fn, lock_of, fields, fn.name,
-                                        acquisitions))
-    protected = {mu.field for mu in muts if mu.held}
-    out = []
-    for mu in muts:
+def check_module(shared, module):
+    la = shared.locks[id(module)]
+    findings = []
+    # -- class-level: unlocked mutations of protected self-fields -------- #
+    for cls, muts in la.class_muts.values():
+        protected = {mu.field for mu in muts if mu.held}
+        for mu in muts:
+            if mu.field in protected and not mu.held and \
+                    mu.method != "__init__":
+                findings.append(Finding(
+                    "TL004", module.path, mu.line, mu.col,
+                    f"`self.{mu.field}` is mutated under the lock "
+                    f"elsewhere in `{cls.name}` but `{mu.method}` "
+                    "mutates it without holding it — take the lock or "
+                    "document why this mutation cannot race"))
+    # -- module-level globals --------------------------------------------- #
+    protected = {mu.field for mu in la.module_muts if mu.held}
+    for mu in la.module_muts:
         if mu.field in protected and not mu.held:
-            out.append(Finding(
+            findings.append(Finding(
                 "TL004", module.path, mu.line, mu.col,
                 f"module global `{mu.field}` is mutated under the lock "
                 f"elsewhere but `{mu.method}` mutates it without holding "
                 "it"))
-    return out
-
-
-def check_module(module):
-    findings = []
-    acquisitions = []  # (outer lock, inner lock, line)
-    for node in ast.walk(module.tree):
-        if isinstance(node, ast.ClassDef):
-            findings.extend(_check_class(module, node, acquisitions))
-    findings.extend(_check_module_level(module, acquisitions))
     # -- lock-order inversions ------------------------------------------- #
     pairs = {}
-    for outer, inner, line in acquisitions:
+    for outer, inner, line in la.acquisitions:
         pairs.setdefault((outer, inner), []).append(line)
     for (a, b), lines in sorted(pairs.items()):
         if (b, a) in pairs and a < b:  # report one direction once
