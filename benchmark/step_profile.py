@@ -388,6 +388,89 @@ def profile_fused_step(smoke=False):
     return rows
 
 
+def profile_checkpoint(smoke=False):
+    """Checkpoint-stall phase rows (ISSUE 15 acceptance): what one
+    ``mx.checkpoint`` save costs the training loop, per mode.  The sync
+    arm pays snapshot + atomic write inline; the async arm pays ONLY
+    the device→host snapshot (``save()`` returns once the values are
+    host-resident — the donation-safety contract — and the fsync+rename
+    commit happens on the writer thread).  The stall is the measured
+    ``save()`` wall time; steady-state step time with a save every
+    step quantifies the residual overlap cost."""
+    import shutil
+    import tempfile
+    import time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ndarray.ndarray import waitall
+
+    n_layers, units, bs = (8, 8, 4) if smoke else (50, 64, 32)
+    reps = 3 if smoke else 10
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(bs, units).astype(onp.float32))
+    y = mx.nd.array(rng.randn(bs, 1).astype(onp.float32))
+    loss_l = gluon.loss.L2Loss()
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(n_layers - 1):
+            net.add(nn.Dense(units, use_bias=False, in_units=units))
+        net.add(nn.Dense(1, use_bias=False, in_units=units))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adamw",
+                            {"learning_rate": 1e-4}, kvstore=None)
+
+    def loss_fn(bx, by):
+        return loss_l(net(bx), by)
+
+    for _ in range(2):
+        trainer.fused_step(loss_fn, x, y)
+    waitall()
+
+    def run(mode):
+        tmp = tempfile.mkdtemp(prefix="mxnet_ckpt_bench_")
+        mgr = None
+        if mode != "no-save":
+            mgr = mx.checkpoint.CheckpointManager(
+                tmp, max_to_keep=2, async_save=(mode == "async-save"))
+        stalls = []
+        t0 = time.perf_counter()
+        for k in range(reps):
+            trainer.fused_step(loss_fn, x, y)
+            if mgr is not None:
+                s0 = time.perf_counter()
+                mgr.save(k + 1, net, trainer)
+                stalls.append(time.perf_counter() - s0)
+        if mgr is not None:
+            mgr.wait_until_finished()
+        waitall()
+        step_ms = (time.perf_counter() - t0) / reps * 1e3
+        if mgr is not None:
+            mgr.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+        stall_ms = (sum(stalls) / len(stalls) * 1e3) if stalls else 0.0
+        return step_ms, stall_ms
+
+    print(f"\ncheckpoint phase ({n_layers}-layer chain, save every "
+          f"step, {'smoke' if smoke else 'baseline'} workload):")
+    rows = []
+    for mode in ("no-save", "sync-save", "async-save"):
+        step_ms, stall_ms = run(mode)
+        rows.append((mode, step_ms, stall_ms))
+        print(f"  {mode:10s}: {step_ms:8.2f} ms/step   "
+              f"save stall {stall_ms:8.2f} ms")
+        emit_row({"bench": "step_profile", "mode": "checkpoint_phase",
+                  "arm": mode, "n_layers": n_layers,
+                  "workload": "smoke" if smoke else "baseline",
+                  "ms_per_step": round(step_ms, 3),
+                  "save_stall_ms": round(stall_ms, 3)})
+    return rows
+
+
 def profile_optimizer_apply(trainer, iters=10):
     """Optimizer-apply phase row for the IMPERATIVE Trainer path (the
     API-parity path the SPMD profile above doesn't cover): the fused
@@ -496,6 +579,8 @@ def main():
                     help="skip the input-pipeline / H2D overlap phase rows")
     ap.add_argument("--no-fused-step-phase", action="store_true",
                     help="skip the fused-step phase rows")
+    ap.add_argument("--no-checkpoint-phase", action="store_true",
+                    help="skip the checkpoint save-stall phase rows")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fused-step phase rows only (tier-1 gate: "
                          "no model build, no trace, runs on CPU in "
@@ -513,6 +598,13 @@ def main():
         # timing at toy sizes is noise): every fused row must be exactly
         # one executable dispatch per step
         assert all(d == 1 for m, d, _ in rows if m.startswith("fused"))
+        ck = profile_checkpoint(smoke=True)
+        # async stall must be measured and strictly the snapshot side:
+        # the async arm's save() wall is bounded by the sync arm's
+        # (snapshot + atomic write) on any platform
+        ck = {m: (step, stall) for m, step, stall in ck}
+        assert ck["async-save"][1] > 0.0
+        assert ck["async-save"][1] <= ck["sync-save"][1] * 1.5 + 5.0, ck
         assert train_step_op_count_smoke() > 0
         return 0
     if args.model is None:
@@ -576,6 +668,8 @@ def main():
         profile_optimizer_apply(trainer)
     if not args.no_fused_step_phase:
         profile_fused_step()
+    if not args.no_checkpoint_phase:
+        profile_checkpoint()
     return 0
 
 

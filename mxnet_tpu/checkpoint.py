@@ -1,32 +1,182 @@
-"""``mx.checkpoint`` — orbax-backed sharded/async checkpointing.
+"""``mx.checkpoint`` — atomic, integrity-checked, async checkpoints
+with bit-exact resume (ISSUE 15).
 
 Reference context (SURVEY.md §5.3/§5.4): the reference's fault-tolerance
-story is "checkpoint every epoch and restart the launcher"; its formats are
-the ``.params`` binary (kept, ndarray/serialization.py) + optimizer-state
-pickles.  The TPU-native upgrade specified in SURVEY.md is "orbax
-checkpoints (sharded, async) + auto-resume" — this module is that:
+story is "checkpoint every epoch and restart the launcher"; its formats
+are the ``.params`` binary + optimizer-state pickles, written in place —
+a preempted host mid-write leaves a half-file the next run loads or
+crashes on.  The TPU-native upgrade specified there (orbax-style
+sharded/async checkpoints + auto-resume) is implemented natively here so
+every property the recovery loop stands on is explicit and testable:
 
-- :class:`CheckpointManager` — step-indexed directory of checkpoints with
-  retention, async save (training continues while the previous step
-  serializes), and sharding-aware restore (multi-host: each host writes its
-  own shards).
-- :func:`save` / :func:`restore` / :func:`latest_step` — functional API
-  over a Gluon block (+ optional Trainer state).
-- auto-resume: ``restore(...)`` with ``step=None`` loads the newest
-  complete checkpoint, the launcher-restart recovery loop in one call.
+- **Commit-or-invisible saves.**  Every step is written to a hidden
+  temp directory (``.tmp-step_XXXXXXXX-<pid>-<nonce>``), each array
+  file and the manifest are fsynced, the directory itself is fsynced,
+  and only then is it renamed to ``step_XXXXXXXX`` (one atomic rename
+  on POSIX).  A rank SIGKILLed mid-save leaves a temp directory that
+  restore reports (``checkpoint_corrupt`` event) and cleans up — never
+  a half-checkpoint that parses.
+- **Integrity-checked restore.**  ``MANIFEST.json`` records every
+  array's file, shape, dtype, byte size, and CRC32.  ``restore(step=
+  None)`` walks steps newest-first, verifies each candidate, emits a
+  loud ``checkpoint_corrupt`` event for any damaged/incomplete one and
+  falls back to the newest verifiable step — corruption is an event,
+  never a crash.  An explicitly requested ``step=`` that fails
+  verification raises a clean :class:`MXNetError` instead.
+- **Async save without donation hazards.**  ``async_save=True``
+  snapshots device→host *synchronously inside* ``save()`` (the only
+  part the training loop waits for — measured as ``snapshot_s`` and in
+  ``benchmark/step_profile.py``); the atomic write happens on a
+  background writer thread.  The fused train step donates weight /
+  optimizer-state / accumulator buffers into the next executable, so
+  the snapshot MUST complete before the next step dispatches — which
+  it does, because ``save()`` doesn't return until the host copy is
+  done.  A failed background write surfaces on the next
+  ``save()``/``wait_until_finished()``.
+- **Bit-exact resume.**  A checkpoint captures everything the step
+  function consumes: params, optimizer states / ``num_update`` /
+  per-index update counts, the fused-step accumulation-window position
+  plus the device accumulator ring(s) for a mid-window save (a
+  mid-window save on the non-fused path refuses loudly instead of
+  silently dropping the partial window), ``amp`` loss-scaler state,
+  the ``mx.random`` root key, and — via ``extra=`` — the data-pipeline
+  cursor (epoch + batch index; restore fast-forwards the sampler with
+  ``DataLoader.iter_from``, never replays batches).  Kill-and-resume
+  equals uninterrupted, pinned by the chaos parity tests.
+- **Resharding restore.**  Arrays are stored as full logical host
+  values; restore places each one with the *target* parameter's
+  current sharding (``Parameter._load_init``), so a checkpoint saved
+  on the 8-device dryrun mesh restores onto a 1-device mesh and vice
+  versa.  A shape mismatch raises an :class:`MXNetError` naming both
+  the saved and the current mesh — no silent replication.
+
+Known limits (documented in docs/CHECKPOINT.md): one writer per
+directory (multi-host pods give each process its own directory, e.g.
+``$MXNET_CHECKPOINT_DIR/rank<r>``); the RNG capture covers the calling
+thread's root key (traced draws ride the trace-key operand and need no
+capture); array payloads are buffered in host memory during write.
+
+Chaos sites: ``checkpoint.save`` fires after the temp files are
+durable but *before* the commit rename (a ``kill`` there is the
+preempted-mid-save scenario), ``checkpoint.restore`` fires at restore
+entry.  See ``MXNET_FAULT_INJECT`` in docs/ENV_VARS.md.
 """
 from __future__ import annotations
 
+import io
+import json
 import os
+import queue as _queue
+import re
+import shutil
+import threading
+import time
+import uuid
+import zlib
 
 import jax
+import jax.numpy as jnp
 import numpy as onp
 
+from . import telemetry
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from .telemetry.faults import fault_point
 
-__all__ = ["CheckpointManager", "save", "restore", "latest_step"]
+__all__ = ["CheckpointManager", "save", "restore", "latest_step",
+           "verify_step", "restart_count"]
 
+FORMAT_VERSION = 1
+_MANIFEST = "MANIFEST.json"
+_STEP_RE = re.compile(r"^step_(\d{8,})$")
+_TMP_PREFIX = ".tmp-"
+
+
+def _step_dirname(step):
+    return f"step_{int(step):08d}"
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def restart_count():
+    """This process's pod-restart generation: 0 on the first launch,
+    incremented by the ``tools/launch.py --restarts`` supervisor on
+    every respawn (``MXNET_RESTART_COUNT``).  Rank code uses it to
+    behave differently across attempts — e.g. a chaos script arms its
+    ``MXNET_FAULT_INJECT`` rule only when ``restart_count() == 0`` so
+    an injected kill doesn't recur forever."""
+    try:
+        return int(os.environ.get("MXNET_RESTART_COUNT", "0"))
+    except ValueError:
+        return 0
+
+
+# --------------------------------------------------------------------- #
+# pytree <-> (json structure, host array leaves)
+# --------------------------------------------------------------------- #
+
+def _is_array(x):
+    return isinstance(x, (jax.Array, onp.ndarray, onp.generic, NDArray))
+
+
+def _enc(x, leaves):
+    """Encode a checkpoint tree into a JSON-able structure + a flat
+    list of HOST numpy leaves.  ``jax.Array`` leaves are device_get
+    here — this is the synchronous device→host snapshot, and the only
+    part of an async save the training loop waits for."""
+    if isinstance(x, NDArray):
+        x = x._data
+    if isinstance(x, jax.Array):
+        leaves.append(onp.asarray(jax.device_get(x)))
+        return {"@arr": len(leaves) - 1}
+    if isinstance(x, (onp.ndarray, onp.generic)):
+        leaves.append(onp.asarray(x))
+        return {"@arr": len(leaves) - 1}
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return {"@val": x}
+    if isinstance(x, (list, tuple)):
+        return {"@seq": [_enc(v, leaves) for v in x],
+                "tuple": isinstance(x, tuple)}
+    if isinstance(x, dict):
+        items = []
+        for k, v in x.items():
+            if not isinstance(k, (str, int)):
+                raise MXNetError(
+                    f"checkpoint: unsupported dict key type "
+                    f"{type(k).__name__} (str/int only)")
+            items.append([["i" if isinstance(k, int) else "s", k],
+                          _enc(v, leaves)])
+        return {"@dict": items}
+    raise MXNetError(
+        f"checkpoint: unsupported leaf type {type(x).__name__}")
+
+
+def _dec(node, leaves, leaf_fn=None):
+    if "@arr" in node:
+        a = leaves[node["@arr"]]
+        return leaf_fn(a) if leaf_fn is not None else a
+    if "@val" in node:
+        return node["@val"]
+    if "@seq" in node:
+        seq = [_dec(v, leaves, leaf_fn) for v in node["@seq"]]
+        return tuple(seq) if node.get("tuple") else seq
+    if "@dict" in node:
+        out = {}
+        for (kt, k), v in node["@dict"]:
+            out[int(k) if kt == "i" else k] = _dec(v, leaves, leaf_fn)
+        return out
+    raise MXNetError(f"checkpoint: malformed structure node {node!r}")
+
+
+# --------------------------------------------------------------------- #
+# training-state capture
+# --------------------------------------------------------------------- #
 
 def _block_tree(block):
     """Block params as a flat name->jax.Array dict (structured names)."""
@@ -40,105 +190,493 @@ def _block_tree(block):
 
 
 def _trainer_tree(trainer):
+    """Everything the step function consumes beyond the params: the
+    optimizer (states, schedule counters), the gradient-accumulation
+    window (position + the device accumulator ring of every cached
+    FusedStep — a mid-window save on the non-fused path has no ring to
+    record and refuses loudly), and the amp loss-scaler state."""
     if trainer is None:
         return None
-    states = [s for s, made in zip(trainer._states, trainer._states_created)]
+    rings = [list(fs._accum) for fs in trainer._fused_steps.values()
+             if getattr(fs, "_accum", None)]
+    if trainer._window_pos != 0 and not rings:
+        raise MXNetError(
+            f"checkpoint: mid-accumulation-window save (micro-batch "
+            f"{trainer._window_pos}/{trainer._update_interval}) without "
+            "a fused-step accumulator ring: the partial window lives in "
+            "grad buffers this checkpoint does not capture, so resume "
+            "could not be bit-exact. Save at the window boundary, or "
+            "drive the window with fused_step() (its device ring is "
+            "captured).")
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
     return {
-        "states": states,
+        "states": list(trainer._states),
         "created": list(trainer._states_created),
-        "num_update": trainer._optimizer.num_update,
-        "index_update_count": dict(trainer._optimizer._index_update_count),
+        "num_update": int(trainer._optimizer.num_update),
+        "index_update_count": {
+            int(k): int(v)
+            for k, v in trainer._optimizer._index_update_count.items()},
+        "window_pos": int(trainer._window_pos),
+        "accum": rings,
+        "loss_scaler": None if scaler is None else {
+            "loss_scale": float(scaler.loss_scale),
+            "unskipped": int(scaler._unskipped)},
     }
 
 
-class CheckpointManager:
-    """Step-indexed async checkpoints (orbax CheckpointManager facade)."""
+def _rng_tree():
+    from . import random as mxrandom
 
-    def __init__(self, directory, max_to_keep=5, async_save=True):
-        import orbax.checkpoint as ocp
+    return mxrandom.get_state()
+
+
+def _mesh_info():
+    devs = jax.devices()
+    return {"device_count": len(devs),
+            "platform": devs[0].platform if devs else "unknown",
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count()}
+
+
+def _apply_params(block, loaded, saved_mesh):
+    """Load ``name -> host array`` into the block, placing every value
+    with the parameter's CURRENT sharding (``_load_init``), so a
+    checkpoint reshards onto whatever mesh the params live on now.  A
+    shape mismatch is a clean error naming both meshes — never a
+    silent replication of wrong-shaped data."""
+    params = block._collect_params_with_prefix()
+    here = _mesh_info()
+    for name, p in params.items():
+        if name not in loaded:
+            raise MXNetError(f"checkpoint missing parameter {name}")
+        arr = loaded[name]
+        if p.shape and None not in p.shape and \
+                tuple(arr.shape) != tuple(p.shape):
+            raise MXNetError(
+                f"checkpoint: parameter {name} was saved with shape "
+                f"{tuple(arr.shape)} on a {saved_mesh.get('device_count')}"
+                f"-device {saved_mesh.get('platform')} mesh but the "
+                f"current parameter has shape {tuple(p.shape)} on a "
+                f"{here['device_count']}-device {here['platform']} mesh "
+                "— the logical shapes must match for a reshard; "
+                "rebuild the block to the saved geometry or pass the "
+                "matching checkpoint")
+        p._load_init(NDArray(jnp.asarray(arr)))
+
+
+def _apply_trainer(trainer, t):
+    trainer._states = [None if s is None else
+                       jax.tree.map(jnp.asarray, s) for s in t["states"]]
+    trainer._states_created = [bool(x) for x in t["created"]]
+    trainer._optimizer.num_update = int(t["num_update"])
+    trainer._optimizer._index_update_count = {
+        int(k): int(v) for k, v in t["index_update_count"].items()}
+    trainer._window_pos = int(t.get("window_pos", 0))
+    # every cached FusedStep's ring is stale relative to the restored
+    # window: drop them, and stage the saved ring(s) for adoption on
+    # the next fused call (matched by shape — see FusedStep.__call__)
+    for fs in trainer._fused_steps.values():
+        fs._accum = None
+    trainer._pending_accum = [
+        [jnp.asarray(a) for a in ring] for ring in t.get("accum", [])]
+    ls = t.get("loss_scaler")
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if ls is not None and scaler is not None:
+        scaler.loss_scale = float(ls["loss_scale"])
+        scaler._unskipped = int(ls["unskipped"])
+
+
+# --------------------------------------------------------------------- #
+# manager
+# --------------------------------------------------------------------- #
+
+class _Corrupt(Exception):
+    """Internal: a step directory failed verification (why in args)."""
+
+
+class CheckpointManager:
+    """Step-indexed directory of atomic checkpoints with retention,
+    async save, integrity-checked auto-resume, and bit-exact
+    training-state capture.  ``directory=None`` uses
+    ``MXNET_CHECKPOINT_DIR`` (exported per rank by
+    ``tools/launch.py --checkpoint-dir``)."""
+
+    def __init__(self, directory=None, max_to_keep=5, async_save=True):
+        directory = directory or os.environ.get("MXNET_CHECKPOINT_DIR")
+        if not directory:
+            raise MXNetError(
+                "CheckpointManager: no directory given and "
+                "MXNET_CHECKPOINT_DIR is unset")
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
-        opts = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                            enable_async_checkpointing=
-                                            async_save)
-        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+        if max_to_keep is not None and int(max_to_keep) < 1:
+            raise MXNetError("max_to_keep must be >= 1 (or None)")
+        self._max_to_keep = None if max_to_keep is None else int(max_to_keep)
+        self._async = bool(async_save)
+        self._lock = threading.Lock()
+        self._jobs = None
+        self._writer = None
+        self._error = None
+        self._closed = False
 
+    @property
+    def directory(self):
+        return self._dir
+
+    # -- save ----------------------------------------------------------- #
     def save(self, step, block, trainer=None, extra=None):
-        """Async-save params (+ trainer optimizer state, + extra numpy
-        pytree) at ``step``."""
-        import orbax.checkpoint as ocp
-        tree = {"params": _block_tree(block)}
+        """Checkpoint ``step``: params (+ trainer training state, + an
+        ``extra`` pytree such as the data cursor).  Synchronous part:
+        the device→host snapshot (donation-safe — completes before the
+        next fused step can donate the buffers).  With
+        ``async_save=True`` the atomic write then happens on the
+        background writer; a failed write raises here on the NEXT call
+        (or on ``wait_until_finished``)."""
+        self._raise_pending()
+        if self._closed:
+            raise MXNetError("CheckpointManager is closed")
+        step = int(step)
+        t0 = time.perf_counter()
+        tree = {"params": _block_tree(block), "rng": _rng_tree()}
         t = _trainer_tree(trainer)
         if t is not None:
             tree["trainer"] = t
         if extra is not None:
             tree["extra"] = extra
-        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        leaves = []
+        struct = _enc(tree, leaves)
+        snapshot_s = time.perf_counter() - t0
+        telemetry.histogram("checkpoint_save_seconds",
+                            phase="snapshot").observe(snapshot_s)
+        if self._async:
+            self._ensure_writer()
+            self._jobs.put((step, struct, leaves, snapshot_s))
+        else:
+            self._write_step(step, struct, leaves, snapshot_s)
         return step
 
-    def restore(self, block, trainer=None, step=None):
-        """Restore into ``block`` (and ``trainer``); ``step=None`` resumes
-        from the newest complete checkpoint.  Returns the step restored, or
-        None if the directory has no checkpoints (fresh start)."""
-        import orbax.checkpoint as ocp
-        if step is None:
-            step = self._mgr.latest_step()
-            if step is None:
-                return None
-        restored = self._mgr.restore(step)
-        params = block._collect_params_with_prefix()
-        loaded = restored["params"]
-        for name, p in params.items():
-            if name not in loaded:
-                raise MXNetError(f"checkpoint missing parameter {name}")
-            p._load_init(NDArray(jax.numpy.asarray(loaded[name])))
-        if trainer is not None and "trainer" in restored:
-            t = restored["trainer"]
-            trainer._states = list(t["states"])
-            trainer._states_created = [bool(x) for x in t["created"]]
-            trainer._optimizer.num_update = int(t["num_update"])
-            trainer._optimizer._index_update_count = {
-                int(k) if str(k).isdigit() else k: int(v)
-                for k, v in t["index_update_count"].items()}
-        return step
+    def _ensure_writer(self):
+        with self._lock:
+            if self._writer is None or not self._writer.is_alive():
+                self._jobs = _queue.Queue()
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="mxnet-ckpt-writer",
+                    daemon=True)
+                self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._jobs.get()
+            try:
+                if job is None:
+                    return
+                try:
+                    self._write_step(*job)
+                except Exception as e:
+                    with self._lock:
+                        if self._error is None:
+                            self._error = e
+            finally:
+                self._jobs.task_done()
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise MXNetError(
+                f"checkpoint: a background save failed: {err}") from err
+
+    def _write_step(self, step, struct, leaves, snapshot_s):
+        t0 = time.perf_counter()
+        final = os.path.join(self._dir, _step_dirname(step))
+        tmp = os.path.join(
+            self._dir, f"{_TMP_PREFIX}{_step_dirname(step)}-"
+                       f"{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        os.makedirs(tmp)
+        try:
+            arrays = []
+            total = 0
+            for i, a in enumerate(leaves):
+                buf = io.BytesIO()
+                onp.save(buf, a, allow_pickle=False)
+                data = buf.getvalue()
+                fname = f"arr_{i:05d}.npy"
+                with open(os.path.join(tmp, fname), "wb") as fh:
+                    fh.write(data)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                arrays.append({"file": fname, "shape": list(a.shape),
+                               "dtype": str(a.dtype),
+                               "bytes": len(data),
+                               "crc32": zlib.crc32(data) & 0xFFFFFFFF})
+                total += len(data)
+            manifest = {"format": FORMAT_VERSION, "step": step,
+                        "saved_unix": time.time(),
+                        "library": "mxnet_tpu",
+                        "mesh": _mesh_info(), "tree": struct,
+                        "arrays": arrays}
+            with open(os.path.join(tmp, _MANIFEST), "w",
+                      encoding="utf-8") as fh:
+                json.dump(manifest, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            _fsync_dir(tmp)
+            # the injected-preemption point: everything is durably in
+            # the temp dir, nothing is committed — a kill here leaves
+            # a checkpoint that never becomes visible
+            fault_point("checkpoint.save", step=step)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except BaseException:
+            # a failed (or fault-aborted) write cleans up its own temp
+            # dir: same-pid temp dirs are deliberately exempt from the
+            # restore-time sweep (they may be a LIVE writer's), so an
+            # abandoned one would otherwise linger for this process's
+            # whole life
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        _fsync_dir(self._dir)
+        write_s = time.perf_counter() - t0
+        telemetry.histogram("checkpoint_save_seconds",
+                            phase="write").observe(write_s)
+        telemetry.counter("checkpoints_saved_total").inc()
+        telemetry.emit("checkpoint_saved", step=step, dir=self._dir,
+                       bytes=total, arrays=len(arrays),
+                       snapshot_s=round(snapshot_s, 6),
+                       write_s=round(write_s, 6),
+                       async_save=self._async)
+        self._retain()
+
+    def _retain(self):
+        if self._max_to_keep is None:
+            return
+        steps = self.all_steps()
+        while len(steps) > self._max_to_keep:
+            victim = steps.pop(0)
+            shutil.rmtree(os.path.join(self._dir, _step_dirname(victim)),
+                          ignore_errors=True)
+
+    # -- discovery / verification --------------------------------------- #
+    def all_steps(self):
+        """Committed step numbers, ascending (no integrity check —
+        see :meth:`verify` / :meth:`latest_step`)."""
+        out = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return out
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self._dir, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _load_verified(self, step, keep_arrays=True):
+        """Read + integrity-check one step: manifest parse, per-array
+        byte size and CRC32.  Returns (manifest, leaves) or raises
+        :class:`_Corrupt` naming what failed."""
+        d = os.path.join(self._dir, _step_dirname(step))
+        mpath = os.path.join(d, _MANIFEST)
+        try:
+            with open(mpath, "r", encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            raise _Corrupt(f"manifest unreadable ({e})")
+        if manifest.get("format") != FORMAT_VERSION:
+            raise _Corrupt(
+                f"format {manifest.get('format')!r} != {FORMAT_VERSION}")
+        leaves = []
+        for meta in manifest.get("arrays", []):
+            fpath = os.path.join(d, meta["file"])
+            try:
+                with open(fpath, "rb") as fh:
+                    data = fh.read()
+            except OSError as e:
+                raise _Corrupt(f"array {meta['file']} unreadable ({e})")
+            if len(data) != meta["bytes"]:
+                raise _Corrupt(
+                    f"array {meta['file']} truncated "
+                    f"({len(data)} != {meta['bytes']} bytes)")
+            if (zlib.crc32(data) & 0xFFFFFFFF) != meta["crc32"]:
+                raise _Corrupt(f"array {meta['file']} checksum mismatch")
+            if keep_arrays:
+                try:
+                    leaves.append(onp.load(io.BytesIO(data),
+                                           allow_pickle=False))
+                except ValueError as e:
+                    raise _Corrupt(
+                        f"array {meta['file']} undecodable ({e})")
+        return manifest, leaves
+
+    def verify(self, step):
+        """(ok, why) for one committed step — why is None when the
+        checkpoint is complete and every checksum matches."""
+        try:
+            self._load_verified(step, keep_arrays=False)
+            return True, None
+        except _Corrupt as e:
+            return False, str(e)
 
     def latest_step(self):
-        return self._mgr.latest_step()
+        """The newest step that passes verification (corrupt newer
+        steps are skipped with a ``checkpoint_corrupt`` event, exactly
+        like ``restore(step=None)``)."""
+        for step in reversed(self.all_steps()):
+            ok, why = self.verify(step)
+            if ok:
+                return step
+            self._report_corrupt(step, why)
+        return None
 
-    def all_steps(self):
-        return list(self._mgr.all_steps())
+    def _report_corrupt(self, step, why):
+        telemetry.counter("checkpoints_corrupt_total").inc()
+        telemetry.emit("checkpoint_corrupt", dir=self._dir, step=step,
+                       why=why)
 
+    def _sweep_tmp(self):
+        """Leftover ``.tmp-*`` directories are saves a dead process
+        never committed (the kill-mid-save scenario): report each one
+        loudly and remove it.  Temp dirs carrying THIS process's pid
+        are skipped — they may be a live async writer's in-flight
+        save (restore-during-save must not destroy it); a dead
+        process's leftovers always carry a different pid."""
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        own = f"-{os.getpid()}-"
+        for name in names:
+            if not name.startswith(_TMP_PREFIX) or own in name:
+                continue
+            self._report_corrupt(
+                None, f"interrupted save (uncommitted {name})")
+            shutil.rmtree(os.path.join(self._dir, name),
+                          ignore_errors=True)
+
+    # -- restore -------------------------------------------------------- #
+    def restore(self, block, trainer=None, step=None, return_extra=False):
+        """Restore into ``block`` (and ``trainer``); ``step=None``
+        auto-resumes from the newest VERIFIABLE checkpoint, skipping
+        incomplete/corrupt ones with a ``checkpoint_corrupt`` event
+        per skip.  Returns the restored step (or ``(step, extra)``
+        with ``return_extra=True``), or None when nothing restorable
+        exists.  An explicit ``step=`` that is missing or fails
+        verification raises :class:`MXNetError`."""
+        fault_point("checkpoint.restore", step=step)
+        self._sweep_tmp()
+        if step is not None:
+            step = int(step)
+            if step not in self.all_steps():
+                raise MXNetError(
+                    f"checkpoint: no step {step} in {self._dir}")
+            try:
+                manifest, leaves = self._load_verified(step)
+            except _Corrupt as e:
+                self._report_corrupt(step, str(e))
+                raise MXNetError(
+                    f"checkpoint: step {step} in {self._dir} failed "
+                    f"verification: {e}") from e
+            return self._apply(manifest, leaves, block, trainer,
+                               return_extra)
+        for s in reversed(self.all_steps()):
+            try:
+                manifest, leaves = self._load_verified(s)
+            except _Corrupt as e:
+                self._report_corrupt(s, str(e))
+                continue
+            return self._apply(manifest, leaves, block, trainer,
+                               return_extra)
+        return None
+
+    def _apply(self, manifest, leaves, block, trainer, return_extra):
+        tree = _dec(manifest["tree"], leaves)
+        saved_mesh = manifest.get("mesh", {})
+        _apply_params(block, tree["params"], saved_mesh)
+        if trainer is not None and tree.get("trainer") is not None:
+            _apply_trainer(trainer, tree["trainer"])
+        if tree.get("rng") is not None:
+            from . import random as mxrandom
+
+            mxrandom.set_state(tree["rng"])
+        step = int(manifest["step"])
+        telemetry.emit("checkpoint_restored", dir=self._dir, step=step,
+                       arrays=len(leaves))
+        if return_extra:
+            return step, tree.get("extra")
+        return step
+
+    # -- lifecycle ------------------------------------------------------ #
     def wait_until_finished(self):
-        """Block until pending async saves are durably written."""
-        self._mgr.wait_until_finished()
+        """Block until pending async saves are durably committed, and
+        surface any background write error."""
+        if self._jobs is not None:
+            self._jobs.join()
+        self._raise_pending()
 
-    def close(self):
-        self._mgr.close()
+    def close(self, timeout=60.0):
+        """Flush pending saves and stop the writer.  A background
+        write error still pending here raises (close is the last
+        chance to hear about it), and so does a writer still mid-write
+        after ``timeout`` seconds — a silently abandoned final
+        checkpoint would be swept as corrupt by the next run."""
+        with self._lock:
+            if self._closed:
+                writer = None
+            else:
+                self._closed = True
+                writer = self._writer
+        if writer is not None:
+            self._jobs.put(None)   # poison pill: the writer loop exits
+            writer.join(timeout=timeout)
+            if writer.is_alive():
+                raise MXNetError(
+                    f"checkpoint: the background writer is still "
+                    f"writing after {timeout}s — the pending save has "
+                    "NOT committed; wait_until_finished() (or a larger "
+                    "close timeout) before exiting, or the next run "
+                    "will sweep it as an interrupted save")
+        self._raise_pending()
 
 
-def save(directory, step, block, trainer=None):
-    """One-shot save (sync): ``mx.checkpoint.save(dir, step, net, trainer)``."""
-    mgr = CheckpointManager(directory, async_save=False)
+# --------------------------------------------------------------------- #
+# functional one-shots
+# --------------------------------------------------------------------- #
+
+def save(directory, step, block, trainer=None, extra=None):
+    """One-shot atomic save (sync):
+    ``mx.checkpoint.save(dir, step, net, trainer)``."""
+    mgr = CheckpointManager(directory, max_to_keep=None, async_save=False)
     try:
-        mgr.save(step, block, trainer)
-        mgr.wait_until_finished()
+        mgr.save(step, block, trainer, extra=extra)
     finally:
         mgr.close()
     return step
 
 
-def restore(directory, block, trainer=None, step=None):
-    """One-shot restore; ``step=None`` = auto-resume from newest."""
-    mgr = CheckpointManager(directory, async_save=False)
+def restore(directory, block, trainer=None, step=None, return_extra=False):
+    """One-shot restore; ``step=None`` = auto-resume from the newest
+    verifiable checkpoint (corrupt ones skipped loudly)."""
+    mgr = CheckpointManager(directory, max_to_keep=None, async_save=False)
     try:
-        return mgr.restore(block, trainer, step)
+        return mgr.restore(block, trainer, step, return_extra=return_extra)
     finally:
         mgr.close()
 
 
 def latest_step(directory):
-    mgr = CheckpointManager(directory, async_save=False)
+    mgr = CheckpointManager(directory, max_to_keep=None, async_save=False)
     try:
         return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+def verify_step(directory, step):
+    """(ok, why) integrity verdict for one step — the offline tool for
+    'is this checkpoint loadable'."""
+    mgr = CheckpointManager(directory, max_to_keep=None, async_save=False)
+    try:
+        return mgr.verify(int(step))
     finally:
         mgr.close()

@@ -500,10 +500,66 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
 
+    def _sampler_batches(self, skip=0):
+        """The epoch's index-batch stream, fast-forwarded past the
+        first ``skip`` batches: their indices are DRAWN from the
+        sampler (so a seeded shuffle's position advances exactly as if
+        they had been consumed) but no sample is ever loaded,
+        batchified, or placed — the checkpoint data-cursor restore
+        path.  Each yielded batch is a ``data.next`` fault-injection
+        site (``MXNET_FAULT_INJECT``)."""
+        it = iter(self._batch_sampler)
+        for k in range(skip):
+            try:
+                next(it)
+            except StopIteration:
+                raise MXNetError(
+                    f"iter_from({skip}): the sampler yields only {k} "
+                    "batches this epoch — the resume cursor is past "
+                    "the end of the data") from None
+
+        def _gen():
+            for i, batch in enumerate(it, start=skip):
+                telemetry.fault_point("data.next", batch=i)
+                yield batch
+        return _gen()
+
     def __iter__(self):
+        return self._make_iter(self._sampler_batches())
+
+    def iter_from(self, batches_done):
+        """One epoch's iterator resumed mid-epoch: identical to
+        ``iter(loader)`` with the first ``batches_done`` batches
+        skipped at the SAMPLER level (indices drawn, data never
+        loaded).  With a seeded :class:`RandomSampler` positioned via
+        ``set_epoch``, this reproduces the interrupted epoch's
+        remaining batches exactly — the restore half of the
+        checkpointed data cursor.  ``last_batch='rollover'`` refuses:
+        its carried-over ``_prev`` indices are in-memory state a
+        restarted process cannot reconstruct, so epochs past the first
+        would resume with silently shifted batch boundaries."""
+        if getattr(self._batch_sampler, "_last_batch", None) == \
+                "rollover":
+            raise MXNetError(
+                "iter_from: last_batch='rollover' carries leftover "
+                "indices across epochs in process memory, which a "
+                "resume cannot reconstruct — bit-exact mid-epoch "
+                "resume needs last_batch='keep' or 'discard'")
+        return self._make_iter(self._sampler_batches(int(batches_done)))
+
+    def set_epoch(self, epoch):
+        """Forward the epoch position to samplers that support it
+        (seeded :class:`RandomSampler` — the resume path)."""
+        for obj in (self._batch_sampler,
+                    getattr(self._batch_sampler, "_sampler", None)):
+            fn = getattr(obj, "set_epoch", None)
+            if callable(fn):
+                fn(epoch)
+
+    def _make_iter(self, batch_iter):
         if self._num_workers == 0:
             def _same_process_iter():
-                for batch in self._batch_sampler:
+                for batch in batch_iter:
                     yield self._batchify_fn([self._dataset[i] for i in batch])
             base = _same_process_iter()
             if self._device is None:
@@ -521,7 +577,7 @@ class DataLoader:
             # moment batchify finishes, no extra layer
             target = _placement_target(self._device)
             place_fn = lambda batch: to_device(batch, target)  # noqa: E731
-        it = _MultiWorkerIter(self._dataset, self._batch_sampler,
+        it = _MultiWorkerIter(self._dataset, batch_iter,
                               self._batchify_fn, self._num_workers,
                               self._prefetch, self._pin_memory,
                               timeout=self._timeout, place_fn=place_fn)

@@ -29,11 +29,31 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Shuffled indices.  With ``seed=None`` (default) each epoch draws
+    from the process-global numpy RNG (reference behavior).  With a
+    ``seed``, epoch ``e`` is the deterministic permutation of
+    ``RandomState(seed + e)`` — the resumable-shuffle mode: after a
+    restart, ``set_epoch(e)`` + a ``DataLoader.iter_from`` fast-forward
+    reproduces exactly the batches the interrupted epoch would have
+    yielded, without replaying data."""
+
+    def __init__(self, length, seed=None):
         self._length = length
+        self._seed = seed
+        self._epoch = 0
+
+    def set_epoch(self, epoch):
+        """Position the seeded shuffle at ``epoch`` (the checkpoint
+        data-cursor restore path; no-op ordering-wise when unseeded)."""
+        self._epoch = int(epoch)
 
     def __iter__(self):
-        indices = onp.random.permutation(self._length)
+        if self._seed is None:
+            indices = onp.random.permutation(self._length)
+        else:
+            rs = onp.random.RandomState(self._seed + self._epoch)
+            indices = rs.permutation(self._length)
+            self._epoch += 1
         return iter(indices.tolist())
 
     def __len__(self):

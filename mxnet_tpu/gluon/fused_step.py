@@ -278,7 +278,7 @@ class FusedStep:
         frozen_vals = [p._data._data for p in self._frozen_params]
         key = mxrandom.next_key()
         if N > 1 and self._accum is None:
-            self._accum = [
+            self._accum = self._adopt_pending_accum(tr, train_vals) or [
                 jnp.zeros(v.shape, _grad_dtype(v.dtype))
                 for v in train_vals]
             # the accumulator ring is a real device-resident cost of
@@ -343,6 +343,41 @@ class FusedStep:
             p._data._data = v
         self._accum = new_accum if N > 1 else None
         return self._wrap_outs(outs)
+
+    def _adopt_pending_accum(self, tr, train_vals):
+        """Adopt a checkpoint-restored accumulator ring
+        (``mx.checkpoint`` stages them on ``trainer._pending_accum``
+        when a mid-window save is restored): the first staged ring
+        whose shapes match this step's training params resumes the
+        window exactly where the save left it.  A restored mid-window
+        position with NO matching ring cannot resume bit-exact — that
+        is a loud error, not a silent zero ring."""
+        pending = getattr(tr, "_pending_accum", None)
+        if pending is None:
+            return None   # no checkpoint restore in this trainer's life
+        if not pending:
+            if tr._window_pos != 0:
+                raise MXNetError(
+                    "fused_step: trainer was restored mid-accumulation-"
+                    f"window (micro-batch {tr._window_pos}/"
+                    f"{tr._update_interval}) but its saved accumulator "
+                    "ring was already adopted by another fused step — "
+                    "one checkpointed ring cannot resume two windows")
+            return None
+        for ridx, ring in enumerate(pending):
+            if len(ring) == len(train_vals) and all(
+                    tuple(r.shape) == tuple(v.shape)
+                    for r, v in zip(ring, train_vals)):
+                return pending.pop(ridx)
+        if tr._window_pos != 0:
+            raise MXNetError(
+                "fused_step: trainer was restored mid-accumulation-"
+                f"window (micro-batch {tr._window_pos}/"
+                f"{tr._update_interval}) but none of the "
+                f"{len(pending)} checkpointed accumulator ring(s) "
+                "match this step's parameter shapes — the checkpoint "
+                "was taken with a different loss_fn/model geometry")
+        return None
 
     def release_accounting(self):
         """Retire this step's ``train.grad_accum`` ledger entry —

@@ -414,6 +414,12 @@ class Trainer:
 
     # -- state checkpointing (SURVEY.md §5.4 d) --------------------------- #
     def save_states(self, fname):
+        # mid-window, the true optimizer input includes the partial
+        # gradient accumulator (device ring / 'add' buffers) that this
+        # pickle does NOT capture — same contract as allreduce_grads():
+        # refuse loudly rather than save a state that cannot resume
+        # (use mx.checkpoint for mid-window-capable saves)
+        self._check_window_boundary("save_states()")
         self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
@@ -431,6 +437,9 @@ class Trainer:
             pickle.dump(payload, f)
 
     def load_states(self, fname):
+        # loading states mid-window would desync the donated fused-step
+        # accumulator ring (its partial grads belong to the OLD states)
+        self._check_window_boundary("load_states()")
         self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.load_optimizer_states(fname)
@@ -441,3 +450,10 @@ class Trainer:
         self._optimizer._index_update_count = payload["index_update_count"]
         self._states = payload["states"]
         self._states_created = payload["created"]
+        # a clean state swap resets the accumulation window: any cached
+        # FusedStep's ring (and the legacy host accumulator) belongs to
+        # the pre-load run and must not mix into the next apply
+        self._window_pos = 0
+        for fs in self._fused_steps.values():
+            fs._accum = None
+            fs._legacy_accum = None

@@ -23,7 +23,7 @@ from .ops.registry import Op, invoke
 
 __all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
            "exponential", "poisson", "multinomial", "shuffle", "bernoulli",
-           "next_key", "current_seed"]
+           "next_key", "current_seed", "get_state", "set_state"]
 
 _state = threading.local()
 
@@ -50,6 +50,25 @@ def seed(seed_state, ctx="all"):
 
 def current_seed():
     return _root().seed_val
+
+
+def get_state():
+    """Snapshot the calling thread's root-key state as a host pytree
+    (the ``mx.checkpoint`` RNG capture).  The trace-key stack is
+    deliberately absent: it only exists while a trace is executing, and
+    traced draws consume a per-call key OPERAND, not this state."""
+    st = _root()
+    return {"key": onp.asarray(jax.device_get(st.key)),
+            "seed": st.seed_val}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot — after this, the stream of
+    :func:`next_key` splits continues exactly where the snapshot was
+    taken (the bit-exact-resume contract)."""
+    st = _root()
+    st.key = jnp.asarray(state["key"], jnp.uint32)
+    st.seed_val = state.get("seed")
 
 
 # trace-key stack: pushed by CachedOp while tracing/executing jit code

@@ -1,0 +1,148 @@
+"""Deterministic resumable training worker — the rank program for the
+chaos parity tests (ISSUE 15 acceptance).
+
+Trains a small Dense chain with ``Trainer.fused_step`` (gradient
+accumulation window ``--update-interval``), drawing per-step RNG noise
+(so the checkpointed ``mx.random`` root key is load-bearing), feeding
+batches through a ``DataLoader`` whose cursor is checkpointed as
+``extra`` and restored with ``iter_from`` (fast-forward, no replay).
+Every step is checkpointed (async, atomic).  On start it auto-resumes
+from the newest COMPLETE checkpoint; at the end it writes the final
+params + optimizer states to ``--out`` as an npz.
+
+Fault arming is per pod-restart generation and per rank::
+
+    --fault 0=checkpoint.save:kill:4 --fault 1=data.next:kill:3
+
+arms ``MXNET_FAULT_INJECT`` with the given spec only when this process's
+``mx.checkpoint.restart_count()`` equals the generation index and its
+rank equals ``--fault-rank`` — so an injected kill does not recur
+forever across supervised restarts (the supervisor never rewrites the
+spec; rank code owns it).
+
+Bit-exact contract under test: kill-and-resume (any number of times,
+at any site) produces an ``--out`` numerically identical to an
+uninterrupted run with the same arguments.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as onp  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--bs", type=int, default=4)
+    ap.add_argument("--units", type=int, default=8)
+    ap.add_argument("--update-interval", type=int, default=2)
+    ap.add_argument("--dir", default=None,
+                    help="checkpoint root (default MXNET_CHECKPOINT_DIR)")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--out-per-rank", action="store_true",
+                    help="substitute the literal 'RANK' in --out with "
+                         "this process's rank (multi-rank pods)")
+    ap.add_argument("--fault", action="append", default=[],
+                    metavar="GEN=SPEC",
+                    help="arm MXNET_FAULT_INJECT=SPEC when "
+                         "restart_count()==GEN and rank==--fault-rank")
+    ap.add_argument("--fault-rank", type=int, default=0)
+    args = ap.parse_args()
+
+    rank = int(os.environ.get("MXNET_WORKER_ID", "0"))
+    if args.out_per_rank:
+        args.out = args.out.replace("RANK", str(rank))
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.heartbeat import start_heartbeat
+
+    gen = mx.checkpoint.restart_count()
+    for spec in args.fault:
+        g, _, rule = spec.partition("=")
+        if int(g) == gen and rank == args.fault_rank:
+            os.environ["MXNET_FAULT_INJECT"] = rule
+            print(f"[rank {rank} gen {gen}] armed fault {rule}",
+                  flush=True)
+    start_heartbeat()
+
+    root = args.dir or os.environ.get("MXNET_CHECKPOINT_DIR")
+    if not root:
+        print("no checkpoint dir (--dir or MXNET_CHECKPOINT_DIR)",
+              file=sys.stderr)
+        return 2
+    ckdir = os.path.join(root, f"rank{rank}")
+
+    # deterministic model + data (both RNGs seeded; the checkpoint's
+    # RNG capture takes over from the restore point)
+    mx.random.seed(7)
+    onp.random.seed(7)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(args.units, use_bias=False, in_units=args.units))
+        net.add(nn.Dense(1, use_bias=False, in_units=args.units))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2}, kvstore=None,
+                            update_interval=args.update_interval)
+    loss_l = gluon.loss.L2Loss()
+
+    def loss_fn(bx, by):
+        return loss_l(net(bx), by)
+
+    rng = onp.random.RandomState(11)
+    X = rng.rand(args.steps * args.bs, args.units).astype(onp.float32)
+    Y = rng.rand(args.steps * args.bs, 1).astype(onp.float32)
+    dataset = gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
+    loader = gluon.data.DataLoader(dataset, batch_size=args.bs,
+                                   shuffle=False)
+
+    mgr = mx.checkpoint.CheckpointManager(ckdir, max_to_keep=3,
+                                          async_save=True)
+    start = 0
+    res = mgr.restore(net, trainer, return_extra=True)
+    if res is not None:
+        step, extra = res
+        start = int((extra or {}).get("batch", step))
+        print(f"[rank {rank} gen {gen}] resumed step {step} "
+              f"(cursor {start}, window {trainer._window_pos})",
+              flush=True)
+
+    step = start
+    for bx, by in loader.iter_from(start):
+        # per-step RNG consumption: resume must continue the key stream
+        noise = mx.random.normal(shape=(args.bs, args.units)) * 0.01
+        trainer.fused_step(loss_fn, bx + noise, by)
+        step += 1
+        mgr.save(step, net, trainer, extra={"batch": step})
+        if step >= args.steps:
+            break
+    mgr.wait_until_finished()
+    mgr.close()
+
+    out = {}
+    for name, p in net._collect_params_with_prefix().items():
+        out[f"param:{name}"] = onp.asarray(p.data().asnumpy())
+    for i, (s, created) in enumerate(zip(trainer._states,
+                                         trainer._states_created)):
+        if not created:
+            continue
+        import jax
+
+        for j, leaf in enumerate(jax.tree.leaves(s)):
+            out[f"state:{i}:{j}"] = onp.asarray(jax.device_get(leaf))
+    tmp = args.out + ".tmp"
+    with open(tmp, "wb") as fh:
+        onp.savez(fh, **out)
+    os.replace(tmp, args.out)
+    print(f"[rank {rank} gen {gen}] done at step {step}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

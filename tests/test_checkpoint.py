@@ -1,10 +1,23 @@
-"""Orbax checkpoint tests (SURVEY.md §5.3/§5.4 TPU-native answer:
-sharded/async checkpoints + auto-resume)."""
+"""Atomic/async checkpoint + bit-exact resume tests (ISSUE 15).
+
+Covers: the commit-or-invisible protocol (corrupt/truncated/interrupted
+checkpoints are skipped loudly, never loaded, never crash auto-resume),
+async save donation safety, bit-exact mid-window resume (params,
+optimizer, accumulator ring, RNG, loss scaler), restore-time resharding
+across meshes, the data-pipeline cursor (``DataLoader.iter_from`` fast
+forward), the new fault-injection sites, and the Estimator's
+``AtomicCheckpointHandler``.
+"""
+import json
+import os
+
 import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
 
 
 def _net_and_trainer():
@@ -23,6 +36,45 @@ def _train(net, trainer, x, y, steps):
             L = loss_fn(net(x), y)
         L.backward()
         trainer.step(x.shape[0])
+
+
+def _params_np(net):
+    return {name: p.data().asnumpy()
+            for name, p in net._collect_params_with_prefix().items()}
+
+
+def _fused_rig(units=6, update_interval=2, seed=0):
+    """Deterministic fused-step training rig: (net, trainer, step_fn)
+    where step_fn(x, y) runs one fused step with a per-step RNG draw
+    (so the checkpointed root key is load-bearing)."""
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(units, use_bias=False, in_units=units))
+        net.add(nn.Dense(2, use_bias=False, in_units=units))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2}, kvstore=None,
+                            update_interval=update_interval)
+    loss_l = gluon.loss.L2Loss()
+
+    def loss_fn(bx, by):
+        return loss_l(net(bx), by)
+
+    def step_fn(x, y):
+        noise = mx.random.normal(shape=x.shape) * 0.01
+        return trainer.fused_step(loss_fn, x + noise, y)
+
+    return net, trainer, step_fn
+
+
+def _batches(n, bs=4, units=6, seed=3):
+    rng = onp.random.RandomState(seed)
+    return [(mx.nd.array(rng.rand(bs, units).astype(onp.float32)),
+             mx.nd.array(rng.rand(bs, 2).astype(onp.float32)))
+            for _ in range(n)]
 
 
 class TestCheckpoint:
@@ -60,3 +112,578 @@ class TestCheckpoint:
         assert mgr.latest_step() == 3
         assert len(mgr.all_steps()) <= 2
         mgr.close()
+
+
+class TestAtomicity:
+    """Commit-or-invisible: only a complete, checksum-clean step dir is
+    ever loaded; everything else is a loud checkpoint_corrupt event and
+    a fallback, never a crash."""
+
+    def _saved_dir(self, tmp_path, steps=(1, 2)):
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        for s in steps:
+            mx.checkpoint.save(str(tmp_path), s, net, trainer)
+        return net, trainer
+
+    def test_truncated_array_falls_back(self, tmp_path):
+        net, trainer = self._saved_dir(tmp_path)
+        step2 = tmp_path / "step_00000002"
+        victim = sorted(step2.glob("arr_*.npy"))[0]
+        victim.write_bytes(victim.read_bytes()[:-7])
+        mx.telemetry.clear_events()
+        net2, tr2 = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2, tr2) == 1
+        evs = mx.telemetry.events(kind="checkpoint_corrupt")
+        assert evs and "truncated" in evs[-1]["why"]
+
+    def test_bitflip_checksum_falls_back(self, tmp_path):
+        self._saved_dir(tmp_path)
+        victim = sorted((tmp_path / "step_00000002").glob("arr_*.npy"))[-1]
+        data = bytearray(victim.read_bytes())
+        data[-1] ^= 0xFF
+        victim.write_bytes(bytes(data))
+        mx.telemetry.clear_events()
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2) == 1
+        evs = mx.telemetry.events(kind="checkpoint_corrupt")
+        assert evs and "checksum" in evs[-1]["why"]
+
+    def test_missing_manifest_falls_back(self, tmp_path):
+        self._saved_dir(tmp_path)
+        (tmp_path / "step_00000002" / "MANIFEST.json").unlink()
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2) == 1
+        assert mx.checkpoint.latest_step(str(tmp_path)) == 1
+
+    def test_interrupted_tmp_swept_and_reported(self, tmp_path):
+        self._saved_dir(tmp_path, steps=(1,))
+        ghost = tmp_path / ".tmp-step_00000009-123-deadbeef"
+        ghost.mkdir()
+        (ghost / "arr_00000.npy").write_bytes(b"partial")
+        mx.telemetry.clear_events()
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2) == 1
+        assert not ghost.exists()
+        evs = mx.telemetry.events(kind="checkpoint_corrupt")
+        assert evs and "interrupted save" in evs[-1]["why"]
+
+    def test_explicit_corrupt_step_raises(self, tmp_path):
+        self._saved_dir(tmp_path)
+        victim = sorted((tmp_path / "step_00000002").glob("arr_*.npy"))[0]
+        victim.write_bytes(b"")
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        with pytest.raises(MXNetError, match="failed.*verification|"
+                                             "verification"):
+            mx.checkpoint.restore(str(tmp_path), net2, step=2)
+        with pytest.raises(MXNetError, match="no step 9"):
+            mx.checkpoint.restore(str(tmp_path), net2, step=9)
+
+    def test_verify_step_api(self, tmp_path):
+        self._saved_dir(tmp_path)
+        ok, why = mx.checkpoint.verify_step(str(tmp_path), 2)
+        assert ok and why is None
+        victim = sorted((tmp_path / "step_00000002").glob("arr_*.npy"))[0]
+        victim.write_bytes(victim.read_bytes()[:-1])
+        ok, why = mx.checkpoint.verify_step(str(tmp_path), 2)
+        assert not ok and "truncated" in why
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        self._saved_dir(tmp_path, steps=(1,))
+        (tmp_path / "step_00000001" / "MANIFEST.json").write_text("{nope")
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2) is None
+
+
+class TestAsyncSave:
+    def test_async_snapshot_is_donation_safe(self, tmp_path):
+        """save() snapshots device→host before returning, so training
+        steps dispatched immediately after (which DONATE the very same
+        param/state/accumulator buffers into the next executable)
+        cannot corrupt the in-flight checkpoint: the restored values
+        equal the values at save time, not the later ones."""
+        net, trainer, step_fn = _fused_rig()
+        batches = _batches(6)
+        for x, y in batches[:2]:
+            step_fn(x, y)
+        at_save = _params_np(net)
+        mgr = mx.checkpoint.CheckpointManager(str(tmp_path),
+                                              async_save=True)
+        mgr.save(2, net, trainer)
+        for x, y in batches[2:]:   # keep training while the write runs
+            step_fn(x, y)
+        mgr.wait_until_finished()
+        mgr.close()
+        assert not onp.allclose(
+            at_save["0.weight"], _params_np(net)["0.weight"])
+        net2, tr2, _ = _fused_rig(seed=9)
+        assert mx.checkpoint.restore(str(tmp_path), net2, tr2) == 2
+        for k, v in _params_np(net2).items():
+            onp.testing.assert_array_equal(v, at_save[k])
+
+    def test_background_write_error_surfaces(self, tmp_path,
+                                             monkeypatch):
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        mgr = mx.checkpoint.CheckpointManager(str(tmp_path),
+                                              async_save=True)
+        monkeypatch.setattr(
+            mgr, "_write_step",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk gone")))
+        mgr.save(1, net, trainer)
+        with pytest.raises(MXNetError, match="background save failed"):
+            mgr.wait_until_finished()
+        mgr.close()
+
+    def test_restore_does_not_sweep_own_live_tmp(self, tmp_path):
+        """Post-review regression: restore() during an in-flight async
+        save must not destroy the writer's own temp dir — only DEAD
+        processes' leftovers (different pid) are swept."""
+        net, trainer = self._rig(tmp_path)
+        own = tmp_path / f".tmp-step_00000009-{os.getpid()}-abcd1234"
+        own.mkdir()
+        dead = tmp_path / ".tmp-step_00000009-99999999-abcd1234"
+        dead.mkdir()
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2) == 1
+        assert own.exists() and not dead.exists()
+
+    def _rig(self, tmp_path):
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+        return net, trainer
+
+    def test_close_timeout_on_live_writer_raises(self, tmp_path,
+                                                 monkeypatch):
+        """Post-review regression: close() must not silently abandon a
+        writer still mid-write — the pending save has not committed."""
+        import time as _time
+
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        mgr = mx.checkpoint.CheckpointManager(str(tmp_path),
+                                              async_save=True)
+        monkeypatch.setattr(mgr, "_write_step",
+                            lambda *a, **k: _time.sleep(3.0))
+        mgr.save(1, net, trainer)
+        with pytest.raises(MXNetError, match="still writing"):
+            mgr.close(timeout=0.2)
+
+    def test_checkpoint_saved_event_fields(self, tmp_path):
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        mx.telemetry.clear_events()
+        mx.checkpoint.save(str(tmp_path), 7, net, trainer)
+        evs = mx.telemetry.events(kind="checkpoint_saved")
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["step"] == 7 and ev["bytes"] > 0
+        assert ev["snapshot_s"] >= 0 and ev["write_s"] > 0
+
+
+class TestBitExactResume:
+    def test_mid_window_resume_is_bit_exact(self, tmp_path):
+        """Kill-and-resume == uninterrupted, at a MID-WINDOW save:
+        the checkpoint carries the accumulation-window position and the
+        donated device accumulator ring, the optimizer schedule
+        counters, and the RNG root key — continuing from the restore
+        reproduces the uninterrupted run's params and states exactly."""
+        batches = _batches(6)
+        net, trainer, step_fn = _fused_rig(update_interval=2)
+        for x, y in batches[:3]:          # step 3 = mid-window
+            step_fn(x, y)
+        assert trainer._window_pos == 1
+        mx.checkpoint.save(str(tmp_path), 3, net, trainer)
+        for x, y in batches[3:]:
+            step_fn(x, y)
+        ref_params = _params_np(net)
+        ref_nu = trainer._optimizer.num_update
+
+        net2, tr2, step_fn2 = _fused_rig(update_interval=2, seed=5)
+        step = mx.checkpoint.restore(str(tmp_path), net2, tr2)
+        assert step == 3 and tr2._window_pos == 1
+        for x, y in batches[3:]:
+            step_fn2(x, y)
+        for k, v in _params_np(net2).items():
+            onp.testing.assert_array_equal(v, ref_params[k])
+        assert tr2._optimizer.num_update == ref_nu
+        import jax
+        for s1, s2, made in zip(trainer._states, tr2._states,
+                                trainer._states_created):
+            if made:
+                for l1, l2 in zip(jax.tree.leaves(s1),
+                                  jax.tree.leaves(s2)):
+                    onp.testing.assert_array_equal(
+                        onp.asarray(jax.device_get(l1)),
+                        onp.asarray(jax.device_get(l2)))
+
+    def test_boundary_resume_is_bit_exact(self, tmp_path):
+        batches = _batches(6)
+        net, trainer, step_fn = _fused_rig(update_interval=2)
+        for x, y in batches[:4]:          # step 4 = window boundary
+            step_fn(x, y)
+        mx.checkpoint.save(str(tmp_path), 4, net, trainer)
+        for x, y in batches[4:]:
+            step_fn(x, y)
+        ref = _params_np(net)
+        net2, tr2, step_fn2 = _fused_rig(update_interval=2, seed=5)
+        assert mx.checkpoint.restore(str(tmp_path), net2, tr2) == 4
+        assert tr2._window_pos == 0
+        for x, y in batches[4:]:
+            step_fn2(x, y)
+        for k, v in _params_np(net2).items():
+            onp.testing.assert_array_equal(v, ref[k])
+
+    def test_mid_window_save_without_ring_refuses(self, tmp_path):
+        """The imperative (non-fused) accumulation window lives in the
+        'add' grad buffers a checkpoint does not capture — a mid-window
+        save there must refuse loudly, not silently drop the partial
+        window."""
+        net, trainer = _net_and_trainer()
+        x = mx.nd.ones((2, 5))
+        net(x)
+        trainer._update_interval = 2
+        trainer._window_pos = 1    # simulate the imperative mid-window
+        with pytest.raises(MXNetError, match="mid-accumulation-window"):
+            mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+
+    def test_loss_scaler_state_roundtrip(self, tmp_path):
+        from mxnet_tpu.amp import LossScaler
+
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        trainer._amp_loss_scaler = LossScaler(init_scale=2.0 ** 10)
+        trainer._amp_loss_scaler.loss_scale = 384.0
+        trainer._amp_loss_scaler._unskipped = 17
+        mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+        net2, tr2 = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        tr2._amp_loss_scaler = LossScaler()
+        mx.checkpoint.restore(str(tmp_path), net2, tr2)
+        assert tr2._amp_loss_scaler.loss_scale == 384.0
+        assert tr2._amp_loss_scaler._unskipped == 17
+
+    def test_rng_state_roundtrip(self):
+        mx.random.seed(123)
+        mx.random.uniform(shape=(3,))
+        st = mx.random.get_state()
+        a = mx.random.uniform(shape=(4,)).asnumpy()
+        b = mx.random.uniform(shape=(4,)).asnumpy()
+        mx.random.set_state(st)
+        onp.testing.assert_array_equal(
+            mx.random.uniform(shape=(4,)).asnumpy(), a)
+        onp.testing.assert_array_equal(
+            mx.random.uniform(shape=(4,)).asnumpy(), b)
+
+    def test_save_states_mid_window_raises(self, tmp_path):
+        """Satellite: Trainer.save_states/load_states keep the same
+        mid-window contract as allreduce_grads() — the pickle cannot
+        capture the partial window, so it refuses instead of saving a
+        state that desyncs on load."""
+        net, trainer, step_fn = _fused_rig(update_interval=2)
+        x, y = _batches(1)[0]
+        step_fn(x, y)              # window_pos -> 1
+        fname = str(tmp_path / "states")
+        with pytest.raises(MXNetError, match="save_states\\(\\) called "
+                                             "mid-accumulation"):
+            trainer.save_states(fname)
+        with pytest.raises(MXNetError, match="load_states\\(\\) called "
+                                             "mid-accumulation"):
+            trainer.load_states(fname)
+        step_fn(*_batches(1)[0])   # complete the window
+        assert trainer._window_pos == 0
+        trainer.save_states(fname)
+        step_fn(x, y)              # start a new window...
+        fs = next(iter(trainer._fused_steps.values()))
+        assert fs._accum is not None
+        trainer._window_pos = 0    # ...reach a boundary, then load
+        trainer.load_states(fname)
+        # a clean load resets the window and drops the stale ring
+        assert trainer._window_pos == 0 and fs._accum is None
+
+
+class TestResharding:
+    def _mesh(self):
+        import jax
+        from mxnet_tpu import parallel
+
+        return parallel.make_mesh({"dp": len(jax.devices())})
+
+    def _sharded_rig(self, seed=0):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        net, trainer, step_fn = _fused_rig(units=8, seed=seed)
+        mesh = self._mesh()
+        sh = NamedSharding(mesh, PartitionSpec("dp"))
+        repl = NamedSharding(mesh, PartitionSpec())
+        for p in net.collect_params().values():
+            p.set_sharding(sh if p.shape[0] % 8 == 0 else repl)
+        return net, trainer, step_fn, sh
+
+    def test_sharded_save_restores_on_single_device(self, tmp_path):
+        """8-device mesh → 1-device placement: arrays are stored as
+        full logical values, so restore just places them with the
+        target param's (absent) sharding."""
+        net, trainer, step_fn, _ = self._sharded_rig()
+        x, y = _batches(1, units=8)[0]
+        step_fn(x, y)
+        step_fn(*_batches(1, units=8, seed=5)[0])
+        ref = _params_np(net)
+        mx.checkpoint.save(str(tmp_path), 2, net, trainer)
+        net2, tr2, _ = _fused_rig(units=8, seed=9)   # unsharded target
+        assert mx.checkpoint.restore(str(tmp_path), net2, tr2) == 2
+        for k, v in _params_np(net2).items():
+            onp.testing.assert_allclose(v, ref[k], rtol=1e-6)
+
+    def test_unsharded_save_restores_onto_mesh(self, tmp_path):
+        net, trainer, _ = _fused_rig(units=8)
+        net(_batches(1, units=8)[0][0])
+        ref = _params_np(net)
+        mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+        net2, tr2, _, sh = self._sharded_rig(seed=9)
+        assert mx.checkpoint.restore(str(tmp_path), net2, tr2) == 1
+        for name, p in net2._collect_params_with_prefix().items():
+            onp.testing.assert_allclose(p.data().asnumpy(), ref[name],
+                                        rtol=1e-6)
+            if p.shape[0] % 8 == 0:
+                assert p._data._data.sharding == sh   # resharded, not
+                # silently replicated
+
+    def test_shape_mismatch_names_both_meshes(self, tmp_path):
+        net, trainer, _ = _fused_rig(units=8)
+        net(_batches(1, units=8)[0][0])
+        mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+        net2, _, _ = _fused_rig(units=4, seed=9)
+        net2(_batches(1, units=4)[0][0])
+        with pytest.raises(MXNetError) as ei:
+            mx.checkpoint.restore(str(tmp_path), net2)
+        assert "mesh" in str(ei.value) and "shape" in str(ei.value)
+
+
+class _CountingDataset(gluon.data.dataset.Dataset):
+    def __init__(self, n, units=6):
+        rng = onp.random.RandomState(0)
+        self._x = rng.rand(n, units).astype(onp.float32)
+        self.fetched = []
+
+    def __getitem__(self, idx):
+        self.fetched.append(int(idx))
+        return self._x[idx]
+
+    def __len__(self):
+        return len(self._x)
+
+
+class TestDataCursor:
+    def test_iter_from_matches_tail(self):
+        ds = _CountingDataset(20)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        full = [b.asnumpy() for b in loader]
+        tail = [b.asnumpy() for b in loader.iter_from(2)]
+        assert len(tail) == len(full) - 2
+        for a, b in zip(full[2:], tail):
+            onp.testing.assert_array_equal(a, b)
+
+    def test_iter_from_never_loads_skipped(self):
+        ds = _CountingDataset(20)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        list(loader.iter_from(3))
+        assert min(ds.fetched) == 12   # batches 0..2 never touched
+
+    def test_iter_from_rollover_refuses(self):
+        """Post-review regression: rollover carries leftover indices
+        across epochs in process memory — a resume cannot reconstruct
+        them, so iter_from refuses instead of silently shifting batch
+        boundaries."""
+        ds = _CountingDataset(10)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False,
+                                       last_batch="rollover")
+        with pytest.raises(MXNetError, match="rollover"):
+            loader.iter_from(1)
+        assert len(list(loader)) == 2   # plain iteration unaffected
+
+    def test_iter_from_past_end_raises(self):
+        ds = _CountingDataset(8)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        with pytest.raises(MXNetError, match="past the end"):
+            loader.iter_from(3)
+
+    def test_seeded_random_sampler_resumes(self):
+        from mxnet_tpu.gluon.data.sampler import RandomSampler
+
+        s1 = RandomSampler(16, seed=7)
+        epoch0 = list(s1)
+        epoch1 = list(s1)
+        assert epoch0 != epoch1
+        s2 = RandomSampler(16, seed=7)
+        s2.set_epoch(1)
+        assert list(s2) == epoch1
+
+    def test_seeded_shuffle_iter_from_reproduces_epoch_tail(self):
+        from mxnet_tpu.gluon.data.sampler import RandomSampler
+
+        ds = _CountingDataset(20)
+        loader = gluon.data.DataLoader(
+            ds, batch_size=4, sampler=RandomSampler(20, seed=3))
+        full = [b.asnumpy() for b in loader]            # epoch 0
+        loader.set_epoch(0)
+        tail = [b.asnumpy() for b in loader.iter_from(2)]
+        for a, b in zip(full[2:], tail):
+            onp.testing.assert_array_equal(a, b)
+
+
+class TestFaultSites:
+    @pytest.fixture(autouse=True)
+    def _clean(self, monkeypatch):
+        from mxnet_tpu.telemetry.faults import reset_faults
+
+        monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+        reset_faults()
+        yield
+        monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+        reset_faults()
+
+    def test_checkpoint_save_site_aborts_before_commit(self, tmp_path,
+                                                       monkeypatch):
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        monkeypatch.setenv("MXNET_FAULT_INJECT",
+                           "checkpoint.save:raise:1")
+        with pytest.raises(MXNetError, match="injected fault"):
+            mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+        # nothing committed: the fault fires AFTER the temp write,
+        # BEFORE the rename — the step must be invisible, and the
+        # failed writer cleaned up its own temp dir
+        assert mx.checkpoint.latest_step(str(tmp_path)) is None
+        assert not list(tmp_path.glob(".tmp-*"))
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        from mxnet_tpu.telemetry.faults import reset_faults
+
+        reset_faults()
+        mx.checkpoint.save(str(tmp_path), 2, net, trainer)
+        net2, _ = _net_and_trainer()
+        net2(mx.nd.ones((1, 5)))
+        assert mx.checkpoint.restore(str(tmp_path), net2) == 2
+
+    def test_checkpoint_restore_site(self, tmp_path, monkeypatch):
+        net, trainer = _net_and_trainer()
+        net(mx.nd.ones((1, 5)))
+        mx.checkpoint.save(str(tmp_path), 1, net, trainer)
+        monkeypatch.setenv("MXNET_FAULT_INJECT",
+                           "checkpoint.restore:raise:1")
+        with pytest.raises(MXNetError, match="injected fault"):
+            mx.checkpoint.restore(str(tmp_path), net, trainer)
+
+    def test_data_next_site(self, monkeypatch):
+        ds = _CountingDataset(20)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "data.next:raise:3")
+        out = []
+        with pytest.raises(MXNetError, match="injected fault"):
+            for b in loader:
+                out.append(b)
+        assert len(out) == 2   # died drawing the 3rd batch
+
+
+class TestAtomicCheckpointHandler:
+    def _estimator(self, seed):
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(6, use_bias=False, in_units=6),
+                    nn.Dense(2, use_bias=False, in_units=6))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-2}, kvstore=None)
+        est = gluon.contrib.estimator.Estimator(
+            net, gluon.loss.L2Loss(), trainer=trainer)
+        return est
+
+    def _loader(self):
+        rng = onp.random.RandomState(2)
+        ds = gluon.data.ArrayDataset(
+            mx.nd.array(rng.rand(16, 6).astype(onp.float32)),
+            mx.nd.array(rng.rand(16, 2).astype(onp.float32)))
+        return gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+
+    def test_periodic_save_and_auto_resume(self, tmp_path):
+        from mxnet_tpu.gluon.contrib.estimator import \
+            AtomicCheckpointHandler
+
+        est = self._estimator(seed=0)
+        h = AtomicCheckpointHandler(str(tmp_path), every_n_batches=2,
+                                    every_n_epochs=None)
+        est.fit(self._loader(), epochs=2, event_handlers=[h])
+        assert h.resumed_step is None
+        assert mx.checkpoint.latest_step(str(tmp_path)) == 8
+        ref = _params_np(est.net)
+
+        est2 = self._estimator(seed=9)    # different init on purpose
+        h2 = AtomicCheckpointHandler(str(tmp_path), every_n_batches=2)
+        h2.train_begin(est2)
+        assert h2.resumed_step == 8 and h2.current_batch == 8
+        for k, v in _params_np(est2.net).items():
+            onp.testing.assert_array_equal(v, ref[k])
+        h2.train_end(est2)
+
+    def test_resume_false_starts_fresh(self, tmp_path):
+        from mxnet_tpu.gluon.contrib.estimator import \
+            AtomicCheckpointHandler
+
+        est = self._estimator(seed=0)
+        h = AtomicCheckpointHandler(str(tmp_path), every_n_epochs=1)
+        est.fit(self._loader(), epochs=1, event_handlers=[h])
+        est2 = self._estimator(seed=9)
+        before = _params_np(est2.net)
+        h2 = AtomicCheckpointHandler(str(tmp_path), resume=False)
+        h2.train_begin(est2)
+        for k, v in _params_np(est2.net).items():
+            onp.testing.assert_array_equal(v, before[k])
+        h2.train_end(est2)
+
+
+class TestReportSections:
+    def test_telemetry_report_checkpoint_and_restart_sections(
+            self, tmp_path):
+        """tools/telemetry_report.py renders the new sections from a
+        recording alone (the offline-truth contract)."""
+        import subprocess
+        import sys
+
+        rec = tmp_path / "rec.jsonl"
+        events = [
+            {"kind": "checkpoint_saved", "dir": "/ck", "step": 3,
+             "bytes": 100, "arrays": 4, "snapshot_s": 0.001,
+             "write_s": 0.01, "async_save": True},
+            {"kind": "checkpoint_corrupt", "dir": "/ck", "step": 4,
+             "why": "array arr_00001.npy truncated"},
+            {"kind": "checkpoint_restored", "dir": "/ck", "step": 3,
+             "arrays": 4},
+            {"kind": "pod_restart", "restart": 1, "rank": 0,
+             "why": "died_signal", "attempt": 1, "budget": 2,
+             "backoff_s": 1.0},
+        ]
+        rec.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        r = subprocess.run(
+            [sys.executable, "tools/telemetry_report.py", str(rec)],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        assert "checkpoints" in r.stdout and "pod restarts" in r.stdout
+        assert "checkpoint_corrupt" in r.stdout
+        r = subprocess.run(
+            [sys.executable, "tools/telemetry_report.py", str(rec),
+             "--json"],
+            capture_output=True, text=True, cwd="/root/repo")
+        data = json.loads(r.stdout)
+        assert data["checkpoints"][0]["saves"] == 1
+        assert data["restarts"][0]["restarts"] == 1

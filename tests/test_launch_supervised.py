@@ -1,31 +1,63 @@
-"""Supervised launch chaos gauntlet (ISSUE 13): ``tools/launch.py`` as
-a real supervisor — a dead or wedged rank produces a clean nonzero
-exit on ALL ranks within the timeout, never a hang.
+"""Supervised launch chaos gauntlet (ISSUES 13 + 15): ``tools/launch.py``
+as a real supervisor — a dead or wedged rank produces a clean nonzero
+exit on ALL ranks within the timeout (never a hang), and with
+``--restarts`` the job RECOVERS: the pod is torn down, re-spawned, and
+every rank auto-resumes from the newest complete checkpoint, bit-exact.
 
-Acceptance bar (a): killing one of 3 launched ranks tears the job down
-with a diagnostic naming the failed rank; the supervisor forwards the
-first failing rank's exit code (128+signal for signal deaths) and no
-sibling survives.  The fast tier-1 arms use a no-import script (exit
-code forwarding) and the fault-injected SIGKILL (the ISSUE's smoke);
-the heartbeat-silence matrix arm is slow.
+Acceptance bars: (ISSUE 13) killing one of 3 launched ranks tears the
+job down with a diagnostic naming the failed rank and the first failing
+rank's exit code forwarded.  (ISSUE 15 chaos parity pin) a training run
+SIGKILLed mid-run — once mid-checkpoint-save and once
+mid-accumulation-window — and restarted via ``--restarts`` produces
+final params/optimizer states numerically identical to an uninterrupted
+run; plus the 3-rank restart smoke (rank 1 fault-killed, one restart,
+run completes, params equal uninterrupted).  The heartbeat-silence
+matrix arms are slow.
 """
+import json
 import os
 import subprocess
 import sys
 import time
 
+import numpy as onp
 import pytest
 
 _LAUNCH = [sys.executable, "tools/launch.py"]
+_RESUME_PROG = os.path.join("tests", "fixtures", "resume_train.py")
 
 
-def _run(args, timeout):
+def _run(args, timeout, extra_env=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
     env.pop("MXNET_FAULT_INJECT", None)
+    env.pop("MXNET_CHECKPOINT_DIR", None)
+    env.pop("MXNET_RESTART_COUNT", None)
+    if extra_env:
+        env.update(extra_env)
     t0 = time.monotonic()
     r = subprocess.run(args, capture_output=True, text=True,
                        cwd="/root/repo", env=env, timeout=timeout)
     return r, time.monotonic() - t0
+
+
+def _assert_npz_equal(path_a, path_b):
+    a, b = onp.load(path_a), onp.load(path_b)
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        onp.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.fixture(scope="module")
+def uninterrupted_out(tmp_path_factory):
+    """The uninterrupted-run truth every parity arm compares against:
+    one direct (no supervisor, no faults) run of the resume_train
+    fixture with its default arguments."""
+    base = tmp_path_factory.mktemp("baseline")
+    out = str(base / "out.npz")
+    r, _ = _run([sys.executable, _RESUME_PROG, "--dir",
+                 str(base / "ck"), "--out", out], timeout=180)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    return out
 
 
 class TestSupervisedLaunch:
@@ -122,6 +154,15 @@ class TestSupervisedLaunch:
         assert r.returncode != 0
         assert "must exceed" in r.stderr
 
+    def test_restarts_rejected_in_ssh_mode(self, tmp_path):
+        hosts = tmp_path / "hosts"
+        hosts.write_text("localhost\n")
+        r, _ = _run(_LAUNCH + ["-n", "1", "--launcher", "ssh", "-H",
+                               str(hosts), "--restarts", "1",
+                               "python", "-c", "pass"], timeout=30)
+        assert r.returncode != 0
+        assert "local mode only" in r.stderr
+
     def test_clean_three_rank_run_still_exits_zero(self, tmp_path):
         """Supervision must not break the happy path: 3 ranks exiting
         zero -> supervisor exits zero with all output passed through."""
@@ -135,3 +176,123 @@ class TestSupervisedLaunch:
         assert r.returncode == 0, r.stderr[-500:]
         for i in range(3):
             assert f"RANK{i}_OK" in r.stdout
+
+
+class TestSupervisedRestart:
+    """ISSUE 15: the recovery half — ``--restarts`` turns worker_dead
+    into a pod restart with checkpoint auto-resume."""
+
+    def test_chaos_parity_two_kills_bit_exact(self, tmp_path,
+                                              uninterrupted_out):
+        """THE chaos parity pin: one supervised run SIGKILLed twice —
+        generation 0 mid-checkpoint-save (``checkpoint.save:kill:4``
+        fires after the temp write, before the commit rename) and
+        generation 1 mid-accumulation-window (``data.next:kill:3`` with
+        update_interval=2 kills after an odd step) — then restarted by
+        the supervisor each time.  The run must complete with exit 0,
+        resume from the newest COMPLETE checkpoint each time (the
+        interrupted save is swept with a checkpoint_corrupt event), and
+        the final params + optimizer states must be numerically
+        IDENTICAL to the uninterrupted run."""
+        out = str(tmp_path / "out.npz")
+        rec = str(tmp_path / "rec.jsonl")
+        r, dt = _run(
+            _LAUNCH + ["-n", "1", "--restarts", "2",
+                       "--restart-backoff", "0.1", "--kill-grace", "1",
+                       "--checkpoint-dir", str(tmp_path / "ck"),
+                       sys.executable, _RESUME_PROG, "--out", out,
+                       "--fault", "0=checkpoint.save:kill:4",
+                       "--fault", "1=data.next:kill:3"],
+            timeout=300, extra_env={"MXNET_TELEMETRY_JSONL": rec})
+        assert r.returncode == 0, (r.returncode, r.stderr[-1200:])
+        assert "restarting the pod" in r.stderr
+        assert r.stderr.count("died_signal") >= 2
+        _assert_npz_equal(uninterrupted_out, out)
+        # the recording carries the whole recovery story
+        events = [json.loads(ln) for ln in open(rec) if ln.strip()]
+        kinds = [e.get("kind") for e in events]
+        assert kinds.count("pod_restart") == 2
+        assert "checkpoint_corrupt" in kinds   # the aborted tmp save
+        assert "checkpoint_saved" in kinds
+        # and telemetry_report renders/parses it (restarts section)
+        rr, _ = _run([sys.executable, "tools/telemetry_report.py", rec,
+                      "--json"], timeout=60)
+        assert rr.returncode == 0, rr.stderr[-500:]
+        summary = json.loads(rr.stdout)
+        assert summary["restarts"][0]["restarts"] == 2
+        assert dt < 240, f"no-hang bar: {dt:.1f}s"
+
+    def test_three_rank_restart_smoke(self, tmp_path,
+                                      uninterrupted_out):
+        """Satellite: 3-rank pod, rank 1 fault-killed mid-run, ONE
+        supervised restart, the whole run completes, and the final
+        params equal an uninterrupted run (every rank trains the same
+        deterministic program and resumes from its own per-rank
+        checkpoint dir)."""
+        outs = [str(tmp_path / f"out{r}.npz") for r in range(3)]
+        r, dt = _run(
+            _LAUNCH + ["-n", "3", "--restarts", "1",
+                       "--restart-backoff", "0.1",
+                       "--heartbeat-interval", "0.2",
+                       "--heartbeat-timeout", "60",
+                       "--kill-grace", "1",
+                       "--checkpoint-dir", str(tmp_path / "ck"),
+                       sys.executable, _RESUME_PROG,
+                       "--out", str(tmp_path / "outRANK.npz"),
+                       "--out-per-rank",
+                       "--fault", "0=launch.heartbeat:kill:3",
+                       "--fault-rank", "1"],
+            timeout=300)
+        assert r.returncode == 0, (r.returncode, r.stderr[-1200:])
+        assert "rank 1" in r.stderr and "restarting the pod" in r.stderr
+        for out in outs:
+            assert os.path.exists(out), (out, r.stdout[-800:])
+        # rank 1 (the killed one) — and its siblings, torn down by the
+        # supervisor mid-flight — all land bit-exact on the truth
+        for out in outs:
+            _assert_npz_equal(uninterrupted_out, out)
+        assert dt < 240, f"no-hang bar: {dt:.1f}s"
+
+    def test_restart_budget_exhausted_per_distinct_failure(
+            self, tmp_path):
+        """A rank flapping the SAME way exhausts its (rank, why) budget
+        and the job fails with that rank's code — restart storms are
+        bounded."""
+        script = tmp_path / "always7.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        r, dt = _run(_LAUNCH + ["-n", "1", "--restarts", "1",
+                                "--restart-backoff", "0.1",
+                                sys.executable, str(script)],
+                     timeout=60)
+        assert r.returncode == 7
+        assert "restarting the pod" in r.stderr          # one restart
+        assert "restart budget exhausted" in r.stderr    # then stop
+        assert dt < 30
+
+    @pytest.mark.slow
+    def test_heartbeat_silent_rank_restarts_and_completes(
+            self, tmp_path):
+        """Matrix arm: a rank whose heartbeat goes SILENT (fault-hung
+        beat loop, process alive) is declared wedged, the pod is torn
+        down and restarted once, and the longer run completes clean —
+        heartbeat-silence and restart composed end to end."""
+        out = str(tmp_path / "outRANK.npz")
+        r, dt = _run(
+            _LAUNCH + ["-n", "3", "--restarts", "1",
+                       "--restart-backoff", "0.1",
+                       "--heartbeat-interval", "0.2",
+                       "--heartbeat-timeout", "2",
+                       "--kill-grace", "1",
+                       "--checkpoint-dir", str(tmp_path / "ck"),
+                       sys.executable, _RESUME_PROG,
+                       "--steps", "400", "--out", out,
+                       "--out-per-rank",
+                       "--fault", "0=launch.heartbeat:hang:2:600",
+                       "--fault-rank", "2"],
+            timeout=420)
+        assert r.returncode == 0, (r.returncode, r.stderr[-1200:])
+        assert "heartbeat silent" in r.stderr
+        assert "restarting the pod" in r.stderr
+        for rank in range(3):
+            assert os.path.exists(str(tmp_path / f"out{rank}.npz"))
+        assert dt < 360, f"no-hang bar: {dt:.1f}s"

@@ -35,6 +35,26 @@ exits with the FIRST failing rank's code (``128+signal`` for signal
 deaths) instead of hanging in a half-dead rendezvous.  Ranks that
 never beat (commands that don't import mxnet_tpu) are supervised on
 process exit alone, so plain commands behave exactly as before.
+
+Supervised restart (ISSUE 15, the recovery half): with ``--restarts N``
+a dead/wedged rank no longer ends the job — the supervisor tears down
+ALL ranks (the same hardened ``_kill_all``), waits a doubling backoff
+(``--restart-backoff``), and re-spawns the whole pod on a fresh
+coordinator port.  Ranks auto-resume from the newest COMPLETE
+checkpoint: ``--checkpoint-dir D`` exports ``MXNET_CHECKPOINT_DIR=D``
+so ``mx.checkpoint.restore(step=None)`` / the Estimator's
+``AtomicCheckpointHandler`` find it, and every spawn exports
+``MXNET_RESTART_COUNT`` (0 on the first launch) so rank code can
+branch per attempt (chaos scripts re-arm ``MXNET_FAULT_INJECT`` — or
+don't — based on it; the supervisor itself never rewrites the spec).
+The budget is counted PER DISTINCT FAILURE ``(rank, why)``: a rank
+flapping the same way N times exhausts its budget and the job fails
+with that rank's code, while a brand-new failure gets its own N —
+restart storms stay bounded without a single global counter starving
+unrelated recoveries.  Each restart emits a ``pod_restart`` event +
+``launch_pod_restarts_total`` counter; ``tools/telemetry_report.py``
+renders them in its restarts section.  An operator signal
+(SIGINT/SIGTERM) is never restarted.  Local mode only.
 """
 from __future__ import annotations
 
@@ -88,6 +108,8 @@ def _emit(kind, **fields):
     telemetry.emit(kind, **fields)
     if kind == "worker_dead":
         telemetry.counter("launch_worker_dead_total").inc()
+    elif kind == "pod_restart":
+        telemetry.counter("launch_pod_restarts_total").inc()
 
 
 class _Rank:
@@ -200,7 +222,10 @@ def _fail(ranks, bad, why, detail, grace):
 
 def _supervise(ranks, heartbeat_timeout, grace):
     """Watch rank processes and heartbeats until everyone exits zero,
-    one rank fails, or a beating rank goes silent."""
+    one rank fails, or a beating rank goes silent.  Returns
+    ``(exit_code, failure)`` — failure is ``{"rank", "why"}`` for a
+    restartable rank death, None for a clean run or an operator
+    signal (signals must never be 'recovered' by a restart)."""
     stop = {"sig": None}
 
     def _on_signal(signum, _frame):
@@ -216,7 +241,7 @@ def _supervise(ranks, heartbeat_timeout, grace):
                 print(f"[launch] received signal {stop['sig']}; "
                       "killing all ranks", file=sys.stderr, flush=True)
                 _kill_all(ranks, grace)
-                return 128 + stop["sig"]
+                return 128 + stop["sig"], None
             for r in list(pending):
                 rc = r.proc.poll()
                 if rc is not None:
@@ -224,10 +249,9 @@ def _supervise(ranks, heartbeat_timeout, grace):
                         sig = -rc if rc < 0 else None
                         detail = (f"died with signal {sig}" if sig
                                   else f"exited with code {rc}")
-                        return _fail(
-                            ranks, r,
-                            "died_signal" if sig else "exited_nonzero",
-                            detail, grace)
+                        why = "died_signal" if sig else "exited_nonzero"
+                        code = _fail(ranks, r, why, detail, grace)
+                        return code, {"rank": r.rank, "why": why}
                     pending.remove(r)
                     continue
                 if heartbeat_timeout:
@@ -237,9 +261,10 @@ def _supervise(ranks, heartbeat_timeout, grace):
                               f"heartbeat silent for {age:.1f}s "
                               f"(--heartbeat-timeout {heartbeat_timeout}"
                               "s): wedged or livelocked", grace)
-                        return 1
+                        return 1, {"rank": r.rank,
+                                   "why": "heartbeat_silent"}
             time.sleep(0.1)
-        return 0
+        return 0, None
     finally:
         for signum, handler in old.items():
             signal.signal(signum, handler)
@@ -247,15 +272,10 @@ def _supervise(ranks, heartbeat_timeout, grace):
             r.reader.join(timeout=2.0)
 
 
-def launch_local(args, command):
+def _run_pod(args, command, restart_count):
+    """Spawn + supervise one generation of the pod (a fresh coordinator
+    port per generation — the previous one may still be in TIME_WAIT)."""
     coordinator = f"127.0.0.1:{_free_port()}"
-    if args.dry_run:
-        for rank in range(args.num_workers):
-            env = _rank_env(args, coordinator, rank)
-            kv = " ".join(f"{k}={env[k]}" for k in sorted(env)
-                          if k.startswith(("MXNET_", "DMLC")))
-            print(f"[rank {rank}] {kv} {' '.join(command)}")
-        return 0
     hb_dir = tempfile.mkdtemp(prefix="mxnet_launch_hb_")
     ranks = []
     try:
@@ -265,6 +285,9 @@ def launch_local(args, command):
             env["MXNET_HEARTBEAT_FILE"] = hb_path
             env["MXNET_HEARTBEAT_INTERVAL"] = str(
                 args.heartbeat_interval)
+            env["MXNET_RESTART_COUNT"] = str(restart_count)
+            if args.checkpoint_dir:
+                env["MXNET_CHECKPOINT_DIR"] = args.checkpoint_dir
             # piped stdout makes python ranks BLOCK-buffered: without
             # this, a hard-killed rank takes its last ~8KB of output
             # to the grave and the post-mortem tail prints stale lines
@@ -279,6 +302,46 @@ def launch_local(args, command):
     finally:
         _kill_all(ranks, grace=0.0)   # no-op when all reaped already
         shutil.rmtree(hb_dir, ignore_errors=True)
+
+
+def launch_local(args, command):
+    if args.dry_run:
+        coordinator = f"127.0.0.1:{_free_port()}"
+        for rank in range(args.num_workers):
+            env = _rank_env(args, coordinator, rank)
+            kv = " ".join(f"{k}={env[k]}" for k in sorted(env)
+                          if k.startswith(("MXNET_", "DMLC")))
+            print(f"[rank {rank}] {kv} {' '.join(command)}")
+        return 0
+    restarts_used = {}   # (rank, why) -> restarts consumed
+    total_restarts = 0
+    while True:
+        code, fail = _run_pod(args, command, total_restarts)
+        if code == 0 or fail is None or args.restarts <= 0:
+            return code
+        sig = (fail.get("rank"), fail.get("why"))
+        used = restarts_used.get(sig, 0)
+        if used >= args.restarts:
+            print(f"[launch] restart budget exhausted: rank {sig[0]} "
+                  f"failed the same way ({sig[1]}) {used + 1} times "
+                  f"with --restarts {args.restarts}; giving up",
+                  file=sys.stderr, flush=True)
+            return code
+        restarts_used[sig] = used + 1
+        total_restarts += 1
+        backoff = args.restart_backoff * (2 ** used)
+        print(f"[launch] rank {sig[0]} {sig[1]}: restarting the pod "
+              f"(restart {total_restarts}; attempt {used + 1}/"
+              f"{args.restarts} for this failure) after {backoff:.1f}s "
+              "backoff; ranks resume from the newest complete "
+              "checkpoint" +
+              (f" in {args.checkpoint_dir}" if args.checkpoint_dir
+               else ""),
+              file=sys.stderr, flush=True)
+        _emit("pod_restart", restart=total_restarts, rank=sig[0],
+              why=sig[1], attempt=used + 1, budget=args.restarts,
+              backoff_s=backoff)
+        time.sleep(backoff)
 
 
 def launch_ssh(args, command):
@@ -358,6 +421,23 @@ def main(argv=None):
     parser.add_argument("--kill-grace", type=float, default=5.0,
                         help="seconds between SIGTERM and SIGKILL when "
                              "tearing down surviving ranks")
+    parser.add_argument("--restarts", type=int, default=0,
+                        help="supervised-restart budget PER DISTINCT "
+                             "failure (rank, why): on a dead/silent "
+                             "rank the whole pod is torn down and "
+                             "re-spawned (doubling backoff), ranks "
+                             "resuming from the newest complete "
+                             "checkpoint; 0 (default) = fail fast. "
+                             "Local mode only")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        help="base seconds between teardown and "
+                             "re-spawn; doubles per consecutive "
+                             "restart of the same failure")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="exported to every rank as "
+                             "MXNET_CHECKPOINT_DIR — where "
+                             "mx.checkpoint auto-resume looks for the "
+                             "newest complete checkpoint on restart")
     parser.add_argument("--dry-run", action="store_true",
                         help="print the per-rank commands without running")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -374,9 +454,17 @@ def main(argv=None):
             f"--heartbeat-timeout {args.heartbeat_timeout} must exceed "
             f"2x --heartbeat-interval {args.heartbeat_interval} — a "
             "healthy rank beating on schedule would be declared silent")
+    if args.restarts < 0:
+        parser.error("--restarts must be >= 0")
+    if args.restart_backoff < 0:
+        parser.error("--restart-backoff must be >= 0")
     if args.launcher == "ssh":
         if not args.hostfile:
             parser.error("--launcher ssh requires -H/--hostfile")
+        if args.restarts:
+            parser.error("--restarts is supported in local mode only "
+                         "(ssh mode has no heartbeat channel to judge "
+                         "restartable failures)")
         return launch_ssh(args, args.command)
     return launch_local(args, args.command)
 

@@ -17,8 +17,15 @@ Sections (each skipped when the file has no events of that kind):
   counters, occupancy.
 - **failure causes** — the fault-tolerance events (ISSUE 13):
   ``worker_dead`` / ``deadline_exceeded`` / ``request_cancelled`` /
-  ``fault_injected`` / ``watchdog_fired`` / ``kvstore_error``, counted
-  per kind with a per-site/server/reason breakdown.
+  ``fault_injected`` / ``watchdog_fired`` / ``kvstore_error`` /
+  ``checkpoint_corrupt``, counted per kind with a
+  per-site/server/reason breakdown.
+- **checkpoints** — ``checkpoint_saved`` / ``checkpoint_restored``
+  rollup per directory: saves, bytes, snapshot/write seconds (the
+  async-save stall truth), restores and corrupt skips.
+- **restarts** — ``pod_restart`` events from the
+  ``tools/launch.py --restarts`` supervisor: per (rank, why) counts,
+  attempts, backoff (ISSUE 15 recovery loop).
 - **bench rows** — ``kind=bench`` events (serve_bench / step_profile
   measured rows) passed through as a table.
 
@@ -142,7 +149,8 @@ def serve_summary(events):
 
 
 FAILURE_KINDS = ("worker_dead", "deadline_exceeded", "request_cancelled",
-                 "fault_injected", "watchdog_fired", "kvstore_error")
+                 "fault_injected", "watchdog_fired", "kvstore_error",
+                 "checkpoint_corrupt")
 
 
 def failure_summary(events):
@@ -161,13 +169,78 @@ def failure_summary(events):
         detail = defaultdict(int)
         for e in evs:
             where = e.get("site") or e.get("server") or \
-                (f"rank {e['rank']}" if "rank" in e else "?")
+                (f"rank {e['rank']}" if "rank" in e else None) or \
+                e.get("dir") or "?"
             what = e.get("fault_kind") or e.get("reason") or \
                 e.get("why") or e.get("command") or e.get("error")
             detail[f"{where}" + (f": {what}" if what else "")] += 1
         rows.append({"kind": kind, "count": len(evs),
                      "detail": dict(sorted(detail.items()))})
     return rows
+
+
+def checkpoint_summary(events):
+    """Per-directory checkpoint rollup: saves (bytes + the measured
+    snapshot/write stalls — the async-save acceptance truth), restores,
+    and corrupt skips."""
+    by_dir = defaultdict(lambda: {"saves": 0, "restores": 0,
+                                  "corrupt": 0, "bytes": 0,
+                                  "snapshot_s": [], "write_s": [],
+                                  "last_step": None})
+    saw = False
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("checkpoint_saved", "checkpoint_restored",
+                        "checkpoint_corrupt"):
+            continue
+        saw = True
+        d = by_dir[e.get("dir", "?")]
+        if kind == "checkpoint_saved":
+            d["saves"] += 1
+            d["bytes"] += e.get("bytes", 0)
+            if e.get("snapshot_s") is not None:
+                d["snapshot_s"].append(e["snapshot_s"])
+            if e.get("write_s") is not None:
+                d["write_s"].append(e["write_s"])
+            d["last_step"] = e.get("step")
+        elif kind == "checkpoint_restored":
+            d["restores"] += 1
+        else:
+            d["corrupt"] += 1
+    if not saw:
+        return []
+    rows = []
+    for path in sorted(by_dir):
+        d = by_dir[path]
+        snaps, writes = d["snapshot_s"], d["write_s"]
+        rows.append({
+            "dir": path, "saves": d["saves"], "restores": d["restores"],
+            "corrupt": d["corrupt"], "bytes": d["bytes"],
+            "last_step": d["last_step"],
+            "snapshot_ms_mean": _to_ms(sum(snaps) / len(snaps))
+            if snaps else None,
+            "snapshot_ms_max": _to_ms(max(snaps)) if snaps else None,
+            "write_ms_mean": _to_ms(sum(writes) / len(writes))
+            if writes else None,
+        })
+    return rows
+
+
+def restart_summary(events):
+    """``pod_restart`` rows from the launch supervisor: one recording
+    answers how often the pod restarted, for which failures, and how
+    much backoff it paid."""
+    evs = [e for e in events if e.get("kind") == "pod_restart"]
+    if not evs:
+        return []
+    detail = defaultdict(int)
+    for e in evs:
+        detail[f"rank {e.get('rank', '?')}: {e.get('why', '?')}"] += 1
+    return [{"restarts": len(evs),
+             "backoff_s_total": round(sum(e.get("backoff_s", 0.0)
+                                          for e in evs), 3),
+             "max_attempt": max(e.get("attempt", 1) for e in evs),
+             "detail": dict(sorted(detail.items()))}]
 
 
 def check_serve(events):
@@ -306,6 +379,28 @@ def render(events):
             lines.append(f"  {r['kind']:<20}{r['count']:>6}")
             for where, n in r["detail"].items():
                 lines.append(f"    {n:>4}x {where}")
+    ckpts = checkpoint_summary(events)
+    if ckpts:
+        lines.append("")
+        lines.append("checkpoints")
+        for r in ckpts:
+            lines.append(
+                f"  {r['dir']}: {r['saves']} saves "
+                f"({r['bytes']} bytes, last step {r['last_step']}), "
+                f"{r['restores']} restores, {r['corrupt']} corrupt; "
+                f"snapshot stall mean {_ms(r['snapshot_ms_mean'])} ms "
+                f"max {_ms(r['snapshot_ms_max'])} ms, "
+                f"write mean {_ms(r['write_ms_mean'])} ms")
+    restarts = restart_summary(events)
+    if restarts:
+        r = restarts[0]
+        lines.append("")
+        lines.append("pod restarts")
+        lines.append(f"  {r['restarts']} restarts, "
+                     f"{r['backoff_s_total']}s total backoff, "
+                     f"deepest attempt {r['max_attempt']}")
+        for where, n in r["detail"].items():
+            lines.append(f"    {n:>4}x {where}")
     bench = [e for e in events if e.get("kind") == "bench"]
     if bench:
         lines.append("")
@@ -345,6 +440,8 @@ def main(argv=None):
             "compile": compile_summary(events),
             "serve": serve_summary(events),
             "failures": failure_summary(events),
+            "checkpoints": checkpoint_summary(events),
+            "restarts": restart_summary(events),
             "bench": [e for e in events if e.get("kind") == "bench"],
         }, indent=2, sort_keys=True))
     else:
