@@ -611,7 +611,23 @@ class _DecodeEngine:
         return self._scan_token(x_tok, pos, ck, cv, sw, q8,
                                 per_slot=True)
 
-    def _scan_token(self, x_tok, pos, ck, cv, sw, q8, per_slot):
+    def pool_token_paged(self, x_tok, pos, kp, vp, pt, page, sw, q8=None):
+        """pool_token against a PAGED pool (``mxnet_tpu.serve``): the
+        caches are page pools ``(NL, NPAGES, KV, page, D)`` and each
+        slot reads/writes them through its page-table row ``pt[b]``
+        (``pt``: (B, MAXP) int32, a TRACED operand — allocation churn
+        changes table VALUES, never shapes, so no retrace).  Rows of
+        retired/idle slots hold the one-past-the-end sentinel
+        ``NPAGES``: their gathers fill zeros and their scatters DROP,
+        which is what makes masked zombie lanes safe — a freed page can
+        never be corrupted by a slot that no longer owns it.  Token
+        order is ``t = j * page + o`` (page-major), so the gathered
+        dense view reproduces ``pool_token``'s attention bit-for-bit."""
+        return self._scan_token(x_tok, pos, kp, vp, sw, q8,
+                                per_slot=True, pages=(pt, page))
+
+    def _scan_token(self, x_tok, pos, ck, cv, sw, q8, per_slot,
+                    pages=None):
         from ..ops.attention import rope as _rope
         from ..ops.registry import get_op
 
@@ -640,9 +656,23 @@ class _DecodeEngine:
         # (1,1,1,T) <= scalar pos, or <= (B,1,1,1) per-slot positions
         pos_b = pos[:, None, None, None] if per_slot else pos
         iB = jnp.arange(B)
+        if pages is not None:
+            pt, page = pages
+            maxp = self.total // page
+
+            def _paged_view(pool_l):
+                # (NPAGES, KV, page, D) pool layer -> (B, KV, T, D)
+                # per-slot dense views through the page table; sentinel
+                # entries (pt == NPAGES) gather zeros
+                g = pool_l.at[pt].get(mode="fill", fill_value=0)
+                return jnp.moveaxis(g, 2, 1).reshape(B, KV, self.total,
+                                                     D)
 
         def body(x, xs):
             w, kc, vc = xs                    # per-layer slices
+            if pages is not None:
+                kc = _paged_view(kc)
+                vc = _paged_view(vc)
             if llama:
                 h = _rms(x, w["rms1_g"], eps=eps1)
                 if int8:
@@ -708,7 +738,18 @@ class _DecodeEngine:
         x, (knew, vnew) = lax.scan(body, x, (sw, ck, cv))
         # knew/vnew: (NL, B, KV, 1, D) — all layers' new columns land in
         # the carried caches as ONE update (slice, or per-slot scatter)
-        if per_slot:
+        if pages is not None:
+            # slot b's position pos[b] lives at (page pt[b, pos//page],
+            # offset pos % page).  Retired slots carry the sentinel in
+            # their table rows so the scatter DROPS their zombie writes;
+            # the clip keeps a stale pos == T from indexing past the
+            # table (it would otherwise clamp onto a live entry).
+            pg = pt[iB, jnp.minimum(pos // page, maxp - 1)]
+            ck = ck.at[:, pg, :, pos % page, :].set(
+                jnp.moveaxis(knew[:, :, :, 0, :], 0, 1), mode="drop")
+            cv = cv.at[:, pg, :, pos % page, :].set(
+                jnp.moveaxis(vnew[:, :, :, 0, :], 0, 1), mode="drop")
+        elif per_slot:
             ck = ck.at[:, iB, :, pos, :].set(
                 jnp.moveaxis(knew[:, :, :, 0, :], 0, 1))
             cv = cv.at[:, iB, :, pos, :].set(
@@ -718,6 +759,152 @@ class _DecodeEngine:
             cv = lax.dynamic_update_slice(cv, vnew, (0, 0, 0, pos, 0))
         xl = _call(self.model.ln_f, x)
         return self._head_logits(xl, q8), ck, cv
+
+    def chunk_tokens(self, toks, off, nlast, ptrow, page, kp, vp, sw,
+                     q8=None):
+        """ONE CHUNK of a single sequence's prefill against the PAGED
+        pool (chunked prefill and prefix-cache suffix fill,
+        ``mxnet_tpu.serve``): ``toks`` (C,) int32 occupy absolute
+        positions ``off .. off+C-1`` of the slot whose page-table row
+        is ``ptrow`` (MAXP,) int32.  The already-cached prefix is
+        gathered through the row, the chunk attends causally over
+        prefix + itself (scores masked at ``t <= off + j`` — the same
+        mask/softmax/einsum discipline as the decode step), chunk K/V
+        scatters back through the row (positions past the reserved
+        pages resolve to the sentinel and DROP), and the logits at
+        absolute position ``off + nlast`` come back for the final
+        chunk's first-token sample.  ``off``/``nlast`` ride as TRACED
+        scalars, so one compiled program per chunk length C serves
+        every landing offset — chunked admission never retraces on
+        prompt length."""
+        from ..ops.attention import rope as _rope
+        from ..ops.registry import get_op
+
+        _fc = get_op("FullyConnected").fn
+        _ln = get_op("LayerNorm").fn
+        _rms = get_op("RMSNorm").fn
+        _act = get_op("Activation").fn
+        U, H, KV, D = self.U, self.H, self.KV, self.D
+        T = self.total
+        llama, cdtype = self.is_llama, self.cdtype
+        int8 = self.use_int8
+        eps1, eps2 = self.norm_eps
+        act_t, scale, rope_base = self.act_t, self.scale, self.rope_base
+        _q8l = self._dense_q8
+        C = toks.shape[0]
+        G = H // KV
+        maxp = T // page
+        npages = kp.shape[1]
+        cpos = off + jnp.arange(C, dtype=jnp.int32)       # absolute
+
+        x = _call(self.model.wte, toks)[None]             # (1, C, U)
+        if not llama:
+            x = x + _call(self.model.wpe, cpos)[None]
+        # (C, T) causal mask over absolute positions: chunk row j sees
+        # cached tokens 0..off+j (its own column included post-update)
+        mask = jnp.arange(T, dtype=jnp.int32)[None, :] <= cpos[:, None]
+
+        def body(x, xs):
+            w, kpl, vpl = xs
+            # dense (1, KV, T, D) views of this slot's cached prefix,
+            # gathered through its page-table row (sentinel -> zeros)
+            kc = jnp.moveaxis(
+                kpl.at[ptrow].get(mode="fill", fill_value=0),
+                1, 0).reshape(KV, T, D)[None]
+            vc = jnp.moveaxis(
+                vpl.at[ptrow].get(mode="fill", fill_value=0),
+                1, 0).reshape(KV, T, D)[None]
+            if llama:
+                h = _rms(x, w["rms1_g"], eps=eps1)
+                if int8:
+                    # q8_matvec is strictly 2-D: project the (C, U) rows
+                    q = _q8l(h[0], w["q"]).reshape(1, C, H, D)
+                    k = _q8l(h[0], w["k"]).reshape(1, C, KV, D)
+                    v = _q8l(h[0], w["v"]).reshape(1, C, KV, D)
+                else:
+                    q = _fc(h, w["q_w"], None, no_bias=True,
+                            flatten=False).reshape(1, C, H, D)
+                    k = _fc(h, w["k_w"], None, no_bias=True,
+                            flatten=False).reshape(1, C, KV, D)
+                    v = _fc(h, w["v_w"], None, no_bias=True,
+                            flatten=False).reshape(1, C, KV, D)
+                q = q.transpose(0, 2, 1, 3)               # (1, H, C, D)
+                k = k.transpose(0, 2, 1, 3)
+                v = v.transpose(0, 2, 1, 3)
+                q = _rope.__wrapped__(q, base=rope_base,
+                                      position_offset=off)
+                k = _rope.__wrapped__(k, base=rope_base,
+                                      position_offset=off)
+            else:
+                h = _ln(x, w["ln1_g"], w["ln1_b"], eps=eps1)
+                qkv = _q8l(h[0], w["qkv"])[None] if int8 else \
+                    _fc(h, w["qkv_w"], w["qkv_b"], flatten=False)
+                q, k, v = (qkv[..., j * U:(j + 1) * U]
+                           .reshape(1, C, H, D).transpose(0, 2, 1, 3)
+                           for j in range(3))
+            k = k.astype(cdtype)
+            v = v.astype(cdtype)
+            # chunk K/V lands in the dense view BEFORE attention, so
+            # one mask covers prefix and intra-chunk causality together
+            kc = lax.dynamic_update_slice(kc, k, (0, 0, off, 0))
+            vc = lax.dynamic_update_slice(vc, v, (0, 0, off, 0))
+            qg = q.reshape(1, KV, G, C, D)
+            s = jnp.einsum("bkgcd,bktd->bkgct", qg, kc,
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask[None, None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(cdtype)
+            o = jnp.einsum("bkgct,bktd->bkgcd", p, vc)
+            o = o.transpose(0, 3, 1, 2, 4).reshape(1, C, U)
+            if llama:
+                x = x + (_q8l(o[0], w["o"])[None] if int8 else
+                         _fc(o, w["o_w"], None, no_bias=True,
+                             flatten=False))
+                h2 = _rms(x, w["rms2_g"], eps=eps2)
+                if int8:
+                    g = _q8l(h2[0], w["gate"])
+                    u = _q8l(h2[0], w["up"])
+                    x = x + _q8l(g * jax.nn.sigmoid(g) * u,
+                                 w["down"])[None]
+                else:
+                    g = _fc(h2, w["gate_w"], None, no_bias=True,
+                            flatten=False)
+                    u = _fc(h2, w["up_w"], None, no_bias=True,
+                            flatten=False)
+                    x = x + _fc(g * jax.nn.sigmoid(g) * u, w["down_w"],
+                                None, no_bias=True, flatten=False)
+            elif int8:
+                x = x + _q8l(o[0], w["proj"])[None]
+                h2 = _ln(x, w["ln2_g"], w["ln2_b"], eps=eps2)
+                x = x + _q8l(_q8l(h2[0], w["fc1"], act_t),
+                             w["fc2"])[None]
+            else:
+                x = x + _fc(o, w["proj_w"], w["proj_b"], flatten=False)
+                h2 = _ln(x, w["ln2_g"], w["ln2_b"], eps=eps2)
+                hh = _fc(h2, w["fc1_w"], w["fc1_b"], flatten=False)
+                if act_t is not None:
+                    hh = _act(hh, act_type=act_t)
+                x = x + _fc(hh, w["fc2_w"], w["fc2_b"], flatten=False)
+            return x, (k, v)
+
+        x, (knew, vnew) = lax.scan(body, x, (sw, kp, vp))
+        # knew/vnew: (NL, 1, KV, C, D) — scatter every chunk column
+        # through the page-table row.  Positions past the reserved
+        # pages (bucket-padded tails) resolve to the sentinel and DROP;
+        # the explicit cpos < T guard covers tails that would otherwise
+        # CLIP onto the row's own last page and corrupt earlier tokens.
+        pgs = jnp.where(cpos < T,
+                        ptrow[jnp.minimum(cpos // page, maxp - 1)],
+                        npages)                            # (C,)
+        offs = cpos % page
+        kp = kp.at[:, pgs, :, offs, :].set(
+            jnp.moveaxis(knew[:, 0], 2, 0), mode="drop")
+        vp = vp.at[:, pgs, :, offs, :].set(
+            jnp.moveaxis(vnew[:, 0], 2, 0), mode="drop")
+        x_last = lax.dynamic_slice(x, (0, nlast, 0), (1, 1, U))[:, 0]
+        xl = _call(self.model.ln_f, x_last)
+        # the chunk head is native, matching prefill_batch (q8 covers
+        # the per-token decode matvecs; each chunk runs once)
+        return self._head_logits(xl, None), kp, vp
 
     def fused_token(self, x_tok, pos, ck, cv, packed_t, q8=None):
         """one_token's Pallas twin: embeddings and head stay XLA ops;

@@ -1,39 +1,58 @@
-"""Slot-pool decode programs — the device side of the continuous-
+"""Paged slot-pool decode programs — the device side of the continuous-
 batching server (``mxnet_tpu.serve.server``).
 
-One resident ``(NL, S, KV, T, D)`` K/V-cache pair is shared by all
-in-flight sequences; per-slot position / last-token / active / stop /
-sampling-key / wall-clock-deadline state rides as TRACED OPERANDS next
-to it, so admission and retirement — including deadline expiry against
-the step's ``now`` operand (ISSUE 13) — are device-side masked updates:
-no recompile, no host sync in the step.  Three compiled units per pool
-size ``S``:
+The resident K/V store is a PAGE POOL: one ``(NL, NPAGES, KV, PAGE, D)``
+array pair shared by all in-flight sequences, addressed through per-slot
+page tables (``(S, MAXP)`` int32 rows, host-owned, passed as TRACED
+OPERANDS on every dispatch — allocation churn changes table VALUES,
+never shapes, so the compiled programs survive any admit/retire/append
+pattern with zero retraces).  A sequence holds only the pages its tokens
+occupy, so a long-context ragged mix packs ~T/len(x) more sequences into
+the same HBM than the dense per-slot ``T``-column layout this replaces.
+Per-slot position / last-token / active / stop / sampling-key /
+wall-clock-deadline state rides alongside, so admission and retirement —
+including deadline expiry against the step's ``now`` operand — stay
+device-side masked updates: no recompile, no host sync in the step.
 
-- **step** — ``_DecodeEngine.pool_token`` (the stacked-layer scan with
-  per-slot positions) + per-slot sampling + retirement flags, jitted
-  with the caches donated: ONE executable dispatch per decode step, the
-  same one-executable discipline as ``kv_generate``'s scan
-  (``tests/test_serve.py`` pins the dispatch count).
+The one-past-the-end page id ``NPAGES`` is the table SENTINEL: gathers
+through it fill zeros and scatters through it DROP.  Retired/idle slots
+carry all-sentinel rows, which is what makes masked zombie lanes safe —
+a freed (or reused) page can never be corrupted by a slot that no longer
+owns it, and the overwrite-before-unmask invariant (a decode step at
+position ``q`` writes its own column before attending) covers everything
+a live slot can read.
+
+Compiled units per pool size ``S``:
+
+- **step** — ``_DecodeEngine.pool_token_paged`` (the stacked-layer scan
+  gathering/scattering through the page tables) + per-slot sampling +
+  retirement flags, jitted with the page pools donated: ONE executable
+  dispatch per decode step (``tests/test_serve.py`` pins the count).
 - **admit(A_bucket, P_bucket)** — ONE causal prefill over an ``(A, P)``
-  block of right-padded prompts (compiled per bucket PAIR from pinned
-  ladders, so admission cost stays a handful of programs): up to ``A``
-  pending requests' K/V streams are written into their assigned pool
-  slots in one masked device-side scatter, and the ``A`` first tokens +
-  done flags come back in one readback.  Rows beyond the wave are
-  masked no-ops (their scatter target is out of bounds and DROPPED), so
-  a partially full wave reuses the same program — admitting an arrival
-  wave of k requests is O(1) dispatches, not O(k).  Each padded tail's
-  cache columns are garbage but UNREACHABLE: a decode step at position
-  ``q`` writes its own column before attending, so every attended
-  column was produced by this sequence.
+  block of right-padded prompts; the K/V stream lands in the admitted
+  slots' RESERVED PAGES via one masked page scatter (rows/pages beyond
+  the wave aim at the sentinel and drop), and the ``A`` first tokens +
+  done flags come back in one readback.
+- **admit_hit(A_bucket)** — prefix-cache hit admission: NO model
+  forward at all.  The slot enters at ``pos = L - 1`` mapping the shared
+  prefix pages read-only (plus at most one copy-on-write page copy per
+  row when the prompt ends exactly on a shared page boundary), and the
+  next regular step recomputes the last prompt token — sampling with
+  ``fold_in(key, L-1)``, the exact key the batched admit uses, so hit
+  and miss streams are token-identical while a hit's TTFT is one step.
+- **chunk(C_bucket)** — chunked prefill: one ``C``-token slice of a
+  single long prompt runs against the slot's page-table row
+  (``_DecodeEngine.chunk_tokens``); the landing offset is a traced
+  scalar, so a prompt of any length streams in over ``ceil(L/C)``
+  dispatches of the same compiled program.  Only the FINAL chunk's
+  masked scatter activates the slot.
 - **sampling** — per-slot ``fold_in(key_slot, pos_slot)`` +
   ``categorical`` on that slot's row, matching ``kv_generate``'s
   batch-1 stream for the same seed token-for-token (greedy is argmax).
 
-Retired slots keep computing (their lanes are masked in the outputs);
-their cache writes land at the stale position and are overwritten on
-the next admission.  That wasted lane is the occupancy cost the
-benchmark measures — the alternative (reshaping the batch) retraces.
+``PagePool`` is the host-side free-list allocator with REFCOUNTS: the
+prefix cache maps one page into many slots' tables, and a page returns
+to the free list only when its last owner (slot or cache index) lets go.
 """
 from __future__ import annotations
 
@@ -44,8 +63,8 @@ from .. import telemetry
 from ..base import MXNetError
 from ..models.decoding import _DecodeEngine, _TRACE_LOCK
 
-__all__ = ["PoolPrograms", "pool_state_init", "pool_state_grow",
-           "pool_state_bytes"]
+__all__ = ["PoolPrograms", "PagePool", "pool_state_init",
+           "pool_state_grow", "pool_state_bytes"]
 
 
 # per-slot scalar state bytes: pos/tok/stop int32 (12) + active bool (1)
@@ -53,29 +72,84 @@ __all__ = ["PoolPrograms", "pool_state_init", "pool_state_grow",
 _SLOT_STATE_BYTES = 25
 
 
-def pool_state_bytes(eng, num_slots=None):
-    """Device bytes of the pool state at ``num_slots`` slots (default:
-    the engine's own slot count) — the K/V cache pair plus the
-    per-slot scalar vectors.  Pure arithmetic from the engine's
-    geometry, so the budget check in ``DecodeServer`` can price a
-    growth (or the initial pool) BEFORE allocating it.  The cache term
-    is ``_DecodeEngine.cache_bytes`` rescaled to ``num_slots`` lanes —
-    ONE formula shared with the compile events' ``cache_bytes`` field,
-    so the budget threshold cannot drift from what is reported."""
-    S = eng.B if num_slots is None else int(num_slots)
-    cache = (eng.cache_bytes() // eng.B) * S
-    return cache + S * _SLOT_STATE_BYTES
+class PagePool:
+    """Host-side page allocator with refcounts (LIFO free list — a just-
+    freed page is the hottest candidate for reuse).  Pages are ints in
+    ``[0, num_pages)``; the COW prefix cache increfs shared pages into
+    many owners, and a page returns to the free list only at refcount
+    zero.  Purely host bookkeeping: the device never sees this object,
+    only the page-table rows built from it."""
+
+    def __init__(self, num_pages):
+        self.num_pages = int(num_pages)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._ref = {}
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def in_use(self):
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n):
+        """``n`` fresh pages at refcount 1, or ``None`` if the pool
+        cannot cover the request (nothing is allocated on failure —
+        admission is all-or-nothing so a half-reserved request can
+        never deadlock the pool)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def incref(self, page):
+        self._ref[page] += 1
+
+    def decref(self, page):
+        """Drop one owner; frees the page at refcount zero."""
+        r = self._ref[page] - 1
+        if r:
+            self._ref[page] = r
+        else:
+            del self._ref[page]
+            self._free.append(page)
+
+    def grow(self, new_num):
+        """Extend the pool with pages ``[num_pages, new_num)`` (pool
+        growth allocates a bigger device array; the new ids join the
+        free list)."""
+        if new_num < self.num_pages:
+            raise MXNetError(f"page pool can only grow: "
+                             f"{self.num_pages} -> {new_num}")
+        self._free.extend(range(new_num - 1, self.num_pages - 1, -1))
+        self.num_pages = int(new_num)
 
 
-def pool_state_init(eng, device=None):
-    """Fresh all-idle pool state for a ``PoolPrograms``' engine:
-    ``(ck, cv, pos, tok, active, stop, keys, deadline)`` — the
-    traced-operand set every step/admit executable threads through.
-    ``deadline`` is the per-slot wall-clock retirement budget (seconds
-    on the server's monotonic epoch; ``+inf`` = none), checked ON
-    DEVICE by the step against its ``now`` operand — deadline expiry
-    is a masked retirement exactly like EOS/budget, never an extra
-    dispatch (ISSUE 13).
+def pool_state_bytes(progs, num_slots=None, num_pages=None):
+    """Device bytes of the pool state at ``num_slots`` slots /
+    ``num_pages`` pages (defaults: the programs' own geometry; the
+    default page count is ``num_slots * MAXP`` — the dense-equivalent
+    allotment, so the figure stays LINEAR in the slot count and the
+    budget thresholds keep their PR-10 meaning).  Pure arithmetic, so
+    ``DecodeServer`` can price a growth (or the initial pool) BEFORE
+    allocating it; ``tests/test_memory.py`` pins this equal to the
+    allocator-reported ``nbytes_of`` of the live state."""
+    S = progs.S if num_slots is None else int(num_slots)
+    npages = S * progs.maxp if num_pages is None else int(num_pages)
+    return npages * progs.page_bytes() + S * _SLOT_STATE_BYTES
+
+
+def pool_state_init(progs, device=None):
+    """Fresh all-idle pool state for ``progs``: ``(kp, vp, pos, tok,
+    active, stop, keys, deadline)`` — the traced-operand set every
+    step/admit/hit/chunk executable threads through (the page TABLES are
+    not in it: they are host numpy, rebuilt per dispatch).  ``deadline``
+    is the per-slot wall-clock retirement budget (seconds on the
+    server's monotonic epoch; ``+inf`` = none), checked ON DEVICE by the
+    step against its ``now`` operand.
 
     Every array is COMMITTED to ``device`` (default: the backend's
     first device).  jit keys its executable cache on each argument's
@@ -83,55 +157,84 @@ def pool_state_init(eng, device=None):
     would compile one signature for the first step and a SECOND
     (identical-aval) signature once the state is jit outputs — a
     silent ~seconds retrace on the serving hot path at steady state."""
-    S = eng.B
+    S = progs.S
+    eng = progs.eng
     if device is None:
         device = jax.devices()[0]
-    ck, cv = eng.zero_caches()
-    state = (ck, cv,
-             jnp.zeros((S,), jnp.int32),          # pos: next write index
-             jnp.zeros((S,), jnp.int32),          # tok: last sampled
-             jnp.zeros((S,), jnp.bool_),          # active
-             jnp.zeros((S,), jnp.int32),          # stop: retire position
-             jnp.zeros((S, 2), jnp.uint32),       # per-slot PRNG keys
+    shape = (eng.NL, progs.num_pages, eng.KV, progs.page, eng.D)
+    state = (jnp.zeros(shape, eng.cdtype),   # K page pool
+             jnp.zeros(shape, eng.cdtype),   # V page pool
+             jnp.zeros((S,), jnp.int32),     # pos: next write index
+             jnp.zeros((S,), jnp.int32),     # tok: last sampled
+             jnp.zeros((S,), jnp.bool_),     # active
+             jnp.zeros((S,), jnp.int32),     # stop: retire position
+             jnp.zeros((S, 2), jnp.uint32),  # per-slot PRNG keys
              jnp.full((S,), jnp.inf, jnp.float32))  # per-slot deadline
     return jax.device_put(state, device)
 
 
-def pool_state_grow(state, new_s):
-    """Pad every slot-axis array of ``state`` up to ``new_s`` slots (the
-    new lanes come up idle).  Runs eagerly — pool growth happens at a
-    step boundary, a handful of times per server lifetime."""
-    ck, cv, pos, tok, active, stop, keys, dl = state
-    grow = new_s - ck.shape[1]
+def pool_state_grow(state, new_s, new_pages=None):
+    """Pad the slot-axis arrays of ``state`` up to ``new_s`` slots and
+    (optionally) the page pools up to ``new_pages`` pages — new lanes
+    come up idle, new pages come up zero (the caller hands their ids to
+    its ``PagePool``).  Runs eagerly — pool growth happens at a step
+    boundary, a handful of times per server lifetime.  NOTE the table
+    sentinel moves with the page count: rows must be rebuilt against
+    the grown pool before the next dispatch (the server regenerates
+    them from its allocator every dispatch, so this is automatic)."""
+    kp, vp, pos, tok, active, stop, keys, dl = state
+    grow = new_s - pos.shape[0]
     if grow <= 0:
-        raise MXNetError(f"pool can only grow: {ck.shape[1]} -> {new_s}")
-    pad = lambda a, axis: jnp.pad(
-        a, [(0, grow) if i == axis else (0, 0) for i in range(a.ndim)])
-    grown = (pad(ck, 1), pad(cv, 1), pad(pos, 0), pad(tok, 0),
-             pad(active, 0), pad(stop, 0), pad(keys, 0),
+        raise MXNetError(f"pool can only grow: {pos.shape[0]} -> "
+                         f"{new_s}")
+    pgrow = 0 if new_pages is None else int(new_pages) - kp.shape[1]
+    if pgrow < 0:
+        raise MXNetError(f"page pool can only grow: {kp.shape[1]} -> "
+                         f"{new_pages}")
+    pad = lambda a, axis, n: jnp.pad(
+        a, [(0, n) if i == axis else (0, 0) for i in range(a.ndim)])
+    grown = (pad(kp, 1, pgrow), pad(vp, 1, pgrow), pad(pos, 0, grow),
+             pad(tok, 0, grow), pad(active, 0, grow), pad(stop, 0, grow),
+             pad(keys, 0, grow),
              # idle-lane deadlines pad as +inf, matching pool_state_init
              jnp.pad(dl, (0, grow), constant_values=jnp.inf))
     # committed placement, same contract as pool_state_init
-    return jax.device_put(grown, list(ck.devices())[0])
+    return jax.device_put(grown, list(kp.devices())[0])
 
 
 class PoolPrograms:
-    """Compiled decode-step + per-bucket admission executables for ONE
-    pool size (slot count) ``num_slots`` against a ``max_total``-column
-    cache.  ``temperature``/``top_k``/``eos_id`` are server-level static
-    config (they shape the compiled sampler); per-request variation
-    rides in the operands (seed key, stop position)."""
+    """Compiled decode-step + admission executables for ONE pool size
+    (slot count) ``num_slots`` against a ``num_pages``-page pool of
+    ``page_size``-token pages (cache horizon ``max_total`` rounded up
+    to whole pages).  ``temperature``/``top_k``/``eos_id`` are
+    server-level static config (they shape the compiled sampler);
+    per-request variation rides in the operands (seed key, stop
+    position, page-table rows)."""
 
     def __init__(self, model, num_slots, max_total, temperature=0.0,
                  top_k=0, eos_id=None, weights="native",
-                 telemetry_label=None):
+                 telemetry_label=None, page_size=16, num_pages=None):
         self.model = model
         self.telemetry_label = telemetry_label
         self.S, self.T = int(num_slots), int(max_total)
+        self.page = int(page_size)
+        if self.page < 1:
+            raise MXNetError(f"page_size must be >= 1, got {self.page}")
+        # cache horizon rounded up to whole pages: the step's attention
+        # span and every table row cover MAXP pages
+        self.Tp = -(-self.T // self.page) * self.page
+        self.maxp = self.Tp // self.page
+        self.num_pages = self.S * self.maxp if num_pages is None \
+            else int(num_pages)
+        if self.num_pages < 1:
+            raise MXNetError(f"num_pages must be >= 1, "
+                             f"got {self.num_pages}")
+        # one-past-the-end page id: gathers fill zero, scatters drop
+        self.sentinel = self.num_pages
         self.temperature, self.top_k = float(temperature), int(top_k)
         self.eos_id = None if eos_id is None else int(eos_id)
         self.weights = weights
-        self.eng = _DecodeEngine(model, self.S, 1, self.T, temperature,
+        self.eng = _DecodeEngine(model, self.S, 1, self.Tp, temperature,
                                  top_k, "batched", weights, "off",
                                  "auto")
         if self.eng.mode != "stacked":
@@ -147,6 +250,19 @@ class PoolPrograms:
         self.operands = (param_vals, q8, sw)
         self._step = None
         self._admits = {}          # (A, P) bucket pair -> jitted fn
+        self._hits = {}            # A bucket -> jitted hit-admission fn
+        self._chunks = {}          # C bucket -> jitted chunk-prefill fn
+
+    def page_bytes(self):
+        """Device bytes of ONE page across all layers, K and V pools
+        together — the pricing unit ``pool_state_bytes`` scales."""
+        e = self.eng
+        return 2 * e.NL * e.KV * self.page * e.D \
+            * jnp.dtype(e.cdtype).itemsize
+
+    def pages_for(self, total_len):
+        """Pages a sequence of ``total_len`` cached positions needs."""
+        return -(-int(total_len) // self.page)
 
     # -- sampling ------------------------------------------------------- #
     def _sample_slots(self, keys, logits, pos):
@@ -181,39 +297,42 @@ class PoolPrograms:
     # -- the decode step ------------------------------------------------ #
     def step_fn(self):
         """The jitted pool step (cached): ``step(param_vals, q8, sw,
-        now, ck, cv, pos, tok, active, stop, keys, deadline)`` → new
+        now, pt, kp, vp, pos, tok, active, stop, keys, deadline)`` → new
         state + ``(emit_tok, emitted, done)`` readback arrays.  ``now``
         is the host's monotonic clock (server-epoch seconds, a float32
-        scalar operand refreshed per dispatch — an operand, not a
-        constant, so it never retraces).  Caches are donated —
-        steady-state serving is one donated-buffer executable dispatch
-        per emitted token wave."""
+        scalar operand refreshed per dispatch); ``pt`` is the ``(S,
+        MAXP)`` int32 page-table block — BOTH are operands, not
+        constants, so neither clock ticks nor page churn ever retrace.
+        Page pools are donated — steady-state serving is one
+        donated-buffer executable dispatch per emitted token wave."""
         if self._step is not None:
             return self._step
         from ..gluon.parameter import params_swapped
 
-        eng = self.eng
+        eng = self
+        deng = self.eng
+        page = self.page
 
-        def step(param_vals, q8, sw, now, ck, cv, pos, tok, active,
+        def step(param_vals, q8, sw, now, pt, kp, vp, pos, tok, active,
                  stop, keys, dl):
-            with _TRACE_LOCK, params_swapped(eng.params, param_vals):
-                logits, ck, cv = eng.pool_token(tok, pos, ck, cv, sw,
-                                                q8)
-                nxt = self._sample_slots(keys, logits, pos)
+            with _TRACE_LOCK, params_swapped(deng.params, param_vals):
+                logits, kp, vp = deng.pool_token_paged(
+                    tok, pos, kp, vp, pt, page, sw, q8)
+                nxt = eng._sample_slots(keys, logits, pos)
             nxt = jnp.where(active, nxt, tok)
             newpos = jnp.where(active, pos + 1, pos)
-            done = self._retire_flags(active, nxt, newpos, stop, now,
-                                      dl)
+            done = eng._retire_flags(active, nxt, newpos, stop, now, dl)
             emitted = active
-            new_state = (ck, cv, newpos, nxt, active & ~done, stop,
+            new_state = (kp, vp, newpos, nxt, active & ~done, stop,
                          keys, dl)
             return new_state, (nxt, emitted, done)
 
         self._step = telemetry.instrument_jit(
-            jax.jit(step, donate_argnums=(4, 5)), "serve.step",
+            jax.jit(step, donate_argnums=(5, 6)), "serve.step",
             key=(self.telemetry_label, self.S),
             fields={"server": self.telemetry_label, "pool": self.S,
-                    "cache_bytes": self.eng.cache_bytes()})
+                    "num_pages": self.num_pages,
+                    "cache_bytes": self.num_pages * self.page_bytes()})
         return self._step
 
     # -- admission ------------------------------------------------------ #
@@ -222,24 +341,22 @@ class PoolPrograms:
         ``a_bucket`` prompts right-padded to ``p_bucket`` tokens (cached
         per ``(A, P)`` bucket pair): ``admit(param_vals, prompts
         (A, P) int32, meta (A, 5) int32 rows = [valid, true_len, slot,
-        stop_pos, seed], dls (A,) float32 per-row deadlines, ck, cv,
-        pos, tok, active, stop, keys, dl)`` → new state +
-        ``(first_tok (A,), done (A,))``.
+        stop_pos, seed], dls (A,) float32 per-row deadlines, pages
+        (A, NPB) int32 reserved-page rows, kp, vp, pos, tok, active,
+        stop, keys, dl)`` → new state + ``(first_tok (A,), done (A,))``.
 
-        ONE causal prefill over the whole block fills every admitted
-        slot's cache columns [0, P) via a masked device-side scatter
-        (row ``i`` lands in pool slot ``meta[i, 2]``; rows with
-        ``valid == 0`` aim at slot index ``S`` — out of bounds — and
-        are DROPPED, so a half-full wave is a no-op on the idle rows
-        and reuses the same compiled program).  The first continuation
-        token of each row is sampled at its own ``true_len - 1``
-        (per-row last index through ``prefill_batch``); a request whose
-        budget is a single token (or whose first token is EOS) comes
-        back ``done`` and never occupies a step lane.  Per-request
-        scalars ride in ONE packed ``(A, 5)`` block and the per-row
-        PRNG keys are derived on device — admitting a wave of k
-        requests is one H2D of the prompt block + meta and ONE
-        executable dispatch, not k of either."""
+        ONE causal prefill over the whole block fills a dense ``(A,
+        Ppad)`` scratch cache, which lands in the wave's RESERVED PAGES
+        via one masked page scatter: row ``i``'s page ``j`` goes to
+        pool page ``pages[i, j]``; idle rows and unreserved tail pages
+        carry the sentinel and are DROPPED, so a half-full wave (or a
+        short prompt) reuses the same compiled program.  The first
+        continuation token of each row is sampled at its own
+        ``true_len - 1``; a request whose budget is a single token (or
+        whose first token is EOS) comes back ``done`` and never
+        occupies a step lane.  Admitting a wave of k requests is one
+        H2D of the prompt block + meta + page rows and ONE executable
+        dispatch, not k of either."""
         key2 = (int(a_bucket), int(p_bucket))
         fn = self._admits.get(key2)
         if fn is not None:
@@ -252,13 +369,17 @@ class PoolPrograms:
             raise MXNetError(f"admission bucket {A} must be >= 1")
         from ..gluon.parameter import params_swapped
 
-        peng = _DecodeEngine(self.model, A, P, self.T,
+        page = self.page
+        ppad = -(-P // page) * page     # prompt bucket in whole pages
+        npb = ppad // page
+        peng = _DecodeEngine(self.model, A, P, ppad,
                              self.temperature, self.top_k, "batched",
                              self.weights, "off", "auto")
         peng.take_operands()    # server-held operands are the only refs
+        NL, KV, D = peng.NL, peng.KV, peng.D
 
-        def admit(param_vals, prompts, meta, dls, ck, cv, pos, tok,
-                  active, stop, keys, dl):
+        def admit(param_vals, prompts, meta, dls, pages, kp, vp, pos,
+                  tok, active, stop, keys, dl):
             valid = meta[:, 0] != 0
             true_len, slot, stop_pos, seed = (meta[:, 1], meta[:, 2],
                                               meta[:, 3], meta[:, 4])
@@ -272,29 +393,167 @@ class PoolPrograms:
             done = stop_pos <= true_len
             if self.eos_id is not None:
                 done = done | (first == self.eos_id)
-            # masked scatter: invalid rows target slot S (out of
-            # bounds) and drop; valid rows carry distinct host-assigned
-            # slots, so the whole wave lands in one scatter per array
+            # page scatter: the dense (A, Ppad) scratch splits into A*NPB
+            # page-shaped rows that land at their reserved pool pages in
+            # one masked scatter per array (sentinel rows DROP)
+            tgt_pg = pages.reshape(A * npb)
+            c1 = ck1.reshape(NL, A, KV, npb, page, D) \
+                    .transpose(0, 1, 3, 2, 4, 5) \
+                    .reshape(NL, A * npb, KV, page, D)
+            v1 = cv1.reshape(NL, A, KV, npb, page, D) \
+                    .transpose(0, 1, 3, 2, 4, 5) \
+                    .reshape(NL, A * npb, KV, page, D)
+            kp = kp.at[:, tgt_pg].set(c1, mode="drop")
+            vp = vp.at[:, tgt_pg].set(v1, mode="drop")
+            # masked slot-state scatter: invalid rows target slot S
+            # (out of bounds) and drop; valid rows carry distinct
+            # host-assigned slots
             tgt = jnp.where(valid, slot, self.S)
-            ck = ck.at[:, tgt].set(ck1, mode="drop")
-            cv = cv.at[:, tgt].set(cv1, mode="drop")
             pos = pos.at[tgt].set(true_len, mode="drop")
             tok = tok.at[tgt].set(first, mode="drop")
             active = active.at[tgt].set(~done, mode="drop")
             stop = stop.at[tgt].set(stop_pos, mode="drop")
             keys = keys.at[tgt].set(keys_a, mode="drop")
             dl = dl.at[tgt].set(dls, mode="drop")
-            new_state = (ck, cv, pos, tok, active, stop, keys, dl)
+            new_state = (kp, vp, pos, tok, active, stop, keys, dl)
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(admit, donate_argnums=(4, 5)), "serve.admit",
+            jax.jit(admit, donate_argnums=(5, 6)), "serve.admit",
             key=(self.telemetry_label, self.S, A, P),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "a_bucket": A, "p_bucket": P,
                     # the A-lane prefill cache pair — the admit
                     # program's transient scratch the budget check
-                    # prices (pool_state_bytes(eng, A))
+                    # prices (pool_state_bytes(progs, A))
                     "cache_bytes": peng.cache_bytes()})
         self._admits[key2] = fn
+        return fn
+
+    def admit_hit_fn(self, a_bucket):
+        """The jitted PREFIX-CACHE-HIT admission program for up to
+        ``a_bucket`` rows (cached per bucket): ``hit(meta (A, 6) int32
+        rows = [valid, true_len, slot, stop_pos, seed, last_tok], dls
+        (A,), src (A,), dst (A,), kp, vp, pos, tok, active, stop, keys,
+        dl)`` → new state (no readback: a hit emits nothing at
+        admission).
+
+        NO model forward runs: the host has already mapped the shared
+        prefix pages into the slot's table row, so admission is a
+        masked slot-state scatter — the slot enters at ``pos = L - 1``
+        with ``tok`` = the last prompt token, and the next regular STEP
+        recomputes that position (writing its K/V through the table and
+        sampling with ``fold_in(key, L - 1)``, the exact admission key
+        of the batched path — hit and miss token streams match while a
+        hit's TTFT is one decode step and ZERO prefill dispatches).
+        ``src``/``dst`` carry at most one copy-on-write page copy per
+        row (needed only when the prompt ends exactly on a shared page
+        boundary, where the recompute-write would land in a shared
+        page); rows without a copy carry the sentinel on both sides
+        (gather fills zeros, scatter drops)."""
+        A = int(a_bucket)
+        fn = self._hits.get(A)
+        if fn is not None:
+            return fn
+        if A < 1:
+            raise MXNetError(f"admission bucket {A} must be >= 1")
+
+        def hit(meta, dls, src, dst, kp, vp, pos, tok, active, stop,
+                keys, dl):
+            valid = meta[:, 0] != 0
+            true_len, slot, stop_pos, seed, last_tok = (
+                meta[:, 1], meta[:, 2], meta[:, 3], meta[:, 4],
+                meta[:, 5])
+            keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
+            # copy-on-write boundary pages: one gather + one masked
+            # scatter covers the whole wave's copies
+            kblk = kp.at[:, src].get(mode="fill", fill_value=0)
+            vblk = vp.at[:, src].get(mode="fill", fill_value=0)
+            kp = kp.at[:, dst].set(kblk, mode="drop")
+            vp = vp.at[:, dst].set(vblk, mode="drop")
+            tgt = jnp.where(valid, slot, self.S)
+            pos = pos.at[tgt].set(true_len - 1, mode="drop")
+            tok = tok.at[tgt].set(last_tok, mode="drop")
+            active = active.at[tgt].set(valid, mode="drop")
+            stop = stop.at[tgt].set(stop_pos, mode="drop")
+            keys = keys.at[tgt].set(keys_a, mode="drop")
+            dl = dl.at[tgt].set(dls, mode="drop")
+            return (kp, vp, pos, tok, active, stop, keys, dl)
+
+        fn = telemetry.instrument_jit(
+            jax.jit(hit, donate_argnums=(4, 5)), "serve.admit_hit",
+            key=(self.telemetry_label, self.S, A),
+            fields={"server": self.telemetry_label, "pool": self.S,
+                    "a_bucket": A})
+        self._hits[A] = fn
+        return fn
+
+    def chunk_fn(self, c_bucket):
+        """The jitted CHUNKED-PREFILL program for one ``C``-token slice
+        of a single prompt (cached per chunk bucket): ``chunk(
+        param_vals, q8, sw, toks (C,) int32, meta (7,) int32 =
+        [final, slot, true_len, stop_pos, seed, nlast, off], dls
+        scalar f32, ptrow (MAXP,) int32, kp, vp, pos, tok, active,
+        stop, keys, dl)`` → new state + ``(first_tok, done)`` scalars.
+
+        The slice occupies absolute positions ``off .. off+C-1`` of the
+        slot whose page-table row is ``ptrow`` (``off`` is TRACED — one
+        compiled program per chunk length serves every landing offset,
+        so a prompt of any length streams in over ``ceil(L/C)``
+        dispatches with no retrace).  Intermediate chunks pass
+        ``final = 0``: their state scatter targets slot ``S`` and
+        DROPS, so the slot stays invisible to the step until the final
+        chunk samples the first continuation token (at ``true_len - 1``
+        with ``fold_in(PRNGKey(seed), true_len - 1)`` — the batched
+        path's exact admission key) and activates it.  Also the
+        prefix-cache PARTIAL-hit suffix path: with shared pages mapped
+        for ``off`` tokens, the same program fills only the divergent
+        tail."""
+        C = int(c_bucket)
+        fn = self._chunks.get(C)
+        if fn is not None:
+            return fn
+        if not 0 < C <= self.Tp:
+            raise MXNetError(f"chunk bucket {C} outside cache "
+                             f"length {self.Tp}")
+        from ..gluon.parameter import params_swapped
+
+        deng = self.eng
+        page = self.page
+
+        def chunk(param_vals, q8, sw, toks, meta, dls, ptrow, kp, vp,
+                  pos, tok, active, stop, keys, dl):
+            final, slot, true_len, stop_pos, seed, nlast, off = (
+                meta[0], meta[1], meta[2], meta[3], meta[4], meta[5],
+                meta[6])
+            key1 = jax.random.PRNGKey(seed)                   # (2,)
+            with _TRACE_LOCK, params_swapped(deng.params, param_vals):
+                logits, kp, vp = deng.chunk_tokens(
+                    toks, off, nlast, ptrow, page, kp, vp, sw, q8)
+                first = self._sample_slots(key1[None], logits,
+                                           (true_len - 1)[None])[0]
+            done = stop_pos <= true_len
+            if self.eos_id is not None:
+                done = done | (first == self.eos_id)
+            # scalar masked scatter: intermediate chunks target slot S
+            # and drop — only the final chunk activates the slot
+            tgt = jnp.where(final != 0, slot, self.S)
+            pos = pos.at[tgt].set(true_len, mode="drop")
+            tok = tok.at[tgt].set(first, mode="drop")
+            active = active.at[tgt].set((final != 0) & ~done,
+                                        mode="drop")
+            stop = stop.at[tgt].set(stop_pos, mode="drop")
+            keys = keys.at[tgt].set(key1, mode="drop")
+            dl = dl.at[tgt].set(dls, mode="drop")
+            new_state = (kp, vp, pos, tok, active, stop, keys, dl)
+            return new_state, (first, done)
+
+        fn = telemetry.instrument_jit(
+            jax.jit(chunk, donate_argnums=(7, 8)), "serve.chunk",
+            key=(self.telemetry_label, self.S, C),
+            fields={"server": self.telemetry_label, "pool": self.S,
+                    "c_bucket": C,
+                    # one slot's dense gather scratch per layer slice
+                    "cache_bytes": self.eng.cache_bytes() // self.S})
+        self._chunks[C] = fn
         return fn

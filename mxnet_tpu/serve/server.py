@@ -50,6 +50,16 @@ allocation raises a clean ``MXNetError`` naming requested vs available
 bytes instead of an allocator OOM.  ``stats()`` reports
 ``pool_bytes`` next to occupancy.
 
+Paged KV (ISSUE 16): the resident pool is PAGED — each sequence holds
+only the fixed-size pages (``MXNET_SERVE_PAGE_SIZE`` tokens each) its
+cached positions occupy, mapped through per-slot page tables passed as
+traced operands (allocation churn never retraces).  Identical prompt
+prefixes SHARE pages copy-on-write (``MXNET_SERVE_PREFIX_CACHE``): a
+full prefix hit admits with ZERO prefill dispatches and a TTFT of one
+decode step.  Prompts past the largest pinned prefill bucket stream in
+over several CHUNKED-PREFILL dispatches instead of being rejected —
+the only hard length limit is the pool cache length (docs/SERVING.md).
+
 Fault tolerance (ISSUE 13): ``submit(deadline=)`` /
 ``MXNET_SERVE_DEADLINE`` give every request a wall-clock budget the
 STEP EXECUTABLE enforces (a per-slot deadline rides the slot-state
@@ -90,7 +100,9 @@ __all__ = ["DecodeServer", "TokenStream", "serve_counters",
 # ``_counters_lock``, so a reset racing a live scheduler thread's
 # increments can't lose counts (read-modify-write vs. reassign).
 serve_counters = {"step_dispatches": 0, "admit_dispatches": 0,
-                  "sync_requests": 0, "pool_grows": 0}
+                  "sync_requests": 0, "pool_grows": 0,
+                  "prefix_hits": 0, "cow_copies": 0,
+                  "chunk_dispatches": 0}
 _counters_lock = threading.Lock()
 _server_seq = itertools.count()
 
@@ -115,7 +127,8 @@ class _CounterView(MutableMapping):
     backing counter; iteration order is the historical key order."""
 
     _KEYS = ("step_dispatches", "admit_dispatches", "sync_requests",
-             "pool_grows")
+             "pool_grows", "prefix_hits", "cow_copies",
+             "chunk_dispatches")
 
     def __init__(self, server_label):
         self._c = {k: telemetry.counter(f"serve_{k}_total",
@@ -171,6 +184,27 @@ def _hbm_budget_from_env():
     if raw is None:
         return None
     return parse_bytes(raw, "MXNET_SERVE_HBM_BUDGET")
+
+
+def _page_size_from_env():
+    """``MXNET_SERVE_PAGE_SIZE``: tokens per KV page (the paged-pool
+    allocation granule); default 16."""
+    raw = os.environ.get("MXNET_SERVE_PAGE_SIZE", "16")
+    try:
+        page = int(raw)
+    except ValueError:
+        raise MXNetError(f"MXNET_SERVE_PAGE_SIZE={raw!r}: expected a "
+                         "positive integer token count")
+    if page < 1:
+        raise MXNetError(f"MXNET_SERVE_PAGE_SIZE={raw!r}: page size "
+                         "must be >= 1 tokens")
+    return page
+
+
+def _prefix_cache_from_env():
+    """``MXNET_SERVE_PREFIX_CACHE``: 0 disables copy-on-write shared-
+    prefix caching (default on)."""
+    return os.environ.get("MXNET_SERVE_PREFIX_CACHE", "1") != "0"
 
 
 def _parse_seconds(var, raw):
@@ -247,6 +281,102 @@ def _bucket_for(ladder, n):
         if b >= n:
             return b
     raise MXNetError(f"{n} exceeds the largest bucket {ladder[-1]}")
+
+
+class _PrefixIndex:
+    """Host-side copy-on-write shared-prefix page cache: a chained trie
+    over FULL pages of prompt tokens, each node mapping one
+    ``(parent, page-of-token-bytes)`` chunk to the pool page holding
+    its K/V.  ``register`` pins a producer's prompt pages with one
+    index-owned refcount each (so they outlive the producer's
+    retirement); ``match`` walks the longest cached chain for a new
+    prompt, and the admission path maps those pages READ-ONLY into the
+    consumer's table row — zero prefill dispatches on a full hit.
+    ``evict`` drops least-recently-touched LEAF nodes when the
+    allocator runs dry, so the cache is exactly the pages nothing else
+    wants yet.  Scheduler-thread-only, like the ``PagePool`` under
+    it."""
+
+    def __init__(self, page_size, pool):
+        self.page = int(page_size)
+        self.pool = pool
+        self._nodes = {}    # (parent_id, chunk_bytes) -> node dict
+        self._by_id = {}    # node id -> node (parent chains, eviction)
+        self._ids = itertools.count(1)
+        self._tick = itertools.count(1)
+
+    def __len__(self):
+        return len(self._nodes)
+
+    def _chunk_key(self, prompt, parent, c):
+        return (parent,
+                prompt[c * self.page:(c + 1) * self.page].tobytes())
+
+    def match(self, prompt):
+        """Longest chain of cached FULL pages covering a prefix of
+        ``prompt``: ``(num_matched_pages, [pool page ids])``."""
+        pages, parent = [], 0
+        for c in range(prompt.size // self.page):
+            node = self._nodes.get(self._chunk_key(prompt, parent, c))
+            if node is None:
+                break
+            node["last"] = next(self._tick)
+            pages.append(node["page"])
+            parent = node["id"]
+        return len(pages), pages
+
+    def register(self, prompt, length, slot_pages):
+        """Index ``prompt[:length]``'s full pages, backed by the
+        producer slot's ``slot_pages`` row.  Only NEWLY created nodes
+        incref their page (existing nodes already own theirs); pages
+        past the last FULL page are never indexed — their K/V columns
+        get overwritten by the producer's own decode steps."""
+        parent = 0
+        for c in range(min(length // self.page, len(slot_pages))):
+            key = self._chunk_key(prompt, parent, c)
+            node = self._nodes.get(key)
+            if node is None:
+                node = {"id": next(self._ids), "key": key,
+                        "page": slot_pages[c], "parent": parent,
+                        "children": 0, "last": next(self._tick)}
+                self._nodes[key] = node
+                self._by_id[node["id"]] = node
+                if parent:
+                    self._by_id[parent]["children"] += 1
+                self.pool.incref(node["page"])
+            else:
+                node["last"] = next(self._tick)
+            parent = node["id"]
+
+    def evict(self, need, protect=()):
+        """Drop LRU leaf nodes (never pages in ``protect``) until
+        ``need`` pool pages have actually come free — a decref only
+        frees a page once no slot still maps it.  Returns pages
+        freed."""
+        protect = set(protect)
+        before = self.pool.free_pages
+        while self.pool.free_pages - before < need:
+            leaves = [nd for nd in self._nodes.values()
+                      if nd["children"] == 0
+                      and nd["page"] not in protect]
+            if not leaves:
+                break
+            self._drop(min(leaves, key=lambda nd: nd["last"]))
+        return self.pool.free_pages - before
+
+    def _drop(self, node):
+        del self._nodes[node["key"]]
+        del self._by_id[node["id"]]
+        if node["parent"]:
+            self._by_id[node["parent"]]["children"] -= 1
+        self.pool.decref(node["page"])
+
+    def drop_all(self):
+        """Release every index-owned page ref (server teardown)."""
+        for node in self._by_id.values():
+            self.pool.decref(node["page"])
+        self._nodes.clear()
+        self._by_id.clear()
 
 
 class TokenStream:
@@ -422,9 +552,10 @@ class DecodeServer:
                  weights="native", max_pending=256, detokenize=None,
                  admit_sizes=None, prefill_buckets=None,
                  hbm_budget=None, default_deadline=None,
-                 step_timeout=None, autostart=True):
+                 step_timeout=None, page_size=None, num_pages=None,
+                 prefix_cache=None, autostart=True):
         from ..telemetry.memory import parse_bytes
-        from .engine import PoolPrograms, pool_state_init
+        from .engine import PagePool, PoolPrograms, pool_state_init
 
         self.model = model
         # fault-tolerance knobs (ISSUE 13): the server's monotonic
@@ -498,6 +629,18 @@ class DecodeServer:
         # allocator OOM mid-dispatch; None = unlimited.
         self.hbm_budget = parse_bytes(hbm_budget, "hbm_budget") \
             if hbm_budget is not None else _hbm_budget_from_env()
+        # paged-KV knobs: page granule, total page count (None = the
+        # dense-equivalent S * MAXP allotment, rescaled on pool
+        # growth; an explicit count is pinned for the server's life)
+        # and the COW shared-prefix cache switch
+        self.page_size = int(page_size) if page_size is not None \
+            else _page_size_from_env()
+        if self.page_size < 1:
+            raise MXNetError(f"page_size must be >= 1, "
+                             f"got {self.page_size}")
+        self._num_pages_fixed = num_pages is not None
+        self.prefix_cache_enabled = bool(prefix_cache) \
+            if prefix_cache is not None else _prefix_cache_from_env()
         # per-server telemetry identity: labels this server's registry
         # counters/histograms and its compile / serve_* events
         self.telemetry_label = f"srv{next(_server_seq)}"
@@ -510,6 +653,8 @@ class DecodeServer:
                                         server=self.telemetry_label),
             "occ": telemetry.gauge("serve_occupancy",
                                    server=self.telemetry_label),
+            "pages": telemetry.gauge("serve_pages_in_use",
+                                     server=self.telemetry_label),
         }
 
         self.sync_mode = os.environ.get("MXNET_SERVE_SYNC", "0") == "1"
@@ -522,7 +667,8 @@ class DecodeServer:
                 self._progs = PoolPrograms(
                     model, self.pool_sizes[0], self.T, temperature,
                     top_k, eos_id, weights,
-                    telemetry_label=self.telemetry_label)
+                    telemetry_label=self.telemetry_label,
+                    page_size=self.page_size, num_pages=num_pages)
             except MXNetError as e:
                 # models the slot-pool gate rejects still serve, one
                 # request at a time, through the kv_generate fallback
@@ -553,15 +699,28 @@ class DecodeServer:
 
             self._check_budget(
                 self.pool_sizes[0],
-                scratch=pool_state_bytes(self._progs.eng,
+                scratch=pool_state_bytes(self._progs,
                                          self.admit_sizes[0]),
                 what=f"initial pool ({self.pool_sizes[0]} slots) plus "
                      f"the smallest admission wave's "
                      f"(A={self.admit_sizes[0]}) prefill scratch")
         self._state = None if self.sync_mode \
-            else pool_state_init(self._progs.eng)
+            else pool_state_init(self._progs)
         if self._state is not None:
             self._account_pool()
+        # host-side page bookkeeping (scheduler-thread-only, like the
+        # slot table): the free-list allocator, per-slot page-table
+        # rows, the set of slots mid-chunked-prefill (their reserved
+        # pages are masked OUT of the step's table until the final
+        # chunk activates them), and the COW prefix index
+        self._pages = None if self.sync_mode \
+            else PagePool(self._progs.num_pages)
+        self._slot_pages = [[] for _ in range(self.pool_sizes[0])]
+        self._chunk_slots = set()
+        self._chunking = deque()   # {"req", "slot", "off"} records
+        self._prefix = _PrefixIndex(self._progs.page, self._pages) \
+            if not self.sync_mode and self.prefix_cache_enabled \
+            else None
 
         # scheduler bookkeeping (single scheduler thread; submit() is
         # the only cross-thread writer and it only touches _pending)
@@ -591,7 +750,11 @@ class DecodeServer:
             sync_reason=self.sync_reason,
             hbm_budget=self.hbm_budget, pool_bytes=self._pool_bytes,
             default_deadline=self.default_deadline,
-            step_timeout=self.step_timeout)
+            step_timeout=self.step_timeout,
+            page_size=self.page_size,
+            num_pages=None if self.sync_mode
+            else self._progs.num_pages,
+            prefix_cache=self.prefix_cache_enabled)
         if autostart:
             self.start()
 
@@ -653,21 +816,29 @@ class DecodeServer:
             raise MXNetError("empty prompt")
         if max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
-        if not self.sync_mode \
-                and prompt.size > self.prefill_buckets[-1]:
-            # fail HERE, naming the limit — not later inside the admit
-            # trace as a shape error on the scheduler thread
-            raise MXNetError(
-                f"prompt length {prompt.size} exceeds the largest "
-                f"prefill bucket {self.prefill_buckets[-1]} (pool "
-                f"cache length {self.T}) — widen "
-                "MXNET_SERVE_PREFILL_BUCKETS / prefill_buckets=, or "
-                "raise max_total_len")
+        # prompts past the largest pinned prefill bucket are NOT
+        # rejected: chunked prefill streams them in over several
+        # dispatches — the only hard limit is the pool cache length
         if prompt.size + max_new_tokens > self.T:
             raise MXNetError(
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the pool cache length "
                 f"{self.T}")
+        if not self.sync_mode:
+            # a request that can NEVER be paged in (more pages than
+            # the pool will ever hold, reachable only with an explicit
+            # small num_pages=) is a caller error here, not an
+            # admission loop that spins forever
+            need = self._progs.pages_for(prompt.size + max_new_tokens)
+            cap = self._pages.num_pages if self._num_pages_fixed \
+                else self.pool_sizes[-1] * self._progs.maxp
+            if need > cap:
+                raise MXNetError(
+                    f"request needs {need} KV pages "
+                    f"({prompt.size} prompt + {max_new_tokens} new "
+                    f"tokens at page_size={self._progs.page}) but the "
+                    f"page pool holds at most {cap} — raise "
+                    "num_pages= or lower max_new_tokens")
         seed = int(seed)
         if not -2 ** 31 <= seed < 2 ** 31:
             # the slot pool carries the seed as a traced int32 operand;
@@ -750,6 +921,14 @@ class DecodeServer:
             # to an in-flight dispatch on the scheduler thread
             "pool_bytes": self._pool_bytes,
             "hbm_budget": self.hbm_budget,
+            # page-pool occupancy (0/None in sync mode: no pool)
+            "page_size": None if self.sync_mode else self._progs.page,
+            "pages_total": 0 if self._pages is None
+            else self._pages.num_pages,
+            "pages_in_use": 0 if self._pages is None
+            else self._pages.in_use,
+            "prefix_nodes": 0 if self._prefix is None
+            else len(self._prefix),
             "counters": dict(self.counters),
             "ttft": self._tele["ttft"].summary(),
             "token_gap": self._tele["gap"].summary(),
@@ -833,7 +1012,10 @@ class DecodeServer:
             return self._pump_sync() or worked
         worked |= self._admit_pending()
         stepped = False
-        if any(r is not None for r in self._slots):
+        # slots mid-chunked-prefill don't step (their lanes activate at
+        # the final chunk); only genuinely live lanes justify a dispatch
+        if any(r is not None and i not in self._chunk_slots
+               for i, r in enumerate(self._slots)):
             self._dispatch_step()
             worked = stepped = True
         # drain PREVIOUS dispatches' readbacks: while stepping, the
@@ -977,7 +1159,9 @@ class DecodeServer:
                 self._slots[i] = None
             if hit:
                 self._work.notify_all()
-        for _i, r in hit:
+        for i, r in hit:
+            self._drop_chunk_record(i)
+            self._free_slot_pages(i)
             self._retire_aside(r, "cancelled")
         # queued cancellations normally drop in _cancel; this sweeps
         # any that raced the pending-pop
@@ -1018,6 +1202,17 @@ class DecodeServer:
         self._state = None
         ACCOUNTANT.drop("serve.kv_pool", self.telemetry_label)
         self._pool_bytes = 0
+        # page bookkeeping dies with the pool buffers (idempotent):
+        # slot rows, chunk records and the prefix index all release
+        # their refs so a closed server reports pages_in_use == 0
+        if self._pages is not None:
+            self._chunking.clear()
+            self._chunk_slots.clear()
+            for i in range(len(self._slot_pages)):
+                self._free_slot_pages(i)
+            if self._prefix is not None:
+                self._prefix.drop_all()
+            self._tele["pages"].set(0)
         with self._lock:
             dropped = list(self._pending)
             self._pending.clear()
@@ -1043,18 +1238,21 @@ class DecodeServer:
         ACCOUNTANT.set("serve.kv_pool", self.telemetry_label,
                        self._state)
 
-    def _check_budget(self, num_slots, scratch=0, what=""):
+    def _check_budget(self, num_slots, scratch=0, what="",
+                      num_pages=None):
         """Refuse device allocations the HBM budget cannot hold, with a
         clean error naming requested vs available bytes (instead of an
         allocator OOM mid-dispatch).  ``num_slots`` prices the resident
-        pool at that size; ``scratch`` adds transient bytes (admission
-        prefill caches) on top of it."""
+        pool at that size (``num_pages`` overrides the dense-equivalent
+        default page count); ``scratch`` adds transient bytes
+        (admission prefill caches) on top of it."""
         if self.hbm_budget is None:
             return
         from ..telemetry.memory import format_bytes
         from .engine import pool_state_bytes
 
-        projected = pool_state_bytes(self._progs.eng, num_slots) \
+        projected = pool_state_bytes(self._progs, num_slots,
+                                     num_pages=num_pages) \
             + scratch
         if projected <= self.hbm_budget:
             return
@@ -1107,8 +1305,13 @@ class DecodeServer:
         # operator must see and fix (pin smaller pool sizes, or raise
         # the budget — tools/memory_report.py prices configs offline),
         # not a condition to silently serve degraded through
+        # an explicitly pinned page count stays pinned across growth;
+        # the dense-equivalent default rescales with the slot count
+        new_pages = self._pages.num_pages if self._num_pages_fixed \
+            else new_s * self._progs.maxp
         self._check_budget(new_s, scratch=self._pool_bytes,
-                           what=f"pool growth {S} -> {new_s} slots")
+                           what=f"pool growth {S} -> {new_s} slots",
+                           num_pages=new_pages)
         # growth compiles (eager state pad now, fresh step/admit
         # programs at their first dispatch): suspend the watchdog's
         # wedge gauge for the rest of this pump — a retrace is slow,
@@ -1117,29 +1320,39 @@ class DecodeServer:
         progs = PoolPrograms(self.model, new_s, self.T,
                              self.temperature, self.top_k, self.eos_id,
                              self.weights,
-                             telemetry_label=self.telemetry_label)
-        # the old pool's in-flight readbacks refer to old slot indices;
-        # they stay valid — slots only ever grow
+                             telemetry_label=self.telemetry_label,
+                             page_size=self.page_size,
+                             num_pages=new_pages)
+        # the old pool's in-flight readbacks refer to old slot indices
+        # and page ids; they stay valid — slots and pages only ever grow
         self._progs = progs
-        self._state = pool_state_grow(self._state, new_s)
+        self._state = pool_state_grow(self._state, new_s,
+                                      new_pages=new_pages)
         self._account_pool()
+        if new_pages > self._pages.num_pages:
+            self._pages.grow(new_pages)
         with self._lock:
             self._slots.extend([None] * (new_s - S))
+        self._slot_pages.extend([] for _ in range(new_s - S))
         self._count("pool_grows")
 
     def _admit_pending(self):
         """Wave-building batched admission: gather ALL currently
         pending requests the free slots can take (capped at the
-        largest pinned ``A`` bucket) and admit each wave with ONE
-        bucketed ``(A, P)`` dispatch — a burst of k arrivals at a step
-        boundary costs 1 admit dispatch, not k.  The outer loop spills
-        a backlog larger than the biggest ``A`` bucket (or than the
-        free slots) into follow-up dispatches in the same pump."""
+        largest pinned ``A`` bucket), PLAN each one against the page
+        pool / prefix cache, and dispatch each mode in bulk — prefill
+        admissions as ONE bucketed ``(A, P)`` dispatch, prefix-cache
+        hits as ONE no-forward hit dispatch, long prompts as chunked
+        prefill records the pump streams in.  A burst of k arrivals at
+        a step boundary costs 1-2 dispatches, not k.  The outer loop
+        spills a backlog larger than the biggest ``A`` bucket (or than
+        the free slots) into follow-up dispatches in the same pump."""
         admitted = may_retire = False
         self._maybe_grow()
         cap = self.admit_sizes[-1]
         while True:
-            free = [i for i, r in enumerate(self._slots) if r is None]
+            free = [i for i, r in enumerate(self._slots)
+                    if r is None and i not in self._chunk_slots]
             if not free:
                 break
             limit = min(len(free), cap)
@@ -1162,16 +1375,19 @@ class DecodeServer:
                     limit = min(limit, len(self._pending))
                 if not limit:
                     break
-                eng = self._progs.eng
+                progs = self._progs
+                resident = pool_state_bytes(
+                    progs, len(self._slots),
+                    num_pages=self._pages.num_pages)
                 usable = [a for a in self.admit_sizes
-                          if pool_state_bytes(eng, len(self._slots))
-                          + pool_state_bytes(eng, a)
+                          if resident + pool_state_bytes(progs, a)
                           <= self.hbm_budget]
                 if not usable:
                     A = self.admit_sizes[0]
                     self._check_budget(
                         len(self._slots),
-                        scratch=pool_state_bytes(eng, A),
+                        scratch=pool_state_bytes(progs, A),
+                        num_pages=self._pages.num_pages,
                         what=f"admission wave of {limit} "
                              f"(A={A} prefill scratch)")
                 limit = min(limit, usable[-1])
@@ -1204,10 +1420,48 @@ class DecodeServer:
                 if dropped:
                     continue   # the backlog behind the drops may fit
                 break
-            self._dispatch_admit(wave)
-            admitted = True
-            may_retire |= any(r.max_new == 1 for _, r in wave)
-        if may_retire:
+            # reserve pages + classify each popped request (prefill
+            # admit / prefix-cache hit / chunked prefill).  A pool that
+            # can't cover a request right now unwinds IT and everything
+            # behind it back to the queue front, in order — retiring
+            # slots free pages and the next pump retries.
+            plans, failed = [], None
+            for k, (slot, req) in enumerate(wave):
+                plan = self._plan_admission(req, slot)
+                if plan is None:
+                    failed = wave[k:]
+                    break
+                plans.append(plan)
+            if failed is not None:
+                with self._lock:
+                    for slot, _req in failed:
+                        self._slots[slot] = None
+                    for _slot, req in reversed(failed):
+                        self._pending.appendleft(req)
+            admit_wave = [(p["slot"], p["req"]) for p in plans
+                          if p["mode"] == "admit"]
+            hit_wave = [p for p in plans if p["mode"] == "hit"]
+            for p in plans:
+                if p["mode"] == "chunk":
+                    self._chunk_slots.add(p["slot"])
+                    self._chunking.append(
+                        {"req": p["req"], "slot": p["slot"],
+                         "off": p["off"]})
+            # hits dispatch FIRST: a COW source page another plan's
+            # eviction freed and re-allocated this wave must be copied
+            # before any admit/chunk dispatch can overwrite it (the
+            # device stream is FIFO)
+            if hit_wave:
+                self._dispatch_hits(hit_wave)
+            if admit_wave:
+                self._dispatch_admit(admit_wave)
+                may_retire |= any(r.max_new == 1
+                                  for _, r in admit_wave)
+            admitted |= bool(plans)
+            if failed is not None:
+                break
+        chunked, chunk_retire = self._pump_chunks()
+        if may_retire or chunk_retire:
             # a 1-token budget retires INSIDE the admission executable;
             # read the (first_tok, done) flags back now so its slot
             # frees before the step-dispatch decision — no wasted
@@ -1215,14 +1469,16 @@ class DecodeServer:
             # step readbacks, off the hot path (an EOS on the very
             # first token costs at most one masked-lane step).
             self._drain_admits()
-        return admitted
+        return admitted or chunked
 
     def _dispatch_admit(self, wave):
         """ONE bucketed (A, P) admission dispatch for a wave of
         ``(slot, request)`` pairs: A = smallest pinned wave bucket that
         fits the wave, P = smallest pinned prompt bucket that fits the
-        wave's longest prompt (submit() already guaranteed the fit).
-        Rows beyond the wave are masked no-ops on device."""
+        wave's longest prompt (the admission planner routes longer
+        prompts to chunked prefill, so one always exists).  Rows beyond
+        the wave are masked no-ops on device; the prefill stream lands
+        in the wave's reserved pages via the page-row operand."""
         fault_point("serve.admit", server=self.telemetry_label,
                     wave=len(wave))
         A = _bucket_for(self.admit_sizes, len(wave))
@@ -1243,12 +1499,19 @@ class DecodeServer:
         # none), scattered into the slot-state deadline vector the
         # step checks device-side
         dls = onp.full((A,), onp.inf, onp.float32)
+        # reserved-page rows: idle rows and tail pages past a row's
+        # reservation carry the sentinel, so their scatter drops
+        npb = -(-P // self._progs.page)
+        pages = onp.full((A, npb), self._progs.num_pages, onp.int32)
         for i, (slot, req) in enumerate(wave):
             n = req.prompt.size
             prompts[i, :n] = req.prompt
             meta[i] = (1, n, slot, n + req.max_new - 1, req.seed)
             if req.deadline is not None:
                 dls[i] = req.deadline - self._epoch
+            row = self._slot_pages[slot]
+            k = min(npb, len(row))
+            pages[i, :k] = row[:k]
         # request-span admission fields + one serve_admit event per
         # dispatch (waves are step-boundary-rare, not per-token)
         now = time.perf_counter()
@@ -1267,7 +1530,7 @@ class DecodeServer:
         param_vals, q8, sw = self._progs.operands
         with telemetry.annotation("mx:serve:admit"):
             new_state, (first, done) = fn(param_vals, prompts, meta,
-                                          dls, *self._state)
+                                          dls, pages, *self._state)
         self._state = new_state
         if self._torn:
             # the watchdog tore the server down while this dispatch was
@@ -1277,6 +1540,256 @@ class DecodeServer:
             return
         self._count("admit_dispatches")
         self._inflight.append(("admit", (first, done), list(wave)))
+        if self._prefix is not None:
+            # index the wave's FULL prompt pages for future COW hits
+            # (device-written by the dispatch just queued; any
+            # consumer's read is a later dispatch on the same stream)
+            for slot, req in wave:
+                self._prefix.register(req.prompt, req.prompt.size,
+                                      self._slot_pages[slot])
+
+    # paged admission planning ------------------------------------------- #
+    def _alloc_pages(self, n, protect=()):
+        """All-or-nothing page reservation, evicting LRU prefix-cache
+        entries (never ``protect``) when the free list runs dry."""
+        got = self._pages.alloc(n)
+        if got is None and self._prefix is not None:
+            self._prefix.evict(n - self._pages.free_pages,
+                               protect=protect)
+            got = self._pages.alloc(n)
+        return got
+
+    def _free_slot_pages(self, slot):
+        """Release one slot's page-table refs (idempotent: the row is
+        cleared first).  Shared pages survive while the prefix index
+        or another slot still holds them — that's the refcount."""
+        row = self._slot_pages[slot]
+        self._slot_pages[slot] = []
+        for p in row:
+            self._pages.decref(p)
+
+    def _drop_chunk_record(self, slot):
+        """Forget a mid-chunked-prefill slot (cancel/teardown paths)."""
+        if slot in self._chunk_slots:
+            self._chunk_slots.discard(slot)
+            for rec in list(self._chunking):
+                if rec["slot"] == slot:
+                    self._chunking.remove(rec)
+
+    def _plan_admission(self, req, slot):
+        """Decide how one popped request enters its slot, reserving its
+        pool pages up front (ALL ``ceil((L+max_new)/page)`` of them —
+        all-or-nothing, so a half-admitted pool can never deadlock):
+
+        - ``admit``  — one bucketed prefill dispatch (no cached prefix,
+          prompt fits the largest pinned bucket);
+        - ``hit``    — the prefix cache covers every prompt token but
+          (at most) the last: shared pages map READ-ONLY into the row,
+          ZERO prefill dispatches, at most one COW page copy;
+        - ``chunk``  — the prompt (or its uncached suffix) streams in
+          over chunked-prefill dispatches.
+
+        Returns ``None`` when the pool can't supply the pages right
+        now (the caller re-queues the request and retries next pump,
+        after retirements free pages)."""
+        progs = self._progs
+        PG = progs.page
+        L = int(req.prompt.size)
+        need = progs.pages_for(L + req.max_new)
+        m, shared = (self._prefix.match(req.prompt)
+                     if self._prefix is not None else (0, []))
+        if m and m * PG >= L - 1:
+            # full hit.  The consumer enters at pos = L-1 and its first
+            # step RE-WRITES that position's K/V — when the cached
+            # pages cover all L tokens that write would land in the
+            # last shared page, so it gets an eager COW copy; when they
+            # cover L-1 the write lands in the first owned page.
+            copy = m * PG == L
+            keep = m - 1 if copy else m
+            # protect the WHOLE matched chain (incl. the COW source):
+            # evicting the source here could hand its page to a later
+            # plan in the same wave before the copy dispatch reads it
+            owned = self._alloc_pages(need - keep, shared[:m])
+            if owned is None:
+                return None
+            for p in shared[:keep]:
+                self._pages.incref(p)
+            self._slot_pages[slot] = list(shared[:keep]) + owned
+            return {"mode": "hit", "req": req, "slot": slot,
+                    "shared": keep,
+                    "src": shared[m - 1] if copy else -1,
+                    "dst": owned[0] if copy else -1}
+        if m == 0 and L <= self.prefill_buckets[-1]:
+            owned = self._alloc_pages(need)
+            if owned is None:
+                return None
+            self._slot_pages[slot] = owned
+            return {"mode": "admit", "req": req, "slot": slot}
+        # chunked prefill: a long prompt streams in over several
+        # dispatches; a PARTIAL prefix hit maps its cached pages and
+        # streams only the divergent suffix
+        owned = self._alloc_pages(need - m, shared)
+        if owned is None:
+            return None
+        for p in shared:
+            self._pages.incref(p)
+        self._slot_pages[slot] = list(shared) + owned
+        if m:
+            self._count("prefix_hits")
+            telemetry.emit("prefix_cache_hit",
+                           server=self.telemetry_label,
+                           request_id=req.stream.request_id,
+                           shared_pages=m, cow_copy=False,
+                           partial=True)
+        return {"mode": "chunk", "req": req, "slot": slot,
+                "off": m * PG}
+
+    def _page_table(self):
+        """The step's ``(S, MAXP)`` int32 page-table operand, sentinel-
+        padded.  Slots mid-chunked-prefill get ALL-SENTINEL rows: their
+        reserved pages are being filled by chunk dispatches, and the
+        step's masked zombie lane must not scribble on them — the real
+        row appears once the final chunk activates the slot."""
+        progs = self._progs
+        pt = onp.full((len(self._slots), progs.maxp), progs.num_pages,
+                      onp.int32)
+        for i, row in enumerate(self._slot_pages):
+            if row and i not in self._chunk_slots:
+                pt[i, :len(row)] = row
+        return pt
+
+    def _dispatch_hits(self, hits):
+        """ONE masked dispatch admits a whole wave of prefix-cache
+        HITS: the shared pages are already resident, so the executable
+        only COW-copies each row's boundary page (if any) and scatters
+        slot state — no model forward, zero prefill dispatches, and the
+        request's first token arrives from the NEXT regular step
+        (TTFT ≈ one decode step)."""
+        A = _bucket_for(self.admit_sizes, len(hits))
+        fn = self._progs.admit_hit_fn(A)
+        self._watch_dispatch(fn)
+        sentinel = self._progs.num_pages
+        meta = onp.zeros((A, 6), onp.int32)
+        meta[:, 1] = 1
+        dls = onp.full((A,), onp.inf, onp.float32)
+        srcs = onp.full((A,), sentinel, onp.int32)
+        dsts = onp.full((A,), sentinel, onp.int32)
+        now = time.perf_counter()
+        S = len(self._slots)
+        busy = sum(r is not None for r in self._slots)
+        occ = busy / S if S else 0.0
+        for i, plan in enumerate(hits):
+            slot, req = plan["slot"], plan["req"]
+            L = req.prompt.size
+            meta[i] = (1, L, slot, L + req.max_new - 1, req.seed,
+                       int(req.prompt[-1]))
+            if req.deadline is not None:
+                dls[i] = req.deadline - self._epoch
+            if plan["src"] >= 0:
+                srcs[i] = plan["src"]
+                dsts[i] = plan["dst"]
+                self._count("cow_copies")
+            self._count("prefix_hits")
+            wait = now - req.stream.submit_time
+            req.span.update(queue_wait_s=wait, wave=len(hits),
+                            a_bucket=A, p_bucket=0,
+                            occupancy_at_admit=occ)
+            self._tele["wait"].observe(wait)
+            telemetry.emit("prefix_cache_hit",
+                           server=self.telemetry_label,
+                           request_id=req.stream.request_id,
+                           shared_pages=plan["shared"],
+                           cow_copy=plan["src"] >= 0, partial=False)
+        with telemetry.annotation("mx:serve:admit_hit"):
+            new_state = fn(meta, dls, srcs, dsts, *self._state)
+        self._state = new_state
+        if self._torn:
+            self._state = None
+
+    def _pump_chunks(self):
+        """Advance every mid-prefill request by ONE chunk dispatch per
+        pump, interleaved with decode steps so resident sequences keep
+        streaming while a long prompt fills in.  Returns ``(worked,
+        may_retire)`` — the latter when a final chunk could retire its
+        request inside the dispatch (1-token budget / EOS-at-admit)."""
+        worked = may_retire = False
+        for rec in list(self._chunking):
+            req, slot = rec["req"], rec["slot"]
+            if req.cancelled or (req.deadline is not None
+                                 and self._clock() >= req.deadline):
+                self._drop_chunk_record(slot)
+                with self._lock:
+                    if self._slots[slot] is req:
+                        self._slots[slot] = None
+                self._free_slot_pages(slot)
+                self._retire_aside(
+                    req, "cancelled" if req.cancelled
+                    else "deadline_exceeded")
+                worked = True
+                continue
+            final = self._dispatch_chunk(rec)
+            worked = True
+            if final:
+                self._drop_chunk_record(slot)
+                may_retire |= req.max_new == 1
+        return worked, may_retire
+
+    def _dispatch_chunk(self, rec):
+        """ONE slice of a streaming prefill: up to the largest pinned
+        prompt bucket of tokens runs through the slot's page-table row
+        at the record's landing offset.  The FINAL chunk also samples
+        the request's first token and activates the slot — its
+        readback routes through the admit drain path.  Returns whether
+        this was the final chunk."""
+        req, slot, off = rec["req"], rec["slot"], rec["off"]
+        fault_point("serve.chunk", server=self.telemetry_label)
+        L = int(req.prompt.size)
+        remaining = L - off
+        top = self.prefill_buckets[-1]
+        if remaining > top:
+            C, final, ntok = top, False, top
+        else:
+            C = _bucket_for(self.prefill_buckets, remaining)
+            final, ntok = True, remaining
+        fn = self._progs.chunk_fn(C)
+        self._watch_dispatch(fn)
+        toks = onp.zeros((C,), onp.int32)
+        toks[:ntok] = req.prompt[off:off + ntok]
+        meta = onp.asarray(
+            [1 if final else 0, slot, L, L + req.max_new - 1,
+             req.seed, (L - 1 - off) if final else C - 1, off],
+            onp.int32)
+        dl = onp.float32(onp.inf if req.deadline is None
+                         else req.deadline - self._epoch)
+        ptrow = onp.full((self._progs.maxp,), self._progs.num_pages,
+                         onp.int32)
+        row = self._slot_pages[slot]
+        ptrow[:len(row)] = row
+        param_vals, q8, sw = self._progs.operands
+        with telemetry.annotation("mx:serve:chunk"):
+            new_state, (first, done) = fn(param_vals, q8, sw, toks,
+                                          meta, dl, ptrow,
+                                          *self._state)
+        self._state = new_state
+        if self._torn:
+            self._state = None
+            return True
+        self._count("chunk_dispatches")
+        rec["off"] = off + ntok
+        telemetry.emit("serve_chunk", server=self.telemetry_label,
+                       request_id=req.stream.request_id, slot=slot,
+                       c_bucket=C, offset=off, final=final)
+        if final:
+            wait = time.perf_counter() - req.stream.submit_time
+            req.span.update(queue_wait_s=wait, wave=1, a_bucket=1,
+                            p_bucket=C)
+            self._tele["wait"].observe(wait)
+            if self._prefix is not None:
+                self._prefix.register(req.prompt, L,
+                                      self._slot_pages[slot])
+            self._inflight.append(("admit", (first, done),
+                                   [(slot, req)]))
+        return final
 
     # the step ------------------------------------------------------------ #
     def _dispatch_step(self):
@@ -1289,7 +1802,8 @@ class DecodeServer:
         now = onp.float32(self._clock() - self._epoch)
         with telemetry.annotation("mx:serve:step"):
             new_state, out = self._progs.step_fn()(
-                param_vals, q8, sw, now, *self._state)
+                param_vals, q8, sw, now, self._page_table(),
+                *self._state)
         self._state = new_state
         if self._torn:
             # late completion of a wedged dispatch after watchdog
@@ -1303,6 +1817,7 @@ class DecodeServer:
         self._occupied_lane_steps += busy
         self._capacity_lane_steps += len(self._slots)
         self._tele["occ"].set(busy / len(self._slots))
+        self._tele["pages"].set(self._pages.in_use)
         self._inflight.append(("step", out, list(self._slots)))
 
     # drain ---------------------------------------------------------------- #
@@ -1322,9 +1837,11 @@ class DecodeServer:
     def _route_admit(self, arrays, wave):
         """Route one admission wave's ``(first_tok, done)`` readback to
         its requests' streams, in wave order — which IS submission
-        order, so per-request stream order is preserved."""
-        first = onp.asarray(arrays[0])
-        done = onp.asarray(arrays[1])
+        order, so per-request stream order is preserved.  (A final
+        CHUNK's scalar readback rides this path too, as a wave of
+        one — hence the flatten.)"""
+        first = onp.asarray(arrays[0]).reshape(-1)
+        done = onp.asarray(arrays[1]).reshape(-1)
         for i, (slot, req) in enumerate(wave):
             if req.cancelled:
                 continue   # retired aside; the lane's output is void
@@ -1334,9 +1851,13 @@ class DecodeServer:
                 req.stream._finish()
                 self._observe_retire(req,
                                      self._retire_reason(req, tok))
+                freed = False
                 with self._lock:
                     if self._slots[slot] is req:
                         self._slots[slot] = None
+                        freed = True
+                if freed:
+                    self._free_slot_pages(slot)
 
     def _flush_drain(self, keep=0, final=False):
         """Route in-flight dispatches' readback arrays to their streams
@@ -1365,9 +1886,13 @@ class DecodeServer:
                         req.stream._finish()
                         self._observe_retire(
                             req, self._retire_reason(req, tok))
+                        freed = False
                         with self._lock:
                             if self._slots[slot] is req:
                                 self._slots[slot] = None
+                                freed = True
+                        if freed:
+                            self._free_slot_pages(slot)
         return worked
 
     # request-span telemetry ------------------------------------------------ #

@@ -316,6 +316,63 @@ class TestSiteCoverage:
 
 
 # --------------------------------------------------------------------- #
+# paged-pool pricing (ISSUE 16)
+# --------------------------------------------------------------------- #
+
+class TestPagedPricing:
+    """ISSUE 16 satellite: ``pool_state_bytes`` pages pricing equals
+    the allocator-reported device bytes of the paged state at init and
+    after growth, and ``stats()['pool_bytes']`` stays truthful while
+    pages are recycled."""
+
+    def test_pool_state_bytes_matches_device_state(self, tiny_gpt):
+        from mxnet_tpu.serve import engine as seng
+
+        progs = seng.PoolPrograms(tiny_gpt, num_slots=2, max_total=24)
+        state = seng.pool_state_init(progs)
+        assert sum(tmem.nbytes_of(x) for x in state) == \
+            seng.pool_state_bytes(progs)
+
+    def test_pool_state_grow_matches_pricing(self, tiny_gpt):
+        """Growth adds slots AND pages; the priced bytes track the
+        grown state exactly (no drift between pricer and allocator)."""
+        from mxnet_tpu.serve import engine as seng
+
+        progs = seng.PoolPrograms(tiny_gpt, num_slots=1, max_total=24)
+        state = seng.pool_state_init(progs)
+        new_pages = 3 * progs.maxp
+        grown = seng.pool_state_grow(state, 3, new_pages=new_pages)
+        assert sum(tmem.nbytes_of(x) for x in grown) == \
+            seng.pool_state_bytes(progs, 3, num_pages=new_pages)
+
+    def test_pool_bytes_truthful_under_page_reuse(self, tiny_gpt):
+        """Admit/retire churn recycles pages in place: the resident
+        pool's reported and accountant-metered bytes never move."""
+        from mxnet_tpu.serve import DecodeServer
+
+        srv = DecodeServer(tiny_gpt, max_total_len=24, pool_sizes=(1,),
+                           prefix_cache=False, autostart=False)
+        try:
+            b0 = srv.stats()["pool_bytes"]
+            assert b0 > 0
+            for seed in range(3):
+                rng = onp.random.RandomState(seed)
+                s = srv.submit(rng.randint(0, 64, (5,)),
+                               max_new_tokens=4)
+                while srv.pump():
+                    pass
+                s.tokens(10)
+                st = srv.stats()
+                assert st["pool_bytes"] == b0
+                assert st["pages_in_use"] == 0
+                assert telemetry.ACCOUNTANT.bytes(
+                    subsystem="serve.kv_pool",
+                    key=srv.telemetry_label) == b0
+        finally:
+            srv.close()
+
+
+# --------------------------------------------------------------------- #
 # budget-aware serving
 # --------------------------------------------------------------------- #
 
@@ -786,3 +843,18 @@ class TestCheckServeBudget:
 
         events = _mem_stream(pool_bytes=4096, budget=None)
         assert telemetry_report.check_serve(events) == []
+
+    def test_pages_over_capacity_flagged(self):
+        """ISSUE 16: serve_stats carrying the paged-pool fields must
+        report pages_in_use <= pages_total; pre-paging streams lack
+        the fields and skip the check (the no-budget stream above)."""
+        from tools import telemetry_report
+
+        events = _mem_stream(pool_bytes=4096)
+        stats = next(e for e in events if e["kind"] == "serve_stats")
+        stats["pages_total"] = 8
+        stats["pages_in_use"] = 3
+        assert telemetry_report.check_serve(events) == []
+        stats["pages_in_use"] = 9
+        fails = telemetry_report.check_serve(events)
+        assert any("pool capacity" in f for f in fails), fails

@@ -541,23 +541,30 @@ class TestBatchedAdmission:
             assert fn._cache_size() == 1
         srv.close()
 
-    def test_prompt_longer_than_largest_bucket_rejected(self, net):
-        """Satellite: a prompt the prefill ladder cannot hold fails at
-        submit() naming the limit — not later inside the admit trace
-        with a shape error."""
+    def test_prompt_longer_than_largest_bucket_chunks_in(self, net):
+        """Satellite (ISSUE 16): a prompt past the largest pinned
+        prefill bucket is NOT rejected any more — chunked prefill
+        streams it in over several dispatches, token-exact."""
         from mxnet_tpu.serve import DecodeServer
         srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
-                           prefill_buckets=(8,), autostart=False)
-        with pytest.raises(MXNetError, match="prefill bucket 8"):
-            srv.submit(_prompt(85, 12), max_new_tokens=4)
-        p = _prompt(86, 6)               # the server still serves
-        s = srv.submit(p, max_new_tokens=3)
+                           prefill_buckets=(8,), prefix_cache=False,
+                           autostart=False)
+        p = _prompt(85, 12)              # 12 > bucket 8: two chunks
+        s = srv.submit(p, max_new_tokens=4)
         _drain(srv)
-        assert s.tokens(5) == _ref(net, p, 3)
+        assert s.tokens(5) == _ref(net, p, 4)
+        assert srv.counters["chunk_dispatches"] == 2
+        assert srv.counters["admit_dispatches"] == 0
+        p2 = _prompt(86, 6)              # short prompts still admit
+        s2 = srv.submit(p2, max_new_tokens=3)
+        _drain(srv)
+        assert s2.tokens(5) == _ref(net, p2, 3)
+        assert srv.counters["admit_dispatches"] == 1
         srv.close()
 
     def test_prompt_longer_than_cache_names_limit(self, server):
-        with pytest.raises(MXNetError, match="prefill bucket"):
+        """The only hard length limit left is the pool cache length."""
+        with pytest.raises(MXNetError, match="pool cache length"):
             server.submit(_prompt(87, 70), max_new_tokens=1)
 
     def test_ttft_recorded_separately(self, net, server):
@@ -594,6 +601,218 @@ class TestBatchedAdmission:
         with pytest.raises(MXNetError, match="ADMIT_SIZES"):
             DecodeServer(net, max_total_len=64, pool_sizes=(2,),
                          autostart=False)
+
+
+class TestPagedKV:
+    """ISSUE 16 tentpole: the paged KV pool, COW shared-prefix caching
+    and chunked prefill.  T=64 with the default 16-token pages gives 4
+    pages per sequence; prompts of 32/33 tokens pin the two full-hit
+    boundary cases (prompt ending ON a page boundary needs one COW
+    copy; one past it shares every matched page outright)."""
+
+    def test_full_prefix_hit_zero_prefill_dispatches(self, net):
+        """THE acceptance pin: an identical prompt re-submitted after
+        its producer retired admits with ZERO prefill dispatches (no
+        admit, no chunk) and stays token-exact — including the eager
+        COW copy of the boundary page the first step re-writes."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        p = _prompt(200, 32)             # exactly 2 full pages
+        s1 = srv.submit(p, max_new_tokens=5)
+        _drain(srv)
+        assert s1.tokens(5) == _ref(net, p, 5)
+        srv.reset_counters()
+        s2 = srv.submit(p, max_new_tokens=5)
+        _drain(srv)
+        assert s2.tokens(5) == _ref(net, p, 5)
+        assert srv.counters["prefix_hits"] == 1
+        assert srv.counters["cow_copies"] == 1
+        assert srv.counters["admit_dispatches"] == 0
+        assert srv.counters["chunk_dispatches"] == 0
+        srv.close()
+
+    def test_prefix_hit_off_boundary_no_copy(self, net):
+        """A prompt ending one past a page boundary shares every
+        matched page read-only — no COW copy at all (the first owned
+        page takes the recompute write)."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        p = _prompt(201, 33)             # 2 full pages + 1 token
+        s1 = srv.submit(p, max_new_tokens=4)
+        _drain(srv)
+        assert s1.tokens(5) == _ref(net, p, 4)
+        srv.reset_counters()
+        s2 = srv.submit(p, max_new_tokens=4)
+        _drain(srv)
+        assert s2.tokens(5) == _ref(net, p, 4)
+        assert srv.counters["prefix_hits"] == 1
+        assert srv.counters["cow_copies"] == 0
+        assert srv.counters["admit_dispatches"] == 0
+        srv.close()
+
+    def test_prefix_hit_sampled_parity(self, net):
+        """A hit's first token comes from the step's recompute of the
+        last prompt position with fold_in(key, L-1) — the batched
+        admission's exact sampling key, so hit and miss streams match
+        the offline batch-1 stream seed-for-seed."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           temperature=0.8, top_k=5, autostart=False)
+        p = _prompt(202, 32)
+        kw = dict(temperature=0.8, top_k=5)
+        s1 = srv.submit(p, max_new_tokens=5, seed=7)
+        _drain(srv)
+        assert s1.tokens(5) == _ref(net, p, 5, seed=7, **kw)
+        srv.reset_counters()
+        s2 = srv.submit(p, max_new_tokens=5, seed=99)   # new key
+        _drain(srv)
+        assert s2.tokens(5) == _ref(net, p, 5, seed=99, **kw)
+        assert srv.counters["prefix_hits"] == 1
+        assert srv.counters["admit_dispatches"] == 0
+        srv.close()
+
+    def test_cow_fork_divergence(self, net):
+        """Two prompts sharing a one-page prefix fork correctly after
+        the first non-shared token: the second maps the shared page and
+        streams only its divergent suffix (a partial hit), and neither
+        stream perturbs the other."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        prefix = _prompt(210, 16)        # exactly one full page
+        p1 = onp.concatenate([prefix, _prompt(211, 4)])
+        p2 = onp.concatenate([prefix, _prompt(212, 4)])
+        s1 = srv.submit(p1, max_new_tokens=5)
+        _drain(srv)
+        srv.reset_counters()
+        s2 = srv.submit(p2, max_new_tokens=5)
+        _drain(srv)
+        assert s1.tokens(5) == _ref(net, p1, 5)
+        assert s2.tokens(5) == _ref(net, p2, 5)
+        assert srv.counters["prefix_hits"] == 1    # partial hit
+        assert srv.counters["admit_dispatches"] == 0
+        assert srv.counters["chunk_dispatches"] == 1   # 4-token suffix
+        srv.close()
+
+    def test_hit_first_token_costs_one_step(self, net):
+        """Acceptance: prefix-hit TTFT is ONE decode step — the hit
+        admission dispatches nothing, and the first pump's single step
+        dispatch produces the first token."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        p = _prompt(203, 32)
+        s1 = srv.submit(p, max_new_tokens=4)
+        _drain(srv)
+        srv.reset_counters()
+        s2 = srv.submit(p, max_new_tokens=4)
+        srv.pump()                       # hit admission + 1 step
+        assert srv.counters["admit_dispatches"] == 0
+        assert srv.counters["chunk_dispatches"] == 0
+        assert srv.counters["step_dispatches"] == 1
+        srv.pump()                       # drains the step's readback
+        assert len(s2.times) >= 1        # first token arrived
+        _drain(srv)
+        assert s2.tokens(5) == _ref(net, p, 4)
+        srv.close()
+
+    def test_refcounted_pages_freed_on_retire(self, net):
+        """Retirement decrefs the slot's page row back to the free
+        list; the resident pool's accountant-metered bytes never move
+        (pages are recycled, not reallocated)."""
+        from mxnet_tpu.serve import DecodeServer
+        from mxnet_tpu.telemetry.memory import ACCOUNTANT
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           prefix_cache=False, autostart=False)
+        label = srv.telemetry_label
+        bytes0 = ACCOUNTANT.bytes(subsystem="serve.kv_pool", key=label)
+        assert bytes0 == srv.stats()["pool_bytes"] > 0
+        p = _prompt(204, 20)             # pages_for(20 + 4) = 2
+        s = srv.submit(p, max_new_tokens=4)
+        srv.pump()
+        assert srv.stats()["pages_in_use"] == 2
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 4)
+        assert srv.stats()["pages_in_use"] == 0      # refs released
+        assert ACCOUNTANT.bytes(subsystem="serve.kv_pool",
+                                key=label) == bytes0  # no delta
+        srv.close()
+        assert srv.stats()["pages_in_use"] == 0
+
+    def test_prefix_cache_retains_only_full_pages(self, net):
+        """With the cache ON, retirement keeps exactly the registered
+        FULL prompt pages resident (index-owned) for future hits."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        p = _prompt(205, 20)             # one full page registered
+        s = srv.submit(p, max_new_tokens=4)
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 4)
+        st = srv.stats()
+        assert st["pages_in_use"] == 1 and st["prefix_nodes"] == 1
+        srv.close()
+
+    def test_env_prefix_cache_off(self, net, monkeypatch):
+        """MXNET_SERVE_PREFIX_CACHE=0 disables the index: identical
+        prompts re-prefill (no hits), parity unchanged."""
+        from mxnet_tpu.serve import DecodeServer
+        monkeypatch.setenv("MXNET_SERVE_PREFIX_CACHE", "0")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        p = _prompt(206, 32)
+        for _ in range(2):
+            s = srv.submit(p, max_new_tokens=3)
+            _drain(srv)
+            assert s.tokens(5) == _ref(net, p, 3)
+        assert srv.counters["prefix_hits"] == 0
+        assert srv.counters["admit_dispatches"] == 2
+        srv.close()
+
+    def test_env_page_size(self, net, monkeypatch):
+        """MXNET_SERVE_PAGE_SIZE pins the page granule; malformed
+        values are a constructor error."""
+        from mxnet_tpu.serve import DecodeServer
+        monkeypatch.setenv("MXNET_SERVE_PAGE_SIZE", "8")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        assert srv._progs.page == 8 and srv._progs.maxp == 8
+        p = _prompt(207, 12)
+        s = srv.submit(p, max_new_tokens=4)
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 4)
+        srv.close()
+        monkeypatch.setenv("MXNET_SERVE_PAGE_SIZE", "none")
+        with pytest.raises(MXNetError, match="PAGE_SIZE"):
+            DecodeServer(net, max_total_len=64, autostart=False)
+
+    def test_page_churn_never_retraces(self, net):
+        """Steady-state discipline through the page-table operand:
+        admit / hit / chunk / retire churn changes table VALUES only —
+        the step executable compiles once, ever."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           prefill_buckets=(8, 16),
+                           autostart=False)
+        p_long = _prompt(208, 24)        # chunks (24 > bucket 16)
+        p_short = _prompt(209, 6)
+        for p, n in ((p_short, 4), (p_long, 4), (p_short, 3),
+                     (p_long, 3)):
+            s = srv.submit(p, max_new_tokens=n)
+            _drain(srv)
+            assert s.tokens(5) == _ref(net, p, n)
+        assert srv.counters["prefix_hits"] >= 1
+        assert srv.counters["chunk_dispatches"] >= 1
+        assert srv._progs.step_fn()._cache_size() == 1
+        for fn in srv._progs._admits.values():
+            assert fn._cache_size() == 1
+        for fn in srv._progs._chunks.values():
+            assert fn._cache_size() == 1
+        for fn in srv._progs._hits.values():
+            assert fn._cache_size() == 1
+        srv.close()
 
 
 class TestSyncFallback:

@@ -434,7 +434,8 @@ class TestServeCounterView:
 
         v = _CounterView("t_view_srv")
         assert set(v) == {"step_dispatches", "admit_dispatches",
-                          "sync_requests", "pool_grows"}
+                          "sync_requests", "pool_grows", "prefix_hits",
+                          "cow_copies", "chunk_dispatches"}
         v.inc("step_dispatches")
         v["step_dispatches"] += 2        # MutableMapping read-modify
         assert v["step_dispatches"] == 3

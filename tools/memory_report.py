@@ -16,6 +16,12 @@ Sections (each skipped when the stream has no events of that kind):
   (``device_memory`` events): last-known bytes per subsystem per
   device (``train.params`` / ``train.opt_states`` /
   ``train.grad_accum`` / ``serve.kv_pool`` / ``data.prefetch_ring``).
+  The paged serve pool (ISSUE 16) meters through the same
+  ``serve.kv_pool`` entry — page churn recycles fixed buffers, so the
+  accounted bytes move only at init/growth and the ``--hbm`` verdict
+  shape is unchanged; per-request page occupancy lives in the serve
+  stream (``serve_stats.pages_in_use``, checked by
+  ``telemetry_report --check-serve``).
 - **budget table** — the per-step answer: PEAK resident subsystem
   totals over the recording (a pool or trainer released before the
   recording ended still had to fit while live) + the largest

@@ -43,7 +43,11 @@ the stream (no process state needed):
    for servers whose ``serve_config`` carries a non-null
    ``hbm_budget``, every ``serve.kv_pool`` accountant sample
    (``device_memory`` events) and the close-time
-   ``serve_stats.pool_bytes`` must stay within it.
+   ``serve_stats.pool_bytes`` must stay within it;
+5. pages ≤ pool capacity (ISSUE 16): any ``serve_stats`` carrying the
+   paged-pool fields must report ``pages_in_use <= pages_total``
+   (streams recorded before paging simply lack the fields and skip
+   the check).
 
 Exit status 1 when a check fails (the tier-1 serve smoke shells this
 against the JSONL ``benchmark/serve_bench.py --smoke`` records).
@@ -317,6 +321,17 @@ def check_serve(events):
             failures.append(
                 f"{st.get('server', '?')}: serve_stats pool_bytes "
                 f"{pb} exceed the configured hbm_budget {budget}")
+
+    # paged-pool capacity (ISSUE 16): pages in use can never exceed
+    # the pool's page count — pre-paging recordings lack the fields
+    # and skip the check
+    for st in stats:
+        total = st.get("pages_total")
+        used = st.get("pages_in_use")
+        if total is not None and used is not None and used > total:
+            failures.append(
+                f"{st.get('server', '?')}: {used} pages in use exceed "
+                f"the pool capacity {total}")
     if not configs and not stats:
         failures.append("no serve_config/serve_stats events in the "
                         "stream — nothing to check")
