@@ -17,6 +17,15 @@ batches vs slot-pool continuous batching (``mxnet_tpu/serve/``) at
 25/50/100% padded-batch occupancy — the serving-shaped comparison the
 static arms can't express.
 
+Every arm reports **tokens_per_dispatch** (ISSUE 17): useful tokens
+emitted per executable dispatch.  The scan/loop arms are exactly 1.0 by
+construction (one decode dispatch per token per lane); the
+**speculative arm** (``spec_selfdraft``) decodes a repetitive-suffix
+prompt on a ONE-slot pump-driven server with draft-and-verify on, and
+its strict global ratio — tokens / (admit + step + verify dispatches)
+— must clear > 1.5 (the n-gram self-drafts verify at high acceptance,
+so each verify dispatch advances several positions).
+
 ``--smoke``: tiny geometry, no TPU — exercises the unrolled and stacked
 arms plus the op-count column and asserts greedy parity between them;
 gated in tier-1 like ``step_profile.py --smoke``.
@@ -43,6 +52,43 @@ def _step_ops(net, total, weights, fused, stacked):
                                    weights=weights, fused=fused,
                                    stacked=stacked)
     return profiler_xla.hlo_op_count(fn, *args)
+
+
+def run_spec_single(net, cfg, P, N):
+    """ISSUE 17 speculative arm: one slot, repetitive-suffix prompt.
+
+    A single request decodes on a pump-driven one-slot server with
+    draft-and-verify ON; the prompt's repeated suffix gives the n-gram
+    drafter material from the first step, so verifies advance several
+    positions each.  Returns ``(prompt, toks, tokens_per_dispatch,
+    accept_rate, dispatch_deltas, wall)`` where tokens_per_dispatch is
+    the STRICT global ratio tokens / (admit + step + verify
+    dispatches) — every dispatch the request cost, nothing amortised
+    away."""
+    from mxnet_tpu.serve import DecodeServer
+
+    prompt = onp.tile(onp.arange(1, 5), -(-P // 4))[:P]
+    srv = DecodeServer(net, max_total_len=P + N, pool_sizes=(1,),
+                       spec=True, prefix_cache=False, autostart=False)
+    warm = srv.submit(prompt, max_new_tokens=N)   # compile everything
+    while srv.pump():
+        pass
+    warm.tokens(1)
+    base = dict(srv.counters)
+    t0 = time.perf_counter()
+    stream = srv.submit(prompt, max_new_tokens=N)
+    while srv.pump():
+        pass
+    wall = time.perf_counter() - t0
+    toks = stream.tokens(1)
+    d = {k: v - base[k] for k, v in dict(srv.counters).items()}
+    disp = (d["admit_dispatches"] + d["step_dispatches"]
+            + d["verify_dispatches"])
+    tpd = len(toks) / max(disp, 1)
+    acc = d["draft_accepted"] / max(d["draft_accepted"]
+                                    + d["draft_rejected"], 1)
+    srv.close()
+    return prompt, toks, tpd, acc, d, wall
 
 
 def smoke():
@@ -75,6 +121,7 @@ def smoke():
         print(json.dumps({"bench": "decode_smoke", "mode": arm,
                           "ops_per_step": ops,
                           "ms_per_token": round(dt / N * 1e3, 3),
+                          "tokens_per_dispatch": 1.0,  # 1 token/step scan
                           "batch": B, "new_tokens": N}))
     onp.testing.assert_array_equal(outs["stacked"], outs["unrolled"])
     onp.testing.assert_array_equal(outs["int8_stacked"],
@@ -84,6 +131,29 @@ def smoke():
     assert ops["int8_stacked"] < ops["int8_unrolled"], rows
     print(f"# parity OK; ops/step {ops['unrolled']} -> {ops['stacked']}"
           f" (int8 {ops['int8_unrolled']} -> {ops['int8_stacked']})")
+
+    # speculative arm (ISSUE 17): strict tokens/(admit+step+verify)
+    # on a repetitive-suffix prompt must clear the > 1.5 acceptance
+    # bar, and the served stream must match the offline greedy decode
+    import jax
+    platform = jax.devices()[0].platform
+    Ns = 48
+    sp_prompt, sp_toks, tpd, acc, d, wall = run_spec_single(
+        net, cfg, P, Ns)
+    print(json.dumps({"bench": "decode_smoke", "mode": "spec_selfdraft",
+                      "tokens_per_dispatch": round(tpd, 3),
+                      "accept_rate": round(acc, 3),
+                      "admit_dispatches": d["admit_dispatches"],
+                      "step_dispatches": d["step_dispatches"],
+                      "verify_dispatches": d["verify_dispatches"],
+                      "ms_per_token": round(wall / Ns * 1e3, 3),
+                      "new_tokens": Ns, "platform": platform}))
+    assert tpd > 1.5, f"spec tokens/dispatch {tpd:.2f} <= 1.5"
+    ref = list(kv_generate(net, sp_prompt[None], max_new_tokens=Ns,
+                           temperature=0.0)[0, sp_prompt.size:])
+    assert sp_toks == ref, "spec stream != kv_generate"
+    print(f"# spec OK: {tpd:.2f} tokens/dispatch at "
+          f"{acc:.2f} accept, parity exact")
     return 0
 
 
@@ -126,6 +196,7 @@ def main():
     print(json.dumps({"bench": "decode", "mode": "kv_cache",
                       "step": decode_mode(net, B, P + N),
                       "tokens_per_sec": round(B * N / dt, 1),
+                      "tokens_per_dispatch": 1.0,  # 1 token/step scan
                       "batch": B, "new_tokens": N,
                       "platform": platform}))
     sys.stdout.flush()
@@ -163,9 +234,26 @@ def main():
                           "new_tokens_per_sec": round(N / dt, 1),
                           "ms_per_token": round(dt / N * 1e3, 3),
                           "ops_per_step": ops,
+                          "tokens_per_dispatch": 1.0,  # 1 token/step
                           "batch": 1, "new_tokens": N, "prompt": P,
                           "platform": platform}))
         sys.stdout.flush()
+
+    # speculative-decoding arm (ISSUE 17): one slot, repetitive-suffix
+    # prompt, draft-and-verify on — strict global tokens per dispatch
+    sp_prompt, sp_toks, tpd, acc, d, wall = run_spec_single(
+        net, cfg, P, N)
+    print(json.dumps({"bench": "decode", "mode": "spec_selfdraft",
+                      "new_tokens_per_sec": round(len(sp_toks) / wall, 1),
+                      "tokens_per_dispatch": round(tpd, 3),
+                      "accept_rate": round(acc, 3),
+                      "admit_dispatches": d["admit_dispatches"],
+                      "step_dispatches": d["step_dispatches"],
+                      "verify_dispatches": d["verify_dispatches"],
+                      "batch": 1, "new_tokens": N, "prompt": P,
+                      "platform": platform}))
+    sys.stdout.flush()
+    assert tpd > 1.5, f"spec tokens/dispatch {tpd:.2f} <= 1.5"
 
     # ragged-arrival arm: the same ragged workload (per 8-request wave
     # one long request + seven short) served as static padded batches
@@ -177,13 +265,14 @@ def main():
     from benchmark.serve_bench import run_ragged
     S_r, N_r = 8, N
     for frac in (0.25, 0.5, 1.0):
-        st, ct, occ = run_ragged(net, cfg, S_r, P, N_r, frac,
-                                 2 * S_r)
+        st, ct, occ, _ttfts = run_ragged(net, cfg, S_r, P, N_r, frac,
+                                         2 * S_r)
         print(json.dumps({"bench": "decode",
                           "mode": f"ragged_occ={frac}",
                           "static_padded_tok_s": round(st, 1),
                           "continuous_tok_s": round(ct, 1),
                           "continuous_vs_static": round(ct / st, 3),
+                          "tokens_per_dispatch": 1.0,  # spec=False
                           "occupancy": round(occ, 3),
                           "num_slots": S_r, "new_tokens": N_r,
                           "platform": platform}))
@@ -198,6 +287,7 @@ def main():
     dt = time.perf_counter() - t0
     print(json.dumps({"bench": "decode", "mode": "full_recompute",
                       "tokens_per_sec": round(B * n2 / dt, 1),
+                      "tokens_per_dispatch": 1.0,  # 1 forward/token
                       "batch": B, "new_tokens": n2,
                       "platform": platform}))
     return 0

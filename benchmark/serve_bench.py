@@ -38,6 +38,20 @@ Arms (one JSON line each):
   with the producer, ``prefix_hits`` == hit count, ZERO admit/chunk
   dispatches across the hit window; full profiles also assert the
   timing bar (hit TTFT ≈ one decode step, not a prefill).
+- **ragged_spec** — the ISSUE 17 speculative-decoding arm: the SAME
+  ragged workload served with draft-and-verify ON (the other arms pin
+  ``spec=False`` — they are the plain-step baseline whose dispatch
+  accounting the smoke asserts).  Reports the accept rate and the
+  ``tokens_per_dispatch`` multiplier; every profile asserts it
+  > 1.5 (the greedy decode of the bench models is self-similar, so
+  the n-gram drafter's proposals verify at high acceptance).
+
+Every arm also reports **tokens_per_dispatch** — tokens delivered per
+slot-advancing dispatch, ``total_tokens / (total_tokens -
+draft_accepted)`` from the stream ledgers: exactly 1.0 on the
+non-spec path (one token per lane per dispatch, asserted by the
+smoke), > 1 only when speculative verification accepts drafts.
+
 - **admit_sequential / admit_batched / admit_ratio** — the
   admission-heavy workload (ISSUE 8): Poisson-sized bursts of
   SHORT-budget requests land at an idle step boundary, so admission
@@ -119,6 +133,18 @@ def build_model(profile):
     return net, cfg
 
 
+def tokens_per_dispatch(streams):
+    """Tokens delivered per slot-advancing dispatch, from the stream
+    ledgers: every token batch a stream receives rides one dispatch
+    (admit / chunk / step / verify), and a verify batch carries its
+    accepted drafts on top of the dispatch's own token — so the
+    multiplier is ``total / (total - accepted)``.  Exactly 1.0 when
+    nothing was accepted (the non-spec invariant the smoke pins)."""
+    total = sum(len(s._toks) for s in streams)
+    acc = sum(s.draft_accepted for s in streams)
+    return total / max(total - acc, 1)
+
+
 def static_batch_rate(net, cfg, B, P, N):
     """Offline reference: one compiled batch-B scan, tok/s."""
     from mxnet_tpu.models import kv_generate
@@ -157,8 +183,10 @@ def run_saturated(net, cfg, S, P, N, n_requests):
     rng = onp.random.RandomState(1)
     prompts = [rng.randint(0, cfg.vocab_size, (P,))
                for _ in range(n_requests)]
+    # spec=False: this arm is the plain-step baseline — the smoke pins
+    # its dispatch accounting AND tokens_per_dispatch == 1.0
     srv = DecodeServer(net, max_total_len=P + N, pool_sizes=(S,),
-                       autostart=False)
+                       spec=False, autostart=False)
     warm_server(srv, cfg, P)
 
     t0 = time.perf_counter()
@@ -211,8 +239,9 @@ def run_ragged(net, cfg, S, P, N_max, frac, n_requests):
     static_tps = useful / (time.perf_counter() - t0)
 
     # -- continuous batching: retired slots back-fill from the queue
+    # (plain-step baseline; the ragged_spec arm is the speculative one)
     srv = DecodeServer(net, max_total_len=P + N_max, pool_sizes=(S,),
-                       autostart=False)
+                       spec=False, autostart=False)
     warm_server(srv, cfg, P)
     t0 = time.perf_counter()
     streams = [srv.submit(p, max_new_tokens=n)
@@ -225,6 +254,44 @@ def run_ragged(net, cfg, S, P, N_max, frac, n_requests):
     ttfts = [s.ttft for s in streams]
     srv.close()
     return static_tps, cont_tps, occ, ttfts
+
+
+def run_ragged_spec(net, cfg, S, P, N_max, frac, n_requests):
+    """The ragged workload with speculative draft-and-verify ON
+    (ISSUE 17): the same ragged length DISTRIBUTION as ``run_ragged``'s
+    continuous arm at 4x the generation budget, served with the default
+    n-gram drafter.  Returns ``(tok/s, tokens_per_dispatch,
+    accept_rate, step+verify dispatch counts, (prompts, lens,
+    streams))``.  The n-gram drafter needs a few emitted tokens before
+    the stream's self-similarity gives it material (a slot's first
+    decode is always a plain step — the ramp), so the arm generates
+    long enough for acceptance to amortise that ramp; the bench models'
+    greedy decode is self-similar and the multiplier clears the > 1.5
+    acceptance bar."""
+    from mxnet_tpu.serve import DecodeServer
+
+    N_max = 4 * N_max
+    lens = ragged_lengths(S, N_max, frac, n_requests)
+    rng = onp.random.RandomState(3)
+    prompts = [rng.randint(0, cfg.vocab_size, (P,))
+               for _ in range(n_requests)]
+    srv = DecodeServer(net, max_total_len=P + N_max, pool_sizes=(S,),
+                       spec=True, autostart=False)
+    warm_server(srv, cfg, P)
+    t0 = time.perf_counter()
+    streams = [srv.submit(p, max_new_tokens=n)
+               for p, n in zip(prompts, lens)]
+    while srv.pump():
+        pass
+    tps = sum(len(s.tokens(1)) for s in streams) / \
+        (time.perf_counter() - t0)
+    st = srv.stats()
+    tpd = tokens_per_dispatch(streams)
+    dispatches = (srv.counters["step_dispatches"],
+                  srv.counters["verify_dispatches"])
+    srv.close()
+    return tps, tpd, st["draft_accept_rate"], dispatches, \
+        (prompts, lens, streams)
 
 
 def run_paged_residency(net, cfg, n_requests):
@@ -247,7 +314,7 @@ def run_paged_residency(net, cfg, n_requests):
     srv = DecodeServer(net, max_total_len=T, pool_sizes=(S,),
                        page_size=page, num_pages=num_pages,
                        prefill_buckets=(8, 32), prefix_cache=False,
-                       autostart=False)
+                       spec=False, autostart=False)
     rng = onp.random.RandomState(11)
     reqs = []
     for i in range(n_requests):
@@ -293,7 +360,7 @@ def run_prefix_hits(net, cfg, S, P, N, n_hits):
     from mxnet_tpu.serve import DecodeServer
 
     srv = DecodeServer(net, max_total_len=P + N, pool_sizes=(S,),
-                       autostart=False)
+                       spec=False, autostart=False)
     warm_server(srv, cfg, P)
     rng = onp.random.RandomState(13)
     shared = rng.randint(0, cfg.vocab_size, (P,))
@@ -334,7 +401,7 @@ def run_qps(net, cfg, S, P, N, qps, n_requests, seed=2):
     rng = onp.random.RandomState(seed)
     py_rng = random.Random(seed)
     srv = DecodeServer(net, max_total_len=P + N, pool_sizes=(S,),
-                       autostart=False)
+                       spec=False, autostart=False)
     warm_server(srv, cfg, P)        # pump-driven warm, then hand off
     srv.start()
 
@@ -370,7 +437,7 @@ def run_admission(net, cfg, S, P, N, n_bursts, sequential, seed=7):
     rng = onp.random.RandomState(seed)
     srv = DecodeServer(net, max_total_len=P + N, pool_sizes=(S,),
                        admit_sizes=(1,) if sequential else None,
-                       autostart=False)
+                       spec=False, autostart=False)
     warm_server(srv, cfg, P)
     streams, bursts = [], []
     t0 = time.perf_counter()
@@ -428,6 +495,7 @@ def main():
     emit_row({"bench": "serve", "mode": "static_batch8",
               "profile": profile,
               "tokens_per_sec": round(static_rate, 1),
+              "tokens_per_dispatch": 1.0,   # scan: 1 token/lane/step
               "batch": S, "new_tokens": N,
               "platform": platform,
               **mem_fields("models.kv_generate")})
@@ -440,9 +508,11 @@ def main():
     steps = srv.counters["step_dispatches"]
     admits = srv.counters["admit_dispatches"]
     sat_ttfts = [s.ttft for s in streams]
+    sat_tpd = tokens_per_dispatch(streams)
     emit_row({"bench": "serve", "mode": "saturated",
               "profile": profile,
               "tokens_per_sec": round(rate, 1),
+              "tokens_per_dispatch": round(sat_tpd, 3),
               "vs_static_batch8": round(ratio, 3),
               "occupancy": round(stats["occupancy"], 3),
               "p50_ttft_ms": round(_pct(sat_ttfts, 0.5) * 1e3, 3),
@@ -469,6 +539,10 @@ def main():
         floor = (n_requests * (N - 1)) // S
         assert steps >= floor, (steps, floor)
         assert steps <= floor + n_requests + 4, (steps, floor)
+        # the non-spec path delivers EXACTLY one token per lane per
+        # dispatch — the ISSUE 17 regression gate on the baseline
+        assert sat_tpd == 1.0, sat_tpd
+        assert srv.counters["verify_dispatches"] == 0
         # ISSUE 9 telemetry invariants, from the registry/event stream
         # alone: warm_server compiled the whole usable (A, P) admission
         # ladder (every pinned A ≤ pool size × the single 16-token
@@ -516,10 +590,41 @@ def main():
                   "static_padded_tok_s": round(st, 1),
                   "continuous_tok_s": round(ct, 1),
                   "continuous_vs_static": round(ct / st, 3),
+                  "tokens_per_dispatch": 1.0,   # spec=False baseline
                   "occupancy": round(occ, 3),
                   "p50_ttft_ms": round(_pct(rt, 0.5) * 1e3, 3),
                   "p99_ttft_ms": round(_pct(rt, 0.99) * 1e3, 3),
                   "platform": platform})
+
+    # speculative-decoding arm (ISSUE 17): the ragged workload with
+    # draft-and-verify ON — the accept rate and the tokens_per_dispatch
+    # multiplier are the columns; > 1.5 is the acceptance bar (every
+    # profile: acceptance is a property of the greedy stream's
+    # self-similarity, not of dispatch cost)
+    phase("ragged_spec")
+    sp_tps, sp_tpd, sp_acc, (sp_steps, sp_verifies), sp_work = \
+        run_ragged_spec(net, cfg, S, P, N, 0.5, n_requests)
+    emit_row({"bench": "serve", "mode": "ragged_spec",
+              "profile": profile,
+              "tokens_per_sec": round(sp_tps, 1),
+              "tokens_per_dispatch": round(sp_tpd, 3),
+              "accept_rate": round(sp_acc, 3),
+              "step_dispatches": sp_steps,
+              "verify_dispatches": sp_verifies,
+              "vs_plain_continuous": round(sp_tps / ragged[0.5][1], 3),
+              "platform": platform})
+    assert sp_verifies > 0, "spec arm never dispatched a verify"
+    assert sp_tpd > 1.5, \
+        f"ragged spec tokens/dispatch {sp_tpd:.2f} <= 1.5"
+    if args.smoke:
+        # speculation must not change a single token: spot-check the
+        # spec arm's streams against the offline greedy decode
+        from mxnet_tpu.models import kv_generate
+        sp_prompts, sp_lens, sp_streams = sp_work
+        for p, n, s in list(zip(sp_prompts, sp_lens, sp_streams))[:4]:
+            ref = list(kv_generate(net, p[None], max_new_tokens=n,
+                                   temperature=0.0)[0, p.size:])
+            assert s.tokens(1) == ref, "spec stream != kv_generate"
 
     # paged-residency arm (ISSUE 16): long-context ragged mix on a
     # page pool priced at a dense 2-slot budget — the acceptance bar
@@ -538,6 +643,7 @@ def main():
               "paged_pool_bytes": res["paged_pool_bytes"],
               "dense_pool_bytes": res["dense_pool_bytes"],
               "tokens_per_sec": round(res["tokens_per_sec"], 1),
+              "tokens_per_dispatch": 1.0,   # spec=False baseline
               "chunk_dispatches": res["counters"]["chunk_dispatches"],
               "platform": platform})
     assert res["resident_x"] >= 2.0, \
@@ -559,6 +665,7 @@ def main():
     gap_p50 = _pct(gaps, 0.5)
     emit_row({"bench": "serve", "mode": "prefix_hit",
               "profile": profile,
+              "tokens_per_dispatch": 1.0,   # spec=False baseline
               "p50_hit_ttft_ms": round(hit_p50 * 1e3, 3),
               "p50_miss_ttft_ms": round(miss_p50 * 1e3, 3),
               "p50_step_ms": round(gap_p50 * 1e3, 3),
@@ -598,6 +705,7 @@ def main():
             "bench": "serve", "mode": f"admit_{name}",
             "profile": profile,
             "tokens_per_sec": round(tps, 1),
+            "tokens_per_dispatch": 1.0,   # spec=False baseline
             "p50_ttft_ms": round(_pct(ttfts, 0.5) * 1e3, 3),
             "p99_ttft_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
             "admit_dispatches_per_request": round(apr, 3),
@@ -680,6 +788,7 @@ def main():
             "profile": profile,
             "offered_qps": round(qps, 3),
             "tokens_per_sec": round(tps, 1),
+            "tokens_per_dispatch": 1.0,   # spec=False baseline
             "p50_ttft_ms": round(_pct(ttfts, 0.5) * 1e3, 3),
             "p99_ttft_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
             "p50_token_latency_ms": round(_pct(gaps, 0.5) * 1e3, 3),

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Recording-driven decode/serve sweep: run the decode_bench and
+serve_bench arms as subprocesses under ``MXNET_TELEMETRY_JSONL``,
+re-verify the serving invariants from each recording with
+``tools/telemetry_report.py --check-serve``, and print the measured
+rows BASELINE.md-ready (one markdown table per bench).
+
+This is the one-command path from "fresh checkout" to "the dispatch
+table in BASELINE.md": every number it prints went through the
+telemetry stream, so the ladder-bounded-compile / zero-retrace /
+draft-ledger invariants were checked against the SAME run the rows
+came from — a row cannot land in BASELINE.md from a run that violated
+the serving contract.
+
+    python benchmark/tpu_sweep.py --smoke        # CPU, minutes
+    python benchmark/tpu_sweep.py                # full profiles
+    python benchmark/tpu_sweep.py --dry-run      # plan only
+
+``--smoke`` forwards each bench's ``--smoke`` profile (the tier-1
+geometry, runs on CPU); ``--dry-run`` prints the planned commands and
+environment without executing (tier-1 covers it). Recordings land in
+``--out`` (default: a temp directory, deleted unless ``--keep``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the arms each bench contributes to the BASELINE.md dispatch table;
+# anything else the bench prints is measured but not a headline row
+WANTED = {
+    "decode": ("unrolled", "stacked", "int8_stacked", "kv_cache",
+               "kv_cache_batch1", "kv_cache_batch1_stacked",
+               "spec_selfdraft"),
+    "serve": ("saturated", "ragged_occ=0.25", "ragged_occ=0.5",
+              "ragged_occ=1.0", "ragged_spec", "prefix_hit"),
+}
+# columns worth a BASELINE.md reader's attention, in print order
+COLUMNS = ("tokens_per_sec", "new_tokens_per_sec", "tokens_per_dispatch",
+           "accept_rate", "ops_per_step", "ms_per_token",
+           "continuous_vs_static", "p50_ttft_ms", "p99_ttft_ms",
+           "p50_hit_ttft_ms", "occupancy", "platform")
+
+
+def plan(args, out_dir):
+    """The sweep plan: (name, argv, recording-path) per bench."""
+    py = sys.executable
+    here = os.path.dirname(os.path.abspath(__file__))
+    jobs = []
+    for name in ("decode", "serve"):
+        argv = [py, os.path.join(here, f"{name}_bench.py")]
+        if args.smoke:
+            argv.append("--smoke")
+        jobs.append((name, argv, os.path.join(out_dir, f"{name}.jsonl")))
+    return jobs
+
+
+def run_job(name, argv, rec_path, timeout):
+    """Run one bench under a JSONL recording; return its stdout rows."""
+    env = dict(os.environ)
+    env["MXNET_TELEMETRY_JSONL"] = rec_path
+    t0 = time.perf_counter()
+    proc = subprocess.run(argv, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+    wall = time.perf_counter() - t0
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        sys.stdout.write(proc.stdout)
+        raise SystemExit(f"tpu_sweep: {name} bench failed "
+                         f"(exit {proc.returncode})")
+    rows = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    print(f"# {name}: {len(rows)} rows in {wall:.1f}s -> {rec_path}")
+    return rows
+
+
+def check_recording(name, rec_path):
+    """Re-verify the serving invariants from the recording alone."""
+    from tools.telemetry_report import check_serve, load
+    events = load(rec_path)
+    failures = check_serve(events)
+    if failures:
+        for f in failures:
+            print(f"CHECK FAILED ({name}): {f}", file=sys.stderr)
+        raise SystemExit(f"tpu_sweep: {name} recording violated the "
+                         f"serving invariants")
+    print(f"# {name}: serve checks OK over {len(events)} recorded events")
+
+
+def baseline_table(name, rows):
+    """BASELINE.md-ready markdown for one bench's headline arms."""
+    picked = [r for r in rows if r.get("mode") in WANTED[name]]
+    if not picked:
+        return f"(no {name} headline rows — bench printed none)"
+    cols = [c for c in COLUMNS if any(c in r for r in picked)]
+    out = [f"| arm | {' | '.join(cols)} |",
+           f"|---|{'---|' * len(cols)}"]
+    for r in picked:
+        cells = [str(r.get(c, "-")) for c in cols]
+        out.append(f"| {r['mode']} | {' | '.join(cells)} |")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Run the decode/serve bench arms under a telemetry "
+                    "recording, re-check the serving invariants from "
+                    "it, and print BASELINE.md-ready rows.")
+    ap.add_argument("--smoke", action="store_true",
+                    help="forward each bench's --smoke profile "
+                         "(CPU-sized, minutes)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the planned commands and recording "
+                         "paths without executing")
+    ap.add_argument("--out", default=None,
+                    help="directory for the JSONL recordings "
+                         "(default: temp dir, deleted unless --keep)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the recordings directory")
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="per-bench subprocess timeout, seconds")
+    args = ap.parse_args(argv)
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="tpu_sweep_")
+    os.makedirs(out_dir, exist_ok=True)
+    jobs = plan(args, out_dir)
+
+    if args.dry_run:
+        for name, cmd, rec in jobs:
+            print(f"{name}: MXNET_TELEMETRY_JSONL={rec} "
+                  + " ".join(cmd))
+        print(f"# dry run: 0 of {len(jobs)} benches executed; "
+              f"rows would be checked via telemetry_report.check_serve")
+        return 0
+
+    tables = []
+    try:
+        for name, cmd, rec in jobs:
+            rows = run_job(name, cmd, rec, args.timeout)
+            check_recording(name, rec)
+            tables.append((name, baseline_table(name, rows)))
+    finally:
+        if args.out is None and not args.keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+        elif args.keep or args.out:
+            print(f"# recordings kept in {out_dir}")
+
+    for name, table in tables:
+        print(f"\n## {name}_bench ({'smoke' if args.smoke else 'full'})")
+        print(table)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -906,6 +906,185 @@ class _DecodeEngine:
         # the per-token decode matvecs; each chunk runs once)
         return self._head_logits(xl, None), kp, vp
 
+    def pool_verify_paged(self, toks, pos, pt, page, kp, vp, sw,
+                          q8=None):
+        """Draft-and-verify scoring against the PAGED pool
+        (``mxnet_tpu.serve`` speculative decoding): every slot ``b``
+        carries a block ``toks[b]`` (C,) int32 whose column 0 is the
+        slot's last emitted token (already sampled, not yet attended)
+        and columns 1..C-1 are host-drafted candidates, occupying
+        absolute positions ``pos[b] .. pos[b]+C-1``.  ONE dispatch
+        computes the model's next-token logits at ALL C positions —
+        ``out[b, j]`` is the token the plain step path would have
+        produced after attending position ``pos[b]+j`` — so the caller
+        accepts the longest prefix where ``out[:, :-1]`` matches the
+        drafts.  The block's K/V columns scatter through the page
+        table like ``chunk_tokens``; a rejected tail needs NO undo:
+        its columns sit past the slot's advanced length, hidden by the
+        causal mask and overwritten (write-before-attend) by the next
+        dispatch that reaches those positions, and pages are reserved
+        for the full ``prompt+max_new`` budget at admission, so
+        rollback never moves a refcount.  Structurally this is
+        ``chunk_tokens`` batched over slots — per-row positions ride
+        as a traced (B,) operand (one compiled program per block
+        width C, zero retraces under accept/reject churn), the same
+        mask/softmax/einsum discipline, the same sentinel-row DROP
+        semantics for retired lanes — crossed with ``_scan_token``'s
+        per-slot paged views and q8 head (the parity contract: a
+        verify column's logits come from the same projections and
+        head as the plain step's)."""
+        from ..ops.attention import rope as _rope
+        from ..ops.registry import get_op
+
+        _fc = get_op("FullyConnected").fn
+        _ln = get_op("LayerNorm").fn
+        _rms = get_op("RMSNorm").fn
+        _act = get_op("Activation").fn
+        B, U, H, KV, D = self.B, self.U, self.H, self.KV, self.D
+        T = self.total
+        llama, cdtype = self.is_llama, self.cdtype
+        int8 = self.use_int8
+        eps1, eps2 = self.norm_eps
+        act_t, scale, rope_base = self.act_t, self.scale, self.rope_base
+        _q8l = self._dense_q8
+        C = toks.shape[1]
+        G = H // KV
+        maxp = T // page
+        npages = kp.shape[1]
+        iB = jnp.arange(B)
+        cpos = pos[:, None] + jnp.arange(C, dtype=jnp.int32)   # (B, C)
+        # dense-view write positions: a column past the cache horizon
+        # (a near-budget slot co-resident with a deeper block, or a
+        # zombie lane's stale pos) aims one-past-the-end and DROPS —
+        # clamping instead would overwrite the slot's own live T-1
+        # column before attention.  Such columns are never accepted
+        # (the verify program caps advance at the slot's stop).
+        wpos = jnp.where(cpos < T, cpos, T)
+
+        x = _call(self.model.wte, toks)                    # (B, C, U)
+        if not llama:
+            x = x + _call(self.model.wpe, cpos)
+        # (B, C, T) causal mask over absolute positions: block column j
+        # of slot b sees cached tokens 0..pos[b]+j (itself included
+        # post-update) — a rejected earlier burst's stale columns sit
+        # PAST pos[b]+j and stay masked out
+        mask = jnp.arange(T, dtype=jnp.int32)[None, None, :] <= \
+            cpos[:, :, None]
+
+        def body(x, xs):
+            w, kpl, vpl = xs
+            # per-slot dense (B, KV, T, D) views through the page
+            # table; sentinel rows (retired slots) gather zeros
+            kc = jnp.moveaxis(
+                kpl.at[pt].get(mode="fill", fill_value=0),
+                2, 1).reshape(B, KV, T, D)
+            vc = jnp.moveaxis(
+                vpl.at[pt].get(mode="fill", fill_value=0),
+                2, 1).reshape(B, KV, T, D)
+            if llama:
+                h = _rms(x, w["rms1_g"], eps=eps1)
+                if int8:
+                    # q8_matvec is strictly 2-D: project (B*C, U) rows
+                    h2d = h.reshape(B * C, U)
+                    q = _q8l(h2d, w["q"]).reshape(B, C, H, D)
+                    k = _q8l(h2d, w["k"]).reshape(B, C, KV, D)
+                    v = _q8l(h2d, w["v"]).reshape(B, C, KV, D)
+                else:
+                    q = _fc(h, w["q_w"], None, no_bias=True,
+                            flatten=False).reshape(B, C, H, D)
+                    k = _fc(h, w["k_w"], None, no_bias=True,
+                            flatten=False).reshape(B, C, KV, D)
+                    v = _fc(h, w["v_w"], None, no_bias=True,
+                            flatten=False).reshape(B, C, KV, D)
+                q = q.transpose(0, 2, 1, 3)               # (B, H, C, D)
+                k = k.transpose(0, 2, 1, 3)
+                v = v.transpose(0, 2, 1, 3)
+                # per-slot rotary phase: rope broadcasts a (B,) offset
+                # to per-row absolute positions pos[b] + j
+                q = _rope.__wrapped__(q, base=rope_base,
+                                      position_offset=pos)
+                k = _rope.__wrapped__(k, base=rope_base,
+                                      position_offset=pos)
+            else:
+                h = _ln(x, w["ln1_g"], w["ln1_b"], eps=eps1)
+                qkv = _q8l(h.reshape(B * C, U),
+                           w["qkv"]).reshape(B, C, 3 * U) if int8 \
+                    else _fc(h, w["qkv_w"], w["qkv_b"], flatten=False)
+                q, k, v = (qkv[..., j * U:(j + 1) * U]
+                           .reshape(B, C, H, D).transpose(0, 2, 1, 3)
+                           for j in range(3))
+            k = k.astype(cdtype)
+            v = v.astype(cdtype)
+            # block K/V lands in the dense views BEFORE attention
+            # (per-slot scatter — offsets vary per row), so one mask
+            # covers cached prefix and intra-block causality together
+            kc = kc.at[iB[:, None], :, wpos].set(
+                k.transpose(0, 2, 1, 3), mode="drop")
+            vc = vc.at[iB[:, None], :, wpos].set(
+                v.transpose(0, 2, 1, 3), mode="drop")
+            qg = q.reshape(B, KV, G, C, D)
+            s = jnp.einsum("bkgcd,bktd->bkgct", qg, kc,
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask[:, None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(cdtype)
+            o = jnp.einsum("bkgct,bktd->bkgcd", p, vc)
+            o = o.transpose(0, 3, 1, 2, 4).reshape(B, C, U)
+            if llama:
+                x = x + (_q8l(o.reshape(B * C, U),
+                              w["o"]).reshape(B, C, U) if int8 else
+                         _fc(o, w["o_w"], None, no_bias=True,
+                             flatten=False))
+                h2 = _rms(x, w["rms2_g"], eps=eps2)
+                if int8:
+                    h2d = h2.reshape(B * C, U)
+                    g = _q8l(h2d, w["gate"])
+                    u = _q8l(h2d, w["up"])
+                    x = x + _q8l(g * jax.nn.sigmoid(g) * u,
+                                 w["down"]).reshape(B, C, U)
+                else:
+                    g = _fc(h2, w["gate_w"], None, no_bias=True,
+                            flatten=False)
+                    u = _fc(h2, w["up_w"], None, no_bias=True,
+                            flatten=False)
+                    x = x + _fc(g * jax.nn.sigmoid(g) * u, w["down_w"],
+                                None, no_bias=True, flatten=False)
+            elif int8:
+                x = x + _q8l(o.reshape(B * C, U),
+                             w["proj"]).reshape(B, C, U)
+                h2 = _ln(x, w["ln2_g"], w["ln2_b"], eps=eps2)
+                x = x + _q8l(_q8l(h2.reshape(B * C, U), w["fc1"],
+                                  act_t), w["fc2"]).reshape(B, C, U)
+            else:
+                x = x + _fc(o, w["proj_w"], w["proj_b"], flatten=False)
+                h2 = _ln(x, w["ln2_g"], w["ln2_b"], eps=eps2)
+                hh = _fc(h2, w["fc1_w"], w["fc1_b"], flatten=False)
+                if act_t is not None:
+                    hh = _act(hh, act_type=act_t)
+                x = x + _fc(hh, w["fc2_w"], w["fc2_b"], flatten=False)
+            return x, (k, v)
+
+        x, (knew, vnew) = lax.scan(body, x, (sw, kp, vp))
+        # knew/vnew: (NL, B, KV, C, D) — scatter every block column of
+        # every slot through its page-table row.  Out-of-range columns
+        # (zombie lanes past T) resolve to the sentinel and DROP; the
+        # cpos < T guard keeps them from CLIPPING onto a live page.
+        pgs = jnp.where(cpos < T,
+                        pt[iB[:, None], jnp.minimum(cpos // page,
+                                                    maxp - 1)],
+                        npages)                            # (B, C)
+        offs = cpos % page
+        # result dims of the non-adjacent advanced indices go FIRST:
+        # value shape (B, C, NL, KV, D)
+        kp = kp.at[:, pgs, :, offs, :].set(
+            jnp.transpose(knew, (1, 3, 0, 2, 4)), mode="drop")
+        vp = vp.at[:, pgs, :, offs, :].set(
+            jnp.transpose(vnew, (1, 3, 0, 2, 4)), mode="drop")
+        xl = _call(self.model.ln_f, x)
+        # same head as the plain step (q8 when int8) — the greedy
+        # parity contract: out[b, 0]'s logits == the step path's
+        logits = self._head_logits(xl.reshape(B * C, U), q8)
+        return logits.reshape(B, C, -1), kp, vp
+
     def fused_token(self, x_tok, pos, ck, cv, packed_t, q8=None):
         """one_token's Pallas twin: embeddings and head stay XLA ops;
         every transformer layer runs inside ONE Pallas kernel

@@ -11,9 +11,11 @@ K/V cache (``docs/SERVING.md``).
         ...
     server.close()
 """
+from .draft import Drafter, NGramDrafter
 from .server import (DecodeServer, TokenStream, serve_counters,
                      reset_serve_counters)
 from .engine import PoolPrograms
 
 __all__ = ["DecodeServer", "TokenStream", "PoolPrograms",
+           "Drafter", "NGramDrafter",
            "serve_counters", "reset_serve_counters"]

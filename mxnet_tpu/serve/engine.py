@@ -67,9 +67,10 @@ __all__ = ["PoolPrograms", "PagePool", "pool_state_init",
            "pool_state_grow", "pool_state_bytes"]
 
 
-# per-slot scalar state bytes: pos/tok/stop int32 (12) + active bool (1)
-# + PRNG key 2x uint32 (8) + deadline float32 (4) — see pool_state_init
-_SLOT_STATE_BYTES = 25
+# per-slot scalar state bytes: pos/tok/stop/spec int32 (16) + active
+# bool (1) + PRNG key 2x uint32 (8) + deadline float32 (4) — see
+# pool_state_init
+_SLOT_STATE_BYTES = 29
 
 
 class PagePool:
@@ -144,12 +145,16 @@ def pool_state_bytes(progs, num_slots=None, num_pages=None):
 
 def pool_state_init(progs, device=None):
     """Fresh all-idle pool state for ``progs``: ``(kp, vp, pos, tok,
-    active, stop, keys, deadline)`` — the traced-operand set every
-    step/admit/hit/chunk executable threads through (the page TABLES are
-    not in it: they are host numpy, rebuilt per dispatch).  ``deadline``
-    is the per-slot wall-clock retirement budget (seconds on the
-    server's monotonic epoch; ``+inf`` = none), checked ON DEVICE by the
-    step against its ``now`` operand.
+    active, stop, keys, deadline, spec)`` — the traced-operand set every
+    step/admit/hit/chunk/verify executable threads through (the page
+    TABLES are not in it: they are host numpy, rebuilt per dispatch).
+    ``deadline`` is the per-slot wall-clock retirement budget (seconds
+    on the server's monotonic epoch; ``+inf`` = none), checked ON
+    DEVICE by the step against its ``now`` operand; ``spec`` is the
+    per-slot speculation-depth cap (0 = never speculate) the verify
+    program clamps draft acceptance against — riding the slot-state
+    vector like keys and deadlines do, so per-request depth never
+    shapes a trace.
 
     Every array is COMMITTED to ``device`` (default: the backend's
     first device).  jit keys its executable cache on each argument's
@@ -169,7 +174,8 @@ def pool_state_init(progs, device=None):
              jnp.zeros((S,), jnp.bool_),     # active
              jnp.zeros((S,), jnp.int32),     # stop: retire position
              jnp.zeros((S, 2), jnp.uint32),  # per-slot PRNG keys
-             jnp.full((S,), jnp.inf, jnp.float32))  # per-slot deadline
+             jnp.full((S,), jnp.inf, jnp.float32),  # per-slot deadline
+             jnp.zeros((S,), jnp.int32))     # spec: speculation depth
     return jax.device_put(state, device)
 
 
@@ -182,7 +188,7 @@ def pool_state_grow(state, new_s, new_pages=None):
     sentinel moves with the page count: rows must be rebuilt against
     the grown pool before the next dispatch (the server regenerates
     them from its allocator every dispatch, so this is automatic)."""
-    kp, vp, pos, tok, active, stop, keys, dl = state
+    kp, vp, pos, tok, active, stop, keys, dl, spec = state
     grow = new_s - pos.shape[0]
     if grow <= 0:
         raise MXNetError(f"pool can only grow: {pos.shape[0]} -> "
@@ -197,7 +203,8 @@ def pool_state_grow(state, new_s, new_pages=None):
              pad(tok, 0, grow), pad(active, 0, grow), pad(stop, 0, grow),
              pad(keys, 0, grow),
              # idle-lane deadlines pad as +inf, matching pool_state_init
-             jnp.pad(dl, (0, grow), constant_values=jnp.inf))
+             jnp.pad(dl, (0, grow), constant_values=jnp.inf),
+             pad(spec, 0, grow))
     # committed placement, same contract as pool_state_init
     return jax.device_put(grown, list(kp.devices())[0])
 
@@ -252,6 +259,7 @@ class PoolPrograms:
         self._admits = {}          # (A, P) bucket pair -> jitted fn
         self._hits = {}            # A bucket -> jitted hit-admission fn
         self._chunks = {}          # C bucket -> jitted chunk-prefill fn
+        self._verifies = {}        # k bucket -> jitted verify fn
 
     def page_bytes(self):
         """Device bytes of ONE page across all layers, K and V pools
@@ -314,7 +322,7 @@ class PoolPrograms:
         page = self.page
 
         def step(param_vals, q8, sw, now, pt, kp, vp, pos, tok, active,
-                 stop, keys, dl):
+                 stop, keys, dl, spec):
             with _TRACE_LOCK, params_swapped(deng.params, param_vals):
                 logits, kp, vp = deng.pool_token_paged(
                     tok, pos, kp, vp, pt, page, sw, q8)
@@ -324,7 +332,7 @@ class PoolPrograms:
             done = eng._retire_flags(active, nxt, newpos, stop, now, dl)
             emitted = active
             new_state = (kp, vp, newpos, nxt, active & ~done, stop,
-                         keys, dl)
+                         keys, dl, spec)
             return new_state, (nxt, emitted, done)
 
         self._step = telemetry.instrument_jit(
@@ -340,10 +348,11 @@ class PoolPrograms:
         """The jitted BATCHED admission program for a wave of up to
         ``a_bucket`` prompts right-padded to ``p_bucket`` tokens (cached
         per ``(A, P)`` bucket pair): ``admit(param_vals, prompts
-        (A, P) int32, meta (A, 5) int32 rows = [valid, true_len, slot,
-        stop_pos, seed], dls (A,) float32 per-row deadlines, pages
-        (A, NPB) int32 reserved-page rows, kp, vp, pos, tok, active,
-        stop, keys, dl)`` → new state + ``(first_tok (A,), done (A,))``.
+        (A, P) int32, meta (A, 6) int32 rows = [valid, true_len, slot,
+        stop_pos, seed, spec_depth], dls (A,) float32 per-row deadlines,
+        pages (A, NPB) int32 reserved-page rows, kp, vp, pos, tok,
+        active, stop, keys, dl, spec)`` → new state + ``(first_tok
+        (A,), done (A,))``.
 
         ONE causal prefill over the whole block fills a dense ``(A,
         Ppad)`` scratch cache, which lands in the wave's RESERVED PAGES
@@ -379,10 +388,11 @@ class PoolPrograms:
         NL, KV, D = peng.NL, peng.KV, peng.D
 
         def admit(param_vals, prompts, meta, dls, pages, kp, vp, pos,
-                  tok, active, stop, keys, dl):
+                  tok, active, stop, keys, dl, spec):
             valid = meta[:, 0] != 0
             true_len, slot, stop_pos, seed = (meta[:, 1], meta[:, 2],
                                               meta[:, 3], meta[:, 4])
+            spec_d = meta[:, 5]
             keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
             with _TRACE_LOCK, params_swapped(peng.params, param_vals):
                 ck1, cv1 = peng.zero_caches()
@@ -415,7 +425,8 @@ class PoolPrograms:
             stop = stop.at[tgt].set(stop_pos, mode="drop")
             keys = keys.at[tgt].set(keys_a, mode="drop")
             dl = dl.at[tgt].set(dls, mode="drop")
-            new_state = (kp, vp, pos, tok, active, stop, keys, dl)
+            spec = spec.at[tgt].set(spec_d, mode="drop")
+            new_state = (kp, vp, pos, tok, active, stop, keys, dl, spec)
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
@@ -432,11 +443,11 @@ class PoolPrograms:
 
     def admit_hit_fn(self, a_bucket):
         """The jitted PREFIX-CACHE-HIT admission program for up to
-        ``a_bucket`` rows (cached per bucket): ``hit(meta (A, 6) int32
-        rows = [valid, true_len, slot, stop_pos, seed, last_tok], dls
-        (A,), src (A,), dst (A,), kp, vp, pos, tok, active, stop, keys,
-        dl)`` → new state (no readback: a hit emits nothing at
-        admission).
+        ``a_bucket`` rows (cached per bucket): ``hit(meta (A, 7) int32
+        rows = [valid, true_len, slot, stop_pos, seed, last_tok,
+        spec_depth], dls (A,), src (A,), dst (A,), kp, vp, pos, tok,
+        active, stop, keys, dl, spec)`` → new state (no readback: a hit
+        emits nothing at admission).
 
         NO model forward runs: the host has already mapped the shared
         prefix pages into the slot's table row, so admission is a
@@ -459,11 +470,12 @@ class PoolPrograms:
             raise MXNetError(f"admission bucket {A} must be >= 1")
 
         def hit(meta, dls, src, dst, kp, vp, pos, tok, active, stop,
-                keys, dl):
+                keys, dl, spec):
             valid = meta[:, 0] != 0
             true_len, slot, stop_pos, seed, last_tok = (
                 meta[:, 1], meta[:, 2], meta[:, 3], meta[:, 4],
                 meta[:, 5])
+            spec_d = meta[:, 6]
             keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
             # copy-on-write boundary pages: one gather + one masked
             # scatter covers the whole wave's copies
@@ -478,7 +490,8 @@ class PoolPrograms:
             stop = stop.at[tgt].set(stop_pos, mode="drop")
             keys = keys.at[tgt].set(keys_a, mode="drop")
             dl = dl.at[tgt].set(dls, mode="drop")
-            return (kp, vp, pos, tok, active, stop, keys, dl)
+            spec = spec.at[tgt].set(spec_d, mode="drop")
+            return (kp, vp, pos, tok, active, stop, keys, dl, spec)
 
         fn = telemetry.instrument_jit(
             jax.jit(hit, donate_argnums=(4, 5)), "serve.admit_hit",
@@ -491,10 +504,11 @@ class PoolPrograms:
     def chunk_fn(self, c_bucket):
         """The jitted CHUNKED-PREFILL program for one ``C``-token slice
         of a single prompt (cached per chunk bucket): ``chunk(
-        param_vals, q8, sw, toks (C,) int32, meta (7,) int32 =
-        [final, slot, true_len, stop_pos, seed, nlast, off], dls
-        scalar f32, ptrow (MAXP,) int32, kp, vp, pos, tok, active,
-        stop, keys, dl)`` → new state + ``(first_tok, done)`` scalars.
+        param_vals, q8, sw, toks (C,) int32, meta (8,) int32 =
+        [final, slot, true_len, stop_pos, seed, nlast, off,
+        spec_depth], dls scalar f32, ptrow (MAXP,) int32, kp, vp, pos,
+        tok, active, stop, keys, dl, spec)`` → new state +
+        ``(first_tok, done)`` scalars.
 
         The slice occupies absolute positions ``off .. off+C-1`` of the
         slot whose page-table row is ``ptrow`` (``off`` is TRACED — one
@@ -522,10 +536,11 @@ class PoolPrograms:
         page = self.page
 
         def chunk(param_vals, q8, sw, toks, meta, dls, ptrow, kp, vp,
-                  pos, tok, active, stop, keys, dl):
+                  pos, tok, active, stop, keys, dl, spec):
             final, slot, true_len, stop_pos, seed, nlast, off = (
                 meta[0], meta[1], meta[2], meta[3], meta[4], meta[5],
                 meta[6])
+            spec_d = meta[7]
             key1 = jax.random.PRNGKey(seed)                   # (2,)
             with _TRACE_LOCK, params_swapped(deng.params, param_vals):
                 logits, kp, vp = deng.chunk_tokens(
@@ -545,7 +560,8 @@ class PoolPrograms:
             stop = stop.at[tgt].set(stop_pos, mode="drop")
             keys = keys.at[tgt].set(key1, mode="drop")
             dl = dl.at[tgt].set(dls, mode="drop")
-            new_state = (kp, vp, pos, tok, active, stop, keys, dl)
+            spec = spec.at[tgt].set(spec_d, mode="drop")
+            new_state = (kp, vp, pos, tok, active, stop, keys, dl, spec)
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
@@ -556,4 +572,100 @@ class PoolPrograms:
                     # one slot's dense gather scratch per layer slice
                     "cache_bytes": self.eng.cache_bytes() // self.S})
         self._chunks[C] = fn
+        return fn
+
+    def verify_fn(self, k_bucket):
+        """The jitted DRAFT-AND-VERIFY program for up to ``k_bucket``
+        drafted tokens per slot (cached per k bucket, the PR-8 ladder
+        discipline — compile count is bounded by the pinned k ladder,
+        and accept/reject churn only changes operand VALUES):
+        ``verify(param_vals, q8, sw, now, pt, drafts (S, k) int32,
+        nd (S,) int32 drafts-actually-proposed per slot, kp, vp, pos,
+        tok, active, stop, keys, dl, spec)`` → new state +
+        ``(out (S, K), adv (S,), done (S,))``.
+
+        ONE pool-step-shaped dispatch scores ``K = k + 1`` positions
+        per slot (column 0 is the slot's last emitted token, not yet
+        attended — a slot with ``nd = 0`` drafts runs a plain step
+        through it): ``out[s, j]`` is the greedy token the plain step
+        path would emit after position ``pos[s] + j``, so the device
+        accepts the longest prefix where ``out[:, :-1]`` matches the
+        drafts (clamped by ``nd``, the slot-state ``spec`` cap, EOS,
+        and the slot's remaining ``stop`` budget) and advances
+        ``adv = accepted + 1`` positions.  The block's K/V columns are
+        already in the paged pool; a REJECTED tail needs no undo — its
+        columns sit past the advanced ``pos``, masked off causally and
+        overwritten before the next attend, and pages were reserved
+        for the full budget at admission, so rollback is the length
+        update alone (never a copy, never a refcount).  Greedy only:
+        acceptance compares argmax tokens, which is exact for
+        ``temperature == 0`` — the server keeps sampled slots on the
+        plain depth-1 step (rejection sampling is out of scope)."""
+        k = int(k_bucket)
+        fn = self._verifies.get(k)
+        if fn is not None:
+            return fn
+        if k < 1:
+            raise MXNetError(f"verify bucket {k} must be >= 1")
+        if self.temperature != 0.0:
+            raise MXNetError(
+                "draft-and-verify acceptance is exact only for greedy "
+                f"decoding; temperature={self.temperature} slots must "
+                "run the plain step (rejection sampling is out of "
+                "scope for v1)")
+        from ..gluon.parameter import params_swapped
+
+        eng = self
+        deng = self.eng
+        page = self.page
+        S, K = self.S, k + 1
+
+        def verify(param_vals, q8, sw, now, pt, drafts, nd, kp, vp,
+                   pos, tok, active, stop, keys, dl, spec):
+            toks = jnp.concatenate([tok[:, None], drafts], axis=1)
+            with _TRACE_LOCK, params_swapped(deng.params, param_vals):
+                logits, kp, vp = deng.pool_verify_paged(
+                    toks, pos, pt, page, kp, vp, sw, q8)
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S,K)
+            # longest accepted prefix: draft j survives iff every
+            # draft 0..j matched the model's own emission AND j is
+            # inside both the proposed count and the slot's spec cap
+            lim = jnp.minimum(nd, spec)
+            ok = (out[:, :-1] == drafts) & \
+                (jnp.arange(K - 1, dtype=jnp.int32)[None, :] <
+                 lim[:, None])
+            acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                          axis=1)
+            adv = acc + 1
+            if self.eos_id is not None:
+                # an emitted EOS ends the stream: nothing past the
+                # first one may be emitted, exactly like the step path
+                iK = jnp.arange(K, dtype=jnp.int32)
+                first_eos = jnp.min(
+                    jnp.where(out == self.eos_id, iK[None, :], K),
+                    axis=1)
+                adv = jnp.minimum(adv, first_eos + 1)
+            # never advance past the slot's stop position (its last
+            # block columns were computed but are not emitted)
+            adv = jnp.minimum(adv, jnp.maximum(stop - pos, 1))
+            adv = jnp.where(active, adv, 0)
+            nxt = jnp.where(
+                active,
+                out[jnp.arange(S), jnp.maximum(adv, 1) - 1], tok)
+            newpos = pos + adv
+            done = eng._retire_flags(active, nxt, newpos, stop, now,
+                                     dl)
+            new_state = (kp, vp, newpos, nxt, active & ~done, stop,
+                         keys, dl, spec)
+            return new_state, (out, adv, done)
+
+        fn = telemetry.instrument_jit(
+            jax.jit(verify, donate_argnums=(7, 8)), "serve.verify",
+            key=(self.telemetry_label, self.S, K),
+            fields={"server": self.telemetry_label, "pool": self.S,
+                    "k_bucket": k,
+                    # the verify block widens the step's dense gather
+                    # scratch K-fold at the attention tail
+                    "cache_bytes": self.eng.cache_bytes()})
+        self._verifies[k] = fn
         return fn

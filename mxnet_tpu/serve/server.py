@@ -70,8 +70,21 @@ next step boundary without touching co-resident lanes.  A scheduler
 watchdog fails every in-flight stream with the underlying error when
 the pump thread dies or a dispatch wedges past
 ``MXNET_SERVE_STEP_TIMEOUT`` — no consumer ever blocks forever — and
-pump/admit/step are ``MXNET_FAULT_INJECT`` sites so all of it is
-exercised deterministically in tier-1 (docs/SERVING.md).
+pump/admit/step/verify are ``MXNET_FAULT_INJECT`` sites so all of it
+is exercised deterministically in tier-1 (docs/SERVING.md).
+
+Speculative decoding (ISSUE 17): on greedy servers a cheap host-side
+drafter (``serve.draft.NGramDrafter`` by default; any
+``serve.draft.Drafter`` plugs in) proposes up to
+``MXNET_SERVE_SPEC_DEPTH`` continuation tokens per slot between
+steps, and ONE bucketed ``(S, k)`` verify dispatch
+(``PoolPrograms.verify_fn``, k pinned to the ``MXNET_SERVE_SPEC_SIZES``
+ladder) scores every proposal and accepts each slot's longest
+matching prefix device-side — several tokens per dispatch when the
+drafts land, exactly one (the plain-step guarantee) when they don't.
+Greedy streams stay token-for-token identical to ``kv_generate``;
+sampled pools never draft (acceptance compares argmax tokens, exact
+only at temperature 0).  ``MXNET_SERVE_SPEC=0`` is the escape hatch.
 """
 from __future__ import annotations
 
@@ -102,7 +115,9 @@ __all__ = ["DecodeServer", "TokenStream", "serve_counters",
 serve_counters = {"step_dispatches": 0, "admit_dispatches": 0,
                   "sync_requests": 0, "pool_grows": 0,
                   "prefix_hits": 0, "cow_copies": 0,
-                  "chunk_dispatches": 0}
+                  "chunk_dispatches": 0, "verify_dispatches": 0,
+                  "draft_proposed": 0, "draft_accepted": 0,
+                  "draft_rejected": 0}
 _counters_lock = threading.Lock()
 _server_seq = itertools.count()
 
@@ -128,7 +143,8 @@ class _CounterView(MutableMapping):
 
     _KEYS = ("step_dispatches", "admit_dispatches", "sync_requests",
              "pool_grows", "prefix_hits", "cow_copies",
-             "chunk_dispatches")
+             "chunk_dispatches", "verify_dispatches",
+             "draft_proposed", "draft_accepted", "draft_rejected")
 
     def __init__(self, server_label):
         self._c = {k: telemetry.counter(f"serve_{k}_total",
@@ -205,6 +221,40 @@ def _prefix_cache_from_env():
     """``MXNET_SERVE_PREFIX_CACHE``: 0 disables copy-on-write shared-
     prefix caching (default on)."""
     return os.environ.get("MXNET_SERVE_PREFIX_CACHE", "1") != "0"
+
+
+def _spec_from_env():
+    """``MXNET_SERVE_SPEC``: 0 disables speculative draft-and-verify
+    decoding (default on; it only engages on greedy servers —
+    sampled pools always run plain depth-1 steps)."""
+    return os.environ.get("MXNET_SERVE_SPEC", "1") != "0"
+
+
+def _spec_depth_from_env():
+    """``MXNET_SERVE_SPEC_DEPTH``: max draft tokens proposed per slot
+    per verify dispatch (default 4; 0 disables speculation, same as
+    ``MXNET_SERVE_SPEC=0``)."""
+    raw = os.environ.get("MXNET_SERVE_SPEC_DEPTH", "4")
+    try:
+        depth = int(raw)
+    except ValueError:
+        raise MXNetError(f"MXNET_SERVE_SPEC_DEPTH={raw!r}: expected "
+                         "a non-negative integer draft depth")
+    if depth < 0:
+        raise MXNetError(f"MXNET_SERVE_SPEC_DEPTH={raw!r}: draft "
+                         "depth must be >= 0")
+    return depth
+
+
+def _spec_sizes_from_env(depth):
+    """``MXNET_SERVE_SPEC_SIZES``: the pinned k-bucket ladder for the
+    verify executable — compile count is bounded by its length, the
+    PR-8 admit-ladder discipline.  Default: powers of two up to the
+    speculation depth."""
+    raw = os.environ.get("MXNET_SERVE_SPEC_SIZES")
+    if raw is None:
+        return tuple(_pow2_ladder(1, max(depth, 1)))
+    return _parse_sizes("MXNET_SERVE_SPEC_SIZES", raw, "draft depths")
 
 
 def _parse_seconds(var, raw):
@@ -406,6 +456,11 @@ class TokenStream:
         self._error = None
         self._cancel_hook = None   # wired by DecodeServer.submit
         self._cancelled = False
+        # speculative-decoding ledger (scheduler-thread writes at
+        # verify drains): draft tokens the verify dispatches accepted
+        # into THIS stream vs proposed-but-rejected
+        self.draft_accepted = 0
+        self.draft_rejected = 0
 
     # -- producer side (server loop) ------------------------------------ #
     @property
@@ -466,6 +521,15 @@ class TokenStream:
         """True once :meth:`cancel` has taken effect (the stream is
         done with the tokens that arrived before cancellation)."""
         return self._cancelled
+
+    @property
+    def accept_rate(self):
+        """Fraction of this request's proposed draft tokens the
+        verify dispatches accepted (0.0 while nothing has been
+        proposed; 1.0 means every draft matched the model's own
+        greedy emission)."""
+        total = self.draft_accepted + self.draft_rejected
+        return self.draft_accepted / total if total else 0.0
 
     def cancel(self):
         """Cancel this request: a queued request is dropped
@@ -553,8 +617,10 @@ class DecodeServer:
                  admit_sizes=None, prefill_buckets=None,
                  hbm_budget=None, default_deadline=None,
                  step_timeout=None, page_size=None, num_pages=None,
-                 prefix_cache=None, autostart=True):
+                 prefix_cache=None, spec=None, spec_depth=None,
+                 spec_sizes=None, drafter=None, autostart=True):
         from ..telemetry.memory import parse_bytes
+        from .draft import NGramDrafter
         from .engine import PagePool, PoolPrograms, pool_state_init
 
         self.model = model
@@ -641,6 +707,33 @@ class DecodeServer:
         self._num_pages_fixed = num_pages is not None
         self.prefix_cache_enabled = bool(prefix_cache) \
             if prefix_cache is not None else _prefix_cache_from_env()
+        # speculative decoding knobs (ISSUE 17): draft-and-verify is
+        # GREEDY-ONLY (acceptance compares argmax tokens — exact at
+        # temperature 0, wrong otherwise), gated HERE so a sampled
+        # server never builds a verify program.  Depth is clamped to
+        # the largest pinned k bucket; a 0 depth disables speculation
+        # like MXNET_SERVE_SPEC=0 does.
+        self.spec_depth = int(spec_depth) if spec_depth is not None \
+            else _spec_depth_from_env()
+        if self.spec_depth < 0:
+            raise MXNetError(f"spec_depth must be >= 0, "
+                             f"got {self.spec_depth}")
+        self.spec_sizes = tuple(spec_sizes) \
+            if spec_sizes is not None \
+            else _spec_sizes_from_env(self.spec_depth)
+        if not self.spec_sizes \
+                or list(self.spec_sizes) != sorted(set(self.spec_sizes)) \
+                or self.spec_sizes[0] < 1:
+            raise MXNetError(f"spec_sizes {self.spec_sizes} must be "
+                             "strictly increasing positive draft "
+                             "depths")
+        self.spec_depth = min(self.spec_depth, self.spec_sizes[-1])
+        self.spec_enabled = ((bool(spec) if spec is not None
+                              else _spec_from_env())
+                             and self.spec_depth > 0
+                             and temperature == 0.0)
+        self._drafter = drafter if drafter is not None \
+            else NGramDrafter()
         # per-server telemetry identity: labels this server's registry
         # counters/histograms and its compile / serve_* events
         self.telemetry_label = f"srv{next(_server_seq)}"
@@ -754,7 +847,9 @@ class DecodeServer:
             page_size=self.page_size,
             num_pages=None if self.sync_mode
             else self._progs.num_pages,
-            prefix_cache=self.prefix_cache_enabled)
+            prefix_cache=self.prefix_cache_enabled,
+            spec=self.spec_enabled, spec_depth=self.spec_depth,
+            spec_sizes=list(self.spec_sizes))
         if autostart:
             self.start()
 
@@ -882,9 +977,15 @@ class DecodeServer:
             self._work.notify_all()
         return stream
 
-    def _count(self, key):
-        self.counters.inc(key)
-        _bump(key)
+    def _count(self, key, n=1):
+        self.counters.inc(key, n)
+        _bump(key, n)
+
+    def _slot_spec_depth(self, req):
+        """The speculation-depth cap scattered into a slot's state row
+        at admission (0 = never speculate; the device clamps accepted
+        drafts to it even if a buggy drafter over-proposes)."""
+        return self.spec_depth if self.spec_enabled else 0
 
     def reset_counters(self):
         """Zero the per-server dispatch counters AND the step/occupancy
@@ -905,10 +1006,21 @@ class DecodeServer:
         histogram summaries) — the serving face of
         ``telemetry.snapshot()``."""
         S = len(self._slots)
+        acc = self.counters["draft_accepted"]
+        rej = self.counters["draft_rejected"]
         return {
             "server": self.telemetry_label,
             "num_slots": S,
             "steps": self._steps,
+            # speculative-decoding face: the per-server draft ledger
+            # plus the accept rate the benches report (accepted +
+            # rejected == proposed is the --check-serve invariant)
+            "spec": self.spec_enabled,
+            "spec_depth": self.spec_depth,
+            "draft_accepted": acc,
+            "draft_rejected": rej,
+            "draft_accept_rate": acc / (acc + rej)
+            if (acc + rej) else 0.0,
             "occupancy": (self._occupied_lane_steps /
                           self._capacity_lane_steps
                           if self._capacity_lane_steps else 0.0),
@@ -1014,16 +1126,34 @@ class DecodeServer:
         stepped = False
         # slots mid-chunked-prefill don't step (their lanes activate at
         # the final chunk); only genuinely live lanes justify a dispatch
-        if any(r is not None and i not in self._chunk_slots
-               for i, r in enumerate(self._slots)):
-            self._dispatch_step()
-            worked = stepped = True
+        if self._live_slots():
+            drafts = None
+            if self.spec_enabled:
+                # drafts must chain off each slot's NEWEST device
+                # token, which is still in flight until the previous
+                # dispatch drains — speculation trades the one-dispatch
+                # host/device overlap for multi-token dispatches
+                # (docs/SERVING.md); draining here may retire slots, so
+                # the liveness check repeats below
+                worked |= self._flush_drain()
+                if self._live_slots():
+                    drafts = self._build_drafts()
+            if drafts:
+                self._dispatch_verify(drafts)
+                worked = stepped = True
+            elif self._live_slots():
+                self._dispatch_step()
+                worked = stepped = True
         # drain PREVIOUS dispatches' readbacks: while stepping, the
         # newest dispatch stays in flight so the device computes it
         # while the host routes the older (S,)-sized arrays; once the
         # loop stops stepping, everything drains so streams finish
         worked |= self._flush_drain(keep=1 if stepped else 0)
         return worked
+
+    def _live_slots(self):
+        return any(r is not None and i not in self._chunk_slots
+                   for i, r in enumerate(self._slots))
 
     def _loop(self):
         while True:
@@ -1493,7 +1623,7 @@ class DecodeServer:
         prompts = onp.zeros((A, P), onp.int32)
         # idle rows: valid=0 (their scatter drops on device); true_len
         # stays 1 so the per-row last-index gather reads a real column
-        meta = onp.zeros((A, 5), onp.int32)
+        meta = onp.zeros((A, 6), onp.int32)
         meta[:, 1] = 1
         # per-row wall-clock deadlines (server-epoch seconds; +inf =
         # none), scattered into the slot-state deadline vector the
@@ -1506,7 +1636,8 @@ class DecodeServer:
         for i, (slot, req) in enumerate(wave):
             n = req.prompt.size
             prompts[i, :n] = req.prompt
-            meta[i] = (1, n, slot, n + req.max_new - 1, req.seed)
+            meta[i] = (1, n, slot, n + req.max_new - 1, req.seed,
+                       self._slot_spec_depth(req))
             if req.deadline is not None:
                 dls[i] = req.deadline - self._epoch
             row = self._slot_pages[slot]
@@ -1669,7 +1800,7 @@ class DecodeServer:
         fn = self._progs.admit_hit_fn(A)
         self._watch_dispatch(fn)
         sentinel = self._progs.num_pages
-        meta = onp.zeros((A, 6), onp.int32)
+        meta = onp.zeros((A, 7), onp.int32)
         meta[:, 1] = 1
         dls = onp.full((A,), onp.inf, onp.float32)
         srcs = onp.full((A,), sentinel, onp.int32)
@@ -1682,7 +1813,8 @@ class DecodeServer:
             slot, req = plan["slot"], plan["req"]
             L = req.prompt.size
             meta[i] = (1, L, slot, L + req.max_new - 1, req.seed,
-                       int(req.prompt[-1]))
+                       int(req.prompt[-1]),
+                       self._slot_spec_depth(req))
             if req.deadline is not None:
                 dls[i] = req.deadline - self._epoch
             if plan["src"] >= 0:
@@ -1757,7 +1889,8 @@ class DecodeServer:
         toks[:ntok] = req.prompt[off:off + ntok]
         meta = onp.asarray(
             [1 if final else 0, slot, L, L + req.max_new - 1,
-             req.seed, (L - 1 - off) if final else C - 1, off],
+             req.seed, (L - 1 - off) if final else C - 1, off,
+             self._slot_spec_depth(req)],
             onp.int32)
         dl = onp.float32(onp.inf if req.deadline is None
                          else req.deadline - self._epoch)
@@ -1790,6 +1923,80 @@ class DecodeServer:
             self._inflight.append(("admit", (first, done),
                                    [(slot, req)]))
         return final
+
+    # speculative decoding -------------------------------------------------- #
+    def _build_drafts(self):
+        """Host-side draft proposals for this pump, ``{slot: 1-D int32
+        drafts}``; ``None`` when no slot proposed anything (the pump
+        takes a plain step, costing exactly what it costs with
+        speculation off).  Drafts chain off the last token ROUTED to
+        each stream, so a just-admitted slot — including a prefix-
+        cache hit, whose first step RECOMPUTES the final prompt
+        position (ISSUE 16) — proposes nothing until its first step
+        drains: the speculation ramp-in the COW semantics require
+        falls out of the drain ordering for free."""
+        drafts = {}
+        for slot, req in enumerate(self._slots):
+            if req is None or req.cancelled \
+                    or slot in self._chunk_slots:
+                continue
+            toks = req.stream._toks
+            if not toks:
+                continue   # no routed token to chain from yet
+            # the verify block emits up to k + 1 tokens; never draft
+            # past the request's remaining budget (the device clamps
+            # too — this just avoids wasted columns)
+            k = min(self.spec_depth, req.max_new - len(toks) - 1)
+            if k < 1:
+                continue
+            hist = onp.concatenate(
+                [req.prompt, onp.asarray(toks, onp.int32)])
+            prop = self._drafter.propose(hist, k)
+            if prop is not None and len(prop):
+                drafts[slot] = onp.asarray(
+                    prop, onp.int32).reshape(-1)[:k]
+        return drafts or None
+
+    def _dispatch_verify(self, drafts):
+        """ONE bucketed ``(S, k)`` draft-and-verify dispatch for this
+        pump's proposals (k = smallest pinned spec bucket that fits
+        the longest draft): column 0 replays each slot's device-held
+        last token — a plain step for slots that proposed nothing —
+        and the executable accepts each slot's longest matching
+        prefix device-side.  Accepted K/V columns are already in the
+        paged pool; rejected tails need no undo (pages were reserved
+        all-or-nothing at admission, so rollback is the device-side
+        position simply not advancing — never a copy, never a
+        refcount; docs/SERVING.md)."""
+        fault_point("serve.verify", server=self.telemetry_label)
+        k = _bucket_for(self.spec_sizes,
+                        max(d.size for d in drafts.values()))
+        fn = self._progs.verify_fn(k)
+        self._watch_dispatch(fn)
+        S = len(self._slots)
+        block = onp.zeros((S, k), onp.int32)
+        nd = onp.zeros((S,), onp.int32)
+        for slot, d in drafts.items():
+            nd[slot] = d.size
+            block[slot, :d.size] = d
+        param_vals, q8, sw = self._progs.operands
+        now = onp.float32(self._clock() - self._epoch)
+        with telemetry.annotation("mx:serve:verify"):
+            new_state, out = fn(param_vals, q8, sw, now,
+                                self._page_table(), block, nd,
+                                *self._state)
+        self._state = new_state
+        if self._torn:
+            self._state = None
+            return
+        self._count("verify_dispatches")
+        busy = sum(r is not None for r in self._slots)
+        self._occupied_lane_steps += busy
+        self._capacity_lane_steps += S
+        self._tele["occ"].set(busy / S)
+        self._tele["pages"].set(self._pages.in_use)
+        self._inflight.append(("verify", out,
+                               (list(self._slots), nd, k)))
 
     # the step ------------------------------------------------------------ #
     def _dispatch_step(self):
@@ -1873,6 +2080,8 @@ class DecodeServer:
             worked = True
             if kind == "admit":
                 self._route_admit(arrays, meta)
+            elif kind == "verify":
+                self._route_verify(arrays, meta)
             else:
                 toks, emitted, done = (onp.asarray(a) for a in arrays)
                 snapshot = meta
@@ -1894,6 +2103,56 @@ class DecodeServer:
                         if freed:
                             self._free_slot_pages(slot)
         return worked
+
+    def _route_verify(self, arrays, meta):
+        """Route one verify dispatch's ``(tokens (S, K), advance (S,),
+        done (S,))`` readback: every live lane emits its accepted
+        prefix plus the executable's own next token (``advance``
+        tokens, >= 1 — a slot that proposed nothing gets its plain-
+        step token through column 0), and the draft ledgers advance by
+        exactly what each surviving stream's proposals resolved to, so
+        accepted + rejected == proposed holds per stream, per server
+        and in the recording (``telemetry_report --check-serve``
+        re-derives it)."""
+        toks, adv, done = (onp.asarray(a) for a in arrays)
+        snapshot, nd, k_bucket = meta
+        proposed_t = accepted_t = rejected_t = 0
+        for slot, req in enumerate(snapshot):
+            if req is None or req.cancelled:
+                continue
+            n = int(adv[slot])
+            if n < 1:
+                continue   # masked lane (inactive this dispatch)
+            for t in toks[slot, :n]:
+                req.stream._push(int(t))
+            proposed = int(nd[slot])
+            if proposed:
+                accepted = n - 1
+                rejected = proposed - accepted
+                req.stream.draft_accepted += accepted
+                req.stream.draft_rejected += rejected
+                proposed_t += proposed
+                accepted_t += accepted
+                rejected_t += rejected
+            if done[slot]:
+                req.stream._finish()
+                self._observe_retire(
+                    req,
+                    self._retire_reason(req, int(toks[slot, n - 1])))
+                freed = False
+                with self._lock:
+                    if self._slots[slot] is req:
+                        self._slots[slot] = None
+                        freed = True
+                if freed:
+                    self._free_slot_pages(slot)
+        if proposed_t:
+            self._count("draft_proposed", proposed_t)
+            self._count("draft_accepted", accepted_t)
+            self._count("draft_rejected", rejected_t)
+        telemetry.emit("serve_spec", server=self.telemetry_label,
+                       k_bucket=k_bucket, proposed=proposed_t,
+                       accepted=accepted_t, rejected=rejected_t)
 
     # request-span telemetry ------------------------------------------------ #
     def _retire_reason(self, req, last_tok):
@@ -1954,7 +2213,9 @@ class DecodeServer:
             else round(sp["queue_wait_s"], 6),
             wave=sp.get("wave"), a_bucket=sp.get("a_bucket"),
             p_bucket=sp.get("p_bucket"),
-            occupancy_at_admit=sp.get("occupancy_at_admit"))
+            occupancy_at_admit=sp.get("occupancy_at_admit"),
+            draft_accepted=st.draft_accepted,
+            draft_rejected=st.draft_rejected)
 
     # sync fallback -------------------------------------------------------- #
     def _pump_sync(self):

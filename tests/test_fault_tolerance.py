@@ -212,8 +212,10 @@ class TestServeDeadline:
         one executable dispatch — retirement adds none."""
         from mxnet_tpu.serve import DecodeServer
         kw = dict(temperature=0.7, top_k=7) if sampled else {}
+        # spec=False: this test pins plain one-dispatch-per-step
+        # accounting (speculative chaos lives in test_serve_spec.py)
         srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
-                           autostart=False, **kw)
+                           spec=False, autostart=False, **kw)
         clk = _FakeClock(srv._epoch)
         srv._clock = clk
         N = 10
@@ -291,8 +293,9 @@ class TestServeCancel:
         and no extra dispatch is spent."""
         from mxnet_tpu.serve import DecodeServer
         kw = dict(temperature=0.7, top_k=7) if sampled else {}
+        # spec=False: pins plain step accounting (see test_serve_spec)
         srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
-                           autostart=False, **kw)
+                           spec=False, autostart=False, **kw)
         N = 10
         pA, pB = _prompt(10, 5), _prompt(11, 4)
         telemetry.clear_events()
@@ -397,8 +400,10 @@ class TestSchedulerFailure:
         underlying error, and submit() afterwards raises cleanly
         naming it."""
         from mxnet_tpu.serve import DecodeServer
+        # spec=False so the pump takes serve.step dispatches (the
+        # speculative serve.verify site has its own chaos suite)
         srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
-                           autostart=False)
+                           spec=False, autostart=False)
         p1, p2 = _prompt(20, 4), _prompt(21, 5)
         s1 = srv.submit(p1, max_new_tokens=8)
         s2 = srv.submit(p2, max_new_tokens=8)

@@ -55,10 +55,12 @@ def net():
 @pytest.fixture(scope="module")
 def server(net):
     """Shared greedy 2-slot pool, pump-driven (compiles once for the
-    whole module); every test drains it back to idle."""
+    whole module); every test drains it back to idle.  spec=False: this
+    module pins the PLAIN one-dispatch-per-step accounting (speculative
+    draft-and-verify has its own suite, test_serve_spec.py)."""
     from mxnet_tpu.serve import DecodeServer
     srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
-                       autostart=False)
+                       spec=False, autostart=False)
     yield srv
     srv.close(drain=False)
 

@@ -1,0 +1,502 @@
+"""Speculative draft-and-verify decoding (ISSUE 17,
+``mxnet_tpu/serve/draft.py`` + ``PoolPrograms.verify_fn``).
+
+THE acceptance bar: a greedy served stream under speculation is
+token-for-token identical to ``kv_generate`` — speculation changes the
+dispatch schedule, never the tokens.  Around it: the verify-ladder
+compile bound (``len(spec_sizes) x len(pool_sizes)`` programs, zero
+retraces under draft-length churn), the draft ledger
+(``accepted + rejected == proposed``, re-derived by ``--check-serve``),
+prefix-cache co-residency (the hit slot's first step is plain — the
+ramp), the ``serve.verify`` chaos site, and the env knobs
+(``MXNET_SERVE_SPEC`` / ``_DEPTH`` / ``_SIZES``).
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry import faults
+
+
+def _gpt(layers=2, units=32, heads=4, hidden=64, vocab=97,
+         max_length=64):
+    from mxnet_tpu.models import GPT, GPTConfig
+    mx.random.seed(0)
+    net = GPT(GPTConfig(vocab_size=vocab, max_length=max_length,
+                        num_layers=layers, units=units, num_heads=heads,
+                        hidden_size=hidden))
+    net.initialize(mx.init.Normal(0.02))
+    return net
+
+
+def _prompt(seed, n, vocab=97):
+    return onp.random.RandomState(seed).randint(0, vocab, (n,))
+
+
+def _drain(server):
+    while server.pump():
+        pass
+
+
+def _ref(net, prompt, n, **kw):
+    from mxnet_tpu.models import kv_generate
+    kw.setdefault("temperature", 0.0)
+    return list(kv_generate(net, prompt[None], max_new_tokens=n,
+                            **kw)[0, prompt.size:])
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _gpt()
+
+
+@pytest.fixture(scope="module")
+def server(net):
+    """Shared greedy 2-slot SPECULATIVE pool, pump-driven; every test
+    drains it back to idle."""
+    from mxnet_tpu.serve import DecodeServer
+    srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                       spec=True, autostart=False)
+    yield srv
+    srv.close(drain=False)
+
+
+# --------------------------------------------------------------------- #
+# parity
+# --------------------------------------------------------------------- #
+
+class TestSpecParity:
+    def test_coresident_streams_match_kv_generate(self, net, server):
+        """Two ragged co-resident requests under speculation are
+        bit-identical to the offline greedy decode, the ledger
+        balances, and speculation actually happened (verify dispatches
+        and accepted drafts are nonzero on this self-similar
+        workload)."""
+        server.reset_counters()
+        p1, p2 = _prompt(300, 5), _prompt(301, 3)
+        s1 = server.submit(p1, max_new_tokens=24)
+        s2 = server.submit(p2, max_new_tokens=20)
+        _drain(server)
+        assert s1.tokens(5) == _ref(net, p1, 24)
+        assert s2.tokens(5) == _ref(net, p2, 20)
+        c = dict(server.counters)
+        assert c["verify_dispatches"] > 0
+        assert c["draft_accepted"] > 0
+        assert c["draft_accepted"] + c["draft_rejected"] \
+            == c["draft_proposed"]
+        # the per-stream ledger sums to the server totals
+        assert s1.draft_accepted + s2.draft_accepted \
+            == c["draft_accepted"]
+        assert s1.draft_rejected + s2.draft_rejected \
+            == c["draft_rejected"]
+        for s in (s1, s2):
+            assert 0.0 <= s.accept_rate <= 1.0
+        st = server.stats()
+        assert st["spec"] is True
+        assert st["draft_accept_rate"] == pytest.approx(
+            c["draft_accepted"] / max(c["draft_proposed"], 1))
+
+    def test_tokens_per_dispatch_beats_plain(self, net, server):
+        """The point of the ISSUE: fewer advancing dispatches than
+        tokens.  On the self-similar greedy stream the ledger
+        multiplier total/(total - accepted) clears 1.5."""
+        server.reset_counters()
+        p = _prompt(302, 4)
+        s = server.submit(p, max_new_tokens=32)
+        _drain(server)
+        assert s.tokens(5) == _ref(net, p, 32)
+        total = len(s.tokens(5))
+        tpd = total / max(total - s.draft_accepted, 1)
+        assert tpd > 1.5, (tpd, s.draft_accepted, s.draft_rejected)
+
+    def test_eos_retirement_exact_under_spec(self, net):
+        """EOS inside an accepted burst retires at the right position:
+        the acceptance clamp cuts the advance at first_eos + 1, so the
+        stream equals the offline EOS-truncated decode."""
+        from mxnet_tpu.serve import DecodeServer
+        p = _prompt(303, 4)
+        ref = _ref(net, p, 16)
+        eos = ref[7]                     # retire mid-stream
+        want = ref[:ref.index(eos) + 1]
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           eos_id=eos, spec=True, autostart=False)
+        telemetry.clear_events()
+        s = srv.submit(p, max_new_tokens=16)
+        _drain(srv)
+        assert s.tokens(5) == want
+        assert any(e.get("request_id") == s.request_id
+                   and e.get("reason") == "eos"
+                   for e in telemetry.events("serve_request"))
+        srv.close()
+
+    def test_short_budget_never_overruns(self, net, server):
+        """max_new smaller than the speculation depth: the budget
+        clamp wins, the stream stops exactly at max_new tokens."""
+        p = _prompt(304, 6)
+        telemetry.clear_events()
+        s = server.submit(p, max_new_tokens=2)
+        _drain(server)
+        assert s.tokens(5) == _ref(net, p, 2)
+        assert any(e.get("request_id") == s.request_id
+                   and e.get("reason") == "max_len"
+                   for e in telemetry.events("serve_request"))
+
+    def test_sampled_server_takes_plain_path(self, net):
+        """temperature > 0 disables speculation (rejection sampling is
+        out of scope): zero verify dispatches, sampled parity exact."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           temperature=0.8, top_k=5, spec=True,
+                           autostart=False)
+        assert srv.spec_enabled is False
+        p = _prompt(305, 4)
+        s = srv.submit(p, max_new_tokens=8, seed=9)
+        _drain(srv)
+        assert s.tokens(5) == _ref(net, p, 8, temperature=0.8, top_k=5,
+                                   seed=9)
+        assert srv.counters["verify_dispatches"] == 0
+        assert srv.stats()["spec"] is False
+        srv.close()
+
+    def test_rejecting_drafter_still_exact(self, net):
+        """A drafter that is always wrong costs nothing but its verify
+        columns: every draft rejects, every verify still advances one
+        plain-step token, parity holds."""
+        from mxnet_tpu.serve import DecodeServer, Drafter
+
+        class WrongDrafter(Drafter):
+            def propose(self, history, k):
+                return [96] * k          # never the greedy argmax
+
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           spec=True, drafter=WrongDrafter(),
+                           autostart=False)
+        p = _prompt(306, 4)
+        ref = _ref(net, p, 12)
+        assert 96 not in ref             # the premise of WrongDrafter
+        s = srv.submit(p, max_new_tokens=12)
+        _drain(srv)
+        assert s.tokens(5) == ref
+        assert s.draft_accepted == 0 and s.draft_rejected > 0
+        assert s.accept_rate == 0.0
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# the drafter
+# --------------------------------------------------------------------- #
+
+class TestNGramDrafter:
+    def test_longest_suffix_most_recent_match(self):
+        from mxnet_tpu.serve import NGramDrafter
+        d = NGramDrafter()
+        # suffix [1,2,3] matched at position 0 -> propose what followed
+        assert d.propose([1, 2, 3, 4, 1, 2, 3], 2) == [4, 1]
+        # two matches: the MOST RECENT earlier occurrence wins
+        assert d.propose([1, 2, 5, 1, 2, 6, 1, 2], 1) == [6]
+
+    def test_no_repeat_proposes_nothing(self):
+        from mxnet_tpu.serve import NGramDrafter
+        d = NGramDrafter()
+        assert d.propose([1, 2, 3, 4, 5], 4) == []
+        assert d.propose([7], 4) == []
+        assert d.propose([1, 2, 1, 2], 0) == []
+
+    def test_window_bounds_the_scan(self):
+        from mxnet_tpu.serve import NGramDrafter
+        d = NGramDrafter(window=4)
+        # the only match for suffix [1,2] is outside the 4-token window
+        assert d.propose([1, 2, 9, 8, 7, 1, 2], 2) == []
+
+    def test_bad_config_raises(self):
+        from mxnet_tpu.serve import NGramDrafter
+        with pytest.raises(ValueError):
+            NGramDrafter(min_match=0)
+        with pytest.raises(ValueError):
+            NGramDrafter(min_match=3, max_match=2)
+
+
+# --------------------------------------------------------------------- #
+# bucketed verify ladder
+# --------------------------------------------------------------------- #
+
+class TestSpecBuckets:
+    def test_verify_compiles_bounded_zero_retraces(self, net):
+        """Draft-length churn is operand VALUES: verify programs are
+        pinned to the k ladder x pool sizes, each compiled once, and a
+        second wave of different draft lengths retraces nothing."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           spec=True, autostart=False)
+        label = srv.telemetry_label
+        telemetry.clear_events()
+        for wave in range(3):            # varied histories and budgets
+            ss = [srv.submit(_prompt(320 + 10 * wave + i, 3 + i),
+                             max_new_tokens=10 + 7 * i)
+                  for i in range(2)]
+            _drain(srv)
+            for s in ss:
+                s.tokens(5)
+        comp = [e for e in telemetry.events("compile")
+                if e.get("site") == "serve.verify"
+                and e.get("server") == label]
+        bound = len(srv.spec_sizes) * len(srv.pool_sizes)
+        assert 0 < len(comp) <= bound, (len(comp), bound)
+        assert not any(e.get("retrace") for e in comp)
+        assert len({e["k_bucket"] for e in comp}) == len(comp)
+        # the engine cache agrees: one program per used bucket, each
+        # with exactly one traced signature
+        assert len(srv._progs._verifies) == len(comp)
+        for fn in srv._progs._verifies.values():
+            assert fn._cache_size() == 1
+        srv.close()
+
+    def test_verify_bucket_validation(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           spec=True, autostart=False)
+        with pytest.raises(MXNetError, match=">= 1"):
+            srv._progs.verify_fn(0)
+        srv.close()
+
+    def test_env_knobs(self, net, monkeypatch):
+        from mxnet_tpu.serve import DecodeServer
+        monkeypatch.setenv("MXNET_SERVE_SPEC", "0")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        assert srv.spec_enabled is False
+        srv.close()
+        monkeypatch.delenv("MXNET_SERVE_SPEC")
+
+        monkeypatch.setenv("MXNET_SERVE_SPEC_DEPTH", "2")
+        monkeypatch.setenv("MXNET_SERVE_SPEC_SIZES", "1,2")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           autostart=False)
+        assert srv.spec_enabled and srv.spec_depth == 2
+        assert srv.spec_sizes == (1, 2)
+        srv.close()
+
+        monkeypatch.setenv("MXNET_SERVE_SPEC_DEPTH", "eight")
+        with pytest.raises(MXNetError, match="MXNET_SERVE_SPEC_DEPTH"):
+            DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                         autostart=False)
+
+    def test_kwarg_validation(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        with pytest.raises(MXNetError, match="spec_depth"):
+            DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                         spec_depth=-1, autostart=False)
+        with pytest.raises(MXNetError, match="spec_sizes"):
+            DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                         spec_sizes=(4, 2), autostart=False)
+        # depth clamps to the largest pinned verify width
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           spec_depth=64, spec_sizes=(1, 2, 4),
+                           autostart=False)
+        assert srv.spec_depth == 4
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# prefix cache co-residency
+# --------------------------------------------------------------------- #
+
+class TestSpecPrefixCache:
+    def test_cow_hit_and_speculation_coresident_parity(self, net):
+        """ISSUE 17 regression pin: a COW prefix hit and a speculating
+        slot co-resident in one pool.  The hit slot's first decode step
+        recomputes the final prompt position (its stream has no tokens
+        for the drafter yet — the ramp), speculation joins only after,
+        and BOTH streams stay bit-identical to the offline decode."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           spec=True, autostart=False)
+        p_hit = _prompt(340, 32)         # two full pages -> cacheable
+        warm = srv.submit(p_hit, max_new_tokens=4)
+        _drain(srv)
+        assert warm.tokens(5) == _ref(net, p_hit, 4)
+        srv.reset_counters()
+        # the hit and a fresh speculating request share the pool
+        s_hit = srv.submit(p_hit, max_new_tokens=12)
+        s_new = srv.submit(_prompt(341, 5), max_new_tokens=16)
+        _drain(srv)
+        assert s_hit.tokens(5) == _ref(net, p_hit, 12)
+        assert s_new.tokens(5) == _ref(net, _prompt(341, 5), 16)
+        c = dict(srv.counters)
+        assert c["prefix_hits"] == 1
+        assert c["draft_accepted"] + c["draft_rejected"] \
+            == c["draft_proposed"]
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# chaos: the serve.verify fault site
+# --------------------------------------------------------------------- #
+
+class TestSpecChaos:
+    def test_verify_fault_fails_streams_cleanly(self, net, monkeypatch):
+        """An injected failure on the FIRST speculative verify dispatch
+        fails every in-flight stream with the underlying error and
+        later submit()s raise cleanly — same contract as serve.step."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           spec=True, autostart=False)
+        p1, p2 = _prompt(350, 4), _prompt(351, 5)
+        s1 = srv.submit(p1, max_new_tokens=12)
+        s2 = srv.submit(p2, max_new_tokens=12)
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "serve.verify:raise:1")
+        faults.reset_faults()
+        srv.start()
+        with pytest.raises(MXNetError, match="injected fault"):
+            s1.tokens(30)
+        with pytest.raises(MXNetError, match="injected fault"):
+            s2.tokens(30)
+        with pytest.raises(MXNetError, match="server failed"):
+            srv.submit(p1, max_new_tokens=2)
+
+    def test_cancel_mid_burst_coresident_exact(self, net):
+        """cancel() between speculative bursts frees the slot at the
+        next drain; the co-resident stream is token-identical and the
+        slot is reusable."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           spec=True, autostart=False)
+        pA, pB = _prompt(352, 5), _prompt(353, 4)
+        sA = srv.submit(pA, max_new_tokens=20)
+        sB = srv.submit(pB, max_new_tokens=20)
+        for _ in range(3):               # mid-flight, bursts in the air
+            srv.pump()
+        assert not sB.done
+        assert sB.cancel() is True
+        _drain(srv)
+        refB = _ref(net, pB, 20)
+        assert sA.tokens(5) == _ref(net, pA, 20)     # co-resident exact
+        got = sB.tokens(5)
+        assert len(got) < 20 and got == refB[:len(got)]
+        assert sB.cancelled
+        pC = _prompt(354, 3)
+        sC = srv.submit(pC, max_new_tokens=6)        # slot reusable
+        _drain(srv)
+        assert sC.tokens(5) == _ref(net, pC, 6)
+        srv.close()
+
+    def test_watchdog_mid_burst_fails_consumers(self, net):
+        """A pump wedged mid-speculative-burst past step_timeout fires
+        the watchdog: consumers get its error instead of blocking."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           spec=True, step_timeout=0.25,
+                           autostart=False)
+        # warm pump-driven first: a first-request compile would trip
+        # the 0.25s timeout before any wedge is simulated
+        w = srv.submit(_prompt(355, 4), max_new_tokens=8)
+        _drain(srv)
+        assert w.tokens(5) == _ref(net, _prompt(355, 4), 8)
+        telemetry.clear_events()
+        real_pump = srv.pump
+
+        def wedged_pump():
+            time.sleep(1.2)
+            return real_pump()
+
+        srv.pump = wedged_pump
+        s = srv.submit(_prompt(356, 4), max_new_tokens=8)
+        srv.start()
+        with pytest.raises(MXNetError, match="watchdog"):
+            s.tokens(30)
+        assert any(e.get("server") == srv.telemetry_label
+                   for e in telemetry.events("watchdog_fired"))
+
+
+# --------------------------------------------------------------------- #
+# the recording-side ledger (telemetry_report --check-serve)
+# --------------------------------------------------------------------- #
+
+class TestCheckServeLedger:
+    def _base(self):
+        return [{"kind": "serve_config", "server": "s", "sync_mode": 0,
+                 "pool_sizes": [2], "admit_sizes": [1, 2],
+                 "prefill_buckets": [8], "spec_sizes": [1, 2, 4]}]
+
+    def test_balanced_ledger_passes(self):
+        from tools.telemetry_report import check_serve
+        evs = self._base() + [
+            {"kind": "serve_spec", "server": "s", "k_bucket": 4,
+             "proposed": 6, "accepted": 4, "rejected": 2},
+            {"kind": "serve_stats", "server": "s", "steps": 3,
+             "counters": {"step_dispatches": 3, "draft_proposed": 6,
+                          "draft_accepted": 4, "draft_rejected": 2}},
+        ]
+        assert check_serve(evs) == []
+
+    def test_unbalanced_events_fail(self):
+        from tools.telemetry_report import check_serve
+        evs = self._base() + [
+            {"kind": "serve_spec", "server": "s", "k_bucket": 4,
+             "proposed": 6, "accepted": 4, "rejected": 1},
+        ]
+        assert any("serve_spec" in f for f in check_serve(evs))
+
+    def test_unbalanced_counters_fail(self):
+        from tools.telemetry_report import check_serve
+        evs = self._base() + [
+            {"kind": "serve_stats", "server": "s",
+             "counters": {"draft_proposed": 6, "draft_accepted": 5,
+                          "draft_rejected": 2}},
+        ]
+        assert any("serve_stats counters" in f for f in check_serve(evs))
+
+    def test_verify_ladder_overflow_fails(self):
+        from tools.telemetry_report import check_serve
+        evs = self._base() + [
+            {"kind": "compile", "site": "serve.verify", "server": "s",
+             "pool": 2, "k_bucket": k} for k in range(1, 5)
+        ]
+        # spec ladder bound = 3 sizes x 1 pool = 3 < 4 compiles
+        assert any("verify compiles" in f for f in check_serve(evs))
+
+    def test_verify_retrace_fails(self):
+        from tools.telemetry_report import check_serve
+        evs = self._base() + [
+            {"kind": "compile", "site": "serve.verify", "server": "s",
+             "pool": 2, "k_bucket": 2},
+            {"kind": "compile", "site": "serve.verify", "server": "s",
+             "pool": 2, "k_bucket": 2},
+        ]
+        assert any("retrace" in f for f in check_serve(evs))
+
+    def test_pre_spec_recording_skips(self):
+        """A recording from before speculation (no spec fields) passes
+        every ledger check untouched."""
+        from tools.telemetry_report import check_serve
+        evs = [{"kind": "serve_config", "server": "s", "sync_mode": 0,
+                "pool_sizes": [2], "admit_sizes": [1],
+                "prefill_buckets": [8]},
+               {"kind": "serve_stats", "server": "s", "steps": 2,
+                "counters": {"step_dispatches": 2}}]
+        assert check_serve(evs) == []
+
+
+# --------------------------------------------------------------------- #
+# the sweep runner
+# --------------------------------------------------------------------- #
+
+class TestTpuSweep:
+    def test_dry_run_plans_both_benches(self):
+        r = subprocess.run(
+            [sys.executable, "benchmark/tpu_sweep.py", "--dry-run",
+             "--smoke"],
+            capture_output=True, text=True, cwd="/root/repo",
+            timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "decode_bench.py" in r.stdout
+        assert "serve_bench.py" in r.stdout
+        assert "MXNET_TELEMETRY_JSONL=" in r.stdout
+        assert "dry run: 0 of 2 benches executed" in r.stdout

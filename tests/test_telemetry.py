@@ -435,7 +435,9 @@ class TestServeCounterView:
         v = _CounterView("t_view_srv")
         assert set(v) == {"step_dispatches", "admit_dispatches",
                           "sync_requests", "pool_grows", "prefix_hits",
-                          "cow_copies", "chunk_dispatches"}
+                          "cow_copies", "chunk_dispatches",
+                          "verify_dispatches", "draft_proposed",
+                          "draft_accepted", "draft_rejected"}
         v.inc("step_dispatches")
         v["step_dispatches"] += 2        # MutableMapping read-modify
         assert v["step_dispatches"] == 3

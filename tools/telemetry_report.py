@@ -47,7 +47,14 @@ the stream (no process state needed):
 5. pages ≤ pool capacity (ISSUE 16): any ``serve_stats`` carrying the
    paged-pool fields must report ``pages_in_use <= pages_total``
    (streams recorded before paging simply lack the fields and skip
-   the check).
+   the check);
+6. speculative-decoding ledger (ISSUE 17): verify compiles per server
+   ≤ ``len(spec_sizes) × len(pool_sizes)`` and retrace-free like the
+   other serve sites, and every proposed draft token resolves —
+   ``accepted + rejected == proposed`` re-derived both from the
+   per-dispatch ``serve_spec`` events and from the close-time
+   ``serve_stats`` draft counters (pre-speculation recordings lack
+   the fields and skip the check).
 
 Exit status 1 when a check fails (the tier-1 serve smoke shells this
 against the JSONL ``benchmark/serve_bench.py --smoke`` records).
@@ -256,7 +263,8 @@ def check_serve(events):
     compiles = defaultdict(list)
     for e in events:
         if e.get("kind") == "compile" and \
-                e.get("site") in ("serve.step", "serve.admit"):
+                e.get("site") in ("serve.step", "serve.admit",
+                                  "serve.verify"):
             compiles[e.get("server")].append(e)
     stats = [e for e in events if e.get("kind") == "serve_stats"]
 
@@ -266,6 +274,7 @@ def check_serve(events):
         evs = compiles.get(srv, [])
         admits = [e for e in evs if e["site"] == "serve.admit"]
         steps = [e for e in evs if e["site"] == "serve.step"]
+        verifies = [e for e in evs if e["site"] == "serve.verify"]
         ladder = (len(cfg.get("admit_sizes", [])) *
                   len(cfg.get("prefill_buckets", [])) *
                   len(cfg.get("pool_sizes", [])) or None)
@@ -277,15 +286,54 @@ def check_serve(events):
             failures.append(
                 f"{srv}: {len(steps)} step compiles for "
                 f"{len(cfg['pool_sizes'])} pinned pool sizes")
-        # distinct-program check: a repeated (pool, A, P) or a
+        # verify programs are pinned to the spec k ladder x pool sizes
+        # (accept/reject churn is operand values, never shapes) —
+        # pre-speculation recordings lack spec_sizes and skip this
+        spec_ladder = (len(cfg.get("spec_sizes") or []) *
+                       len(cfg.get("pool_sizes", [])))
+        if verifies and spec_ladder and len(verifies) > spec_ladder:
+            failures.append(
+                f"{srv}: {len(verifies)} verify compiles exceed the "
+                f"pinned k ladder product {spec_ladder}")
+        # distinct-program check: a repeated (pool, A, P, k) or a
         # cache_size > 1 event is a RETRACE of an existing program
         seen = set()
-        for e in admits + steps:
+        for e in admits + steps + verifies:
             key = (e["site"], e.get("pool"), e.get("a_bucket"),
-                   e.get("p_bucket"))
+                   e.get("p_bucket"), e.get("k_bucket"))
             if key in seen or e.get("retrace"):
                 failures.append(f"{srv}: retrace of {key}")
             seen.add(key)
+
+    # speculative-decoding ledger (ISSUE 17): every proposed draft
+    # token resolves to exactly one of accepted/rejected — re-derived
+    # BOTH from the per-dispatch serve_spec events and from the
+    # close-time serve_stats counters
+    spec_evs = defaultdict(lambda: {"proposed": 0, "accepted": 0,
+                                    "rejected": 0})
+    for e in events:
+        if e.get("kind") == "serve_spec":
+            led = spec_evs[e.get("server", "?")]
+            for f in ("proposed", "accepted", "rejected"):
+                led[f] += e.get(f, 0)
+    for srv, led in sorted(spec_evs.items()):
+        if led["accepted"] + led["rejected"] != led["proposed"]:
+            failures.append(
+                f"{srv}: serve_spec events: accepted "
+                f"{led['accepted']} + rejected {led['rejected']} != "
+                f"proposed {led['proposed']}")
+    for st in stats:
+        counters = st.get("counters", {})
+        prop = counters.get("draft_proposed")
+        if prop is None:
+            continue   # pre-speculation recording
+        acc = counters.get("draft_accepted", 0)
+        rej = counters.get("draft_rejected", 0)
+        if acc + rej != prop:
+            failures.append(
+                f"{st.get('server', '?')}: serve_stats counters: "
+                f"draft_accepted {acc} + draft_rejected {rej} != "
+                f"draft_proposed {prop}")
 
     for st in stats:
         counters = st.get("counters", {})
@@ -470,7 +518,8 @@ def main(argv=None):
                 print(f"CHECK FAILED: {f}", file=sys.stderr)
             return 1
         print("serve checks OK: ladder-bounded compiles, zero "
-              "retraces, 1 dispatch/step, pool bytes within budget")
+              "retraces, 1 dispatch/step, pool bytes within budget, "
+              "draft ledger balanced")
     return 0
 
 
