@@ -41,12 +41,14 @@ WANTED = {
                "kv_cache_batch1", "kv_cache_batch1_stacked",
                "spec_selfdraft"),
     "serve": ("saturated", "ragged_occ=0.25", "ragged_occ=0.5",
-              "ragged_occ=1.0", "ragged_spec", "prefix_hit"),
+              "ragged_occ=1.0", "ragged_spec", "kv_quant_residency",
+              "prefix_hit"),
 }
 # columns worth a BASELINE.md reader's attention, in print order
 COLUMNS = ("tokens_per_sec", "new_tokens_per_sec", "tokens_per_dispatch",
            "accept_rate", "ops_per_step", "ms_per_token",
-           "continuous_vs_static", "p50_ttft_ms", "p99_ttft_ms",
+           "continuous_vs_static", "resident_x", "greedy_agreement",
+           "p50_ttft_ms", "p99_ttft_ms",
            "p50_hit_ttft_ms", "occupancy", "platform")
 
 

@@ -110,6 +110,101 @@ def _quantize_head(w, bias=None):
     return wq, s, bias
 
 
+def _kv_dequant(codes, scales, dtype):
+    """Int8 KV page codes -> ``dtype`` values: ``codes * scale`` with
+    the per-page-per-head f32 scale broadcast over the trailing
+    ``(page, D)`` axes.  A sentinel gather fills codes AND scales with
+    zeros, so unmapped pages dequantize to the exact zeros the f32
+    pool's fill would have produced."""
+    return (codes.astype(jnp.float32)
+            * scales[..., None, None]).astype(dtype)
+
+
+def _kv_requant(vals, floor_scales):
+    """Symmetric per-page-row int8 quantization of ``vals`` over its
+    trailing ``(page, D)`` axes, with the new scale FLOORED at the
+    page's previous scale (pass ``0.0`` for fresh pages).  The floor
+    is what keeps the read-modify-write page rewrites lossless for
+    untouched columns: when a new column does not raise the page's
+    dynamic range the scale is unchanged and every existing code
+    round-trips to itself exactly (``round(c * s / s) == c``) — zero
+    drift over the up-to-``page`` step rewrites a frontier page sees.
+    When the range DOES grow, the whole page re-rounds at the coarser
+    scale, exactly what a one-shot quantization of the final page
+    contents would have produced."""
+    v32 = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v32), axis=(-2, -1))
+    s = jnp.maximum(jnp.maximum(amax / 127.0, floor_scales), 1e-8)
+    codes = jnp.round(v32 / s[..., None, None]).astype(jnp.int8)
+    return codes, s
+
+
+def _kv_step_rmw(pool, pg, iB, offs, newcol):
+    """Requantizing single-column page rewrite for the paged pool STEP:
+    gather each slot's frontier page ``pg[b]`` (codes + scale),
+    dequantize, land slot ``b``'s new K or V column at page offset
+    ``offs[b]``, re-quantize with the old scale as floor, and scatter
+    codes+scales back (``mode="drop"``: a retired lane's sentinel page
+    id cannot touch a freed page).  ``newcol`` is ``(B, NL, KV, D)``
+    — the advanced-index layout of the dense per-slot scatter this
+    replaces.  Write pages are exclusively owned (COW guarantees the
+    shared prefix never holds a slot's write frontier), so the
+    whole-page scatter never races another slot."""
+    codes, scales = pool
+    old_s = scales.at[:, pg].get(mode="fill", fill_value=0)
+    vals = _kv_dequant(codes.at[:, pg].get(mode="fill", fill_value=0),
+                       old_s, jnp.float32)       # (NL, B, KV, page, D)
+    vals = vals.at[:, iB, :, offs, :].set(newcol.astype(jnp.float32))
+    q, s = _kv_requant(vals, old_s)
+    return (codes.at[:, pg].set(q, mode="drop"),
+            scales.at[:, pg].set(s, mode="drop"))
+
+
+def _kv_chunk_rmw(pool, wpgs, loc, new_cd, page, ntp):
+    """Requantizing page-WINDOW rewrite for ``chunk_tokens``: the
+    chunk's ``C`` consecutive positions touch at most ``ntp``
+    consecutive pages of one slot's row.  Gather the window,
+    dequantize, land the chunk columns at their window-local offsets
+    ``loc`` (out-of-window entries DROP — bucket-padded tails and
+    positions past the cache horizon never land), re-quantize each
+    window page with its old scale as floor, scatter back.  ``new_cd``
+    is ``(NL, KV, C, D)``."""
+    codes, scales = pool
+    old_s = scales.at[:, wpgs].get(mode="fill", fill_value=0)
+    win = _kv_dequant(codes.at[:, wpgs].get(mode="fill", fill_value=0),
+                      old_s, jnp.float32)        # (NL, NTP, KV, page, D)
+    NL, _, KV, _, D = win.shape
+    flat = jnp.moveaxis(win, 2, 1).reshape(NL, KV, ntp * page, D)
+    flat = flat.at[:, :, loc, :].set(new_cd.astype(jnp.float32),
+                                     mode="drop")
+    win = jnp.moveaxis(flat.reshape(NL, KV, ntp, page, D), 2, 1)
+    q, s = _kv_requant(win, old_s)
+    return (codes.at[:, wpgs].set(q, mode="drop"),
+            scales.at[:, wpgs].set(s, mode="drop"))
+
+
+def _kv_verify_rmw(pool, wpgs, iB, loc, new_bd, page, ntp):
+    """Requantizing per-slot page-window rewrite for
+    ``pool_verify_paged``: like ``_kv_chunk_rmw`` batched over slots —
+    slot ``b``'s block touches window pages ``wpgs[b]`` with
+    window-local column offsets ``loc[b]``.  Slots' write windows are
+    disjoint (every window page belongs to its slot's reserved,
+    exclusively-owned range), so the batched whole-page scatter never
+    collides.  ``new_bd`` is ``(B, C, NL, KV, D)``."""
+    codes, scales = pool
+    old_s = scales.at[:, wpgs].get(mode="fill", fill_value=0)
+    win = _kv_dequant(codes.at[:, wpgs].get(mode="fill", fill_value=0),
+                      old_s, jnp.float32)     # (NL, B, NTP, KV, page, D)
+    NL, B, _, KV, _, D = win.shape
+    flat = jnp.moveaxis(win, 3, 2).reshape(NL, B, KV, ntp * page, D)
+    flat = flat.at[:, iB[:, None], :, loc, :].set(
+        new_bd.astype(jnp.float32), mode="drop")
+    win = jnp.moveaxis(flat.reshape(NL, B, KV, ntp, page, D), 3, 2)
+    q, s = _kv_requant(win, old_s)
+    return (codes.at[:, wpgs].set(q, mode="drop"),
+            scales.at[:, wpgs].set(s, mode="drop"))
+
+
 def _gpt_act_type(model):
     """fc1 activation of the first block (None for a linear fc1 — and
     for FFN variants without the fc1/act structure: the unrolled path
@@ -659,12 +754,25 @@ class _DecodeEngine:
         if pages is not None:
             pt, page = pages
             maxp = self.total // page
+            # int8 pools ride as (codes, scales) tuples — a STATIC
+            # python structure, so the branch is resolved at trace time
+            # and costs the f32 path nothing
+            quant = isinstance(ck, tuple)
 
             def _paged_view(pool_l):
                 # (NPAGES, KV, page, D) pool layer -> (B, KV, T, D)
                 # per-slot dense views through the page table; sentinel
-                # entries (pt == NPAGES) gather zeros
-                g = pool_l.at[pt].get(mode="fill", fill_value=0)
+                # entries (pt == NPAGES) gather zeros.  int8 pools
+                # dequantize in the SAME gather (per-page scales ride
+                # the scan xs next to the codes).
+                if quant:
+                    cdl, scl = pool_l
+                    g = _kv_dequant(
+                        cdl.at[pt].get(mode="fill", fill_value=0),
+                        scl.at[pt].get(mode="fill", fill_value=0),
+                        cdtype)
+                else:
+                    g = pool_l.at[pt].get(mode="fill", fill_value=0)
                 return jnp.moveaxis(g, 2, 1).reshape(B, KV, self.total,
                                                      D)
 
@@ -745,10 +853,18 @@ class _DecodeEngine:
             # the clip keeps a stale pos == T from indexing past the
             # table (it would otherwise clamp onto a live entry).
             pg = pt[iB, jnp.minimum(pos // page, maxp - 1)]
-            ck = ck.at[:, pg, :, pos % page, :].set(
-                jnp.moveaxis(knew[:, :, :, 0, :], 0, 1), mode="drop")
-            cv = cv.at[:, pg, :, pos % page, :].set(
-                jnp.moveaxis(vnew[:, :, :, 0, :], 0, 1), mode="drop")
+            newk = jnp.moveaxis(knew[:, :, :, 0, :], 0, 1)
+            newv = jnp.moveaxis(vnew[:, :, :, 0, :], 0, 1)
+            if quant:
+                # requantizing page RMW: dequantize the frontier page,
+                # land the column, re-quantize (old scale as floor)
+                ck = _kv_step_rmw(ck, pg, iB, pos % page, newk)
+                cv = _kv_step_rmw(cv, pg, iB, pos % page, newv)
+            else:
+                ck = ck.at[:, pg, :, pos % page, :].set(newk,
+                                                        mode="drop")
+                cv = cv.at[:, pg, :, pos % page, :].set(newv,
+                                                        mode="drop")
         elif per_slot:
             ck = ck.at[:, iB, :, pos, :].set(
                 jnp.moveaxis(knew[:, :, :, 0, :], 0, 1))
@@ -794,7 +910,8 @@ class _DecodeEngine:
         C = toks.shape[0]
         G = H // KV
         maxp = T // page
-        npages = kp.shape[1]
+        quant = isinstance(kp, tuple)      # int8 (codes, scales) pools
+        npages = (kp[0] if quant else kp).shape[1]
         cpos = off + jnp.arange(C, dtype=jnp.int32)       # absolute
 
         x = _call(self.model.wte, toks)[None]             # (1, C, U)
@@ -807,13 +924,22 @@ class _DecodeEngine:
         def body(x, xs):
             w, kpl, vpl = xs
             # dense (1, KV, T, D) views of this slot's cached prefix,
-            # gathered through its page-table row (sentinel -> zeros)
-            kc = jnp.moveaxis(
-                kpl.at[ptrow].get(mode="fill", fill_value=0),
-                1, 0).reshape(KV, T, D)[None]
-            vc = jnp.moveaxis(
-                vpl.at[ptrow].get(mode="fill", fill_value=0),
-                1, 0).reshape(KV, T, D)[None]
+            # gathered through its page-table row (sentinel -> zeros;
+            # int8 pools dequantize in the same gather)
+            if quant:
+                kpl = _kv_dequant(
+                    kpl[0].at[ptrow].get(mode="fill", fill_value=0),
+                    kpl[1].at[ptrow].get(mode="fill", fill_value=0),
+                    cdtype)
+                vpl = _kv_dequant(
+                    vpl[0].at[ptrow].get(mode="fill", fill_value=0),
+                    vpl[1].at[ptrow].get(mode="fill", fill_value=0),
+                    cdtype)
+            else:
+                kpl = kpl.at[ptrow].get(mode="fill", fill_value=0)
+                vpl = vpl.at[ptrow].get(mode="fill", fill_value=0)
+            kc = jnp.moveaxis(kpl, 1, 0).reshape(KV, T, D)[None]
+            vc = jnp.moveaxis(vpl, 1, 0).reshape(KV, T, D)[None]
             if llama:
                 h = _rms(x, w["rms1_g"], eps=eps1)
                 if int8:
@@ -892,14 +1018,33 @@ class _DecodeEngine:
         # pages (bucket-padded tails) resolve to the sentinel and DROP;
         # the explicit cpos < T guard covers tails that would otherwise
         # CLIP onto the row's own last page and corrupt earlier tokens.
-        pgs = jnp.where(cpos < T,
-                        ptrow[jnp.minimum(cpos // page, maxp - 1)],
-                        npages)                            # (C,)
-        offs = cpos % page
-        kp = kp.at[:, pgs, :, offs, :].set(
-            jnp.moveaxis(knew[:, 0], 2, 0), mode="drop")
-        vp = vp.at[:, pgs, :, offs, :].set(
-            jnp.moveaxis(vnew[:, 0], 2, 0), mode="drop")
+        if quant:
+            # requantizing page-WINDOW RMW: the C consecutive columns
+            # touch at most ntp consecutive pages of this row (static
+            # in C and page, so the program shape is unchanged).  Pad
+            # columns past ``nlast`` are masked OUT here — unlike the
+            # f32 path's harmless garbage-but-unreachable writes, a pad
+            # column would poison its page's shared SCALE.
+            ntp = (C + page - 2) // page + 1
+            p0 = off // page
+            widx = p0 + jnp.arange(ntp, dtype=jnp.int32)
+            wpgs = jnp.where(widx < maxp,
+                             ptrow[jnp.minimum(widx, maxp - 1)],
+                             npages)                       # (NTP,)
+            keepc = (jnp.arange(C, dtype=jnp.int32) <= nlast) & \
+                (cpos < T)
+            loc = jnp.where(keepc, cpos - p0 * page, ntp * page)
+            kp = _kv_chunk_rmw(kp, wpgs, loc, knew[:, 0], page, ntp)
+            vp = _kv_chunk_rmw(vp, wpgs, loc, vnew[:, 0], page, ntp)
+        else:
+            pgs = jnp.where(cpos < T,
+                            ptrow[jnp.minimum(cpos // page, maxp - 1)],
+                            npages)                        # (C,)
+            offs = cpos % page
+            kp = kp.at[:, pgs, :, offs, :].set(
+                jnp.moveaxis(knew[:, 0], 2, 0), mode="drop")
+            vp = vp.at[:, pgs, :, offs, :].set(
+                jnp.moveaxis(vnew[:, 0], 2, 0), mode="drop")
         x_last = lax.dynamic_slice(x, (0, nlast, 0), (1, 1, U))[:, 0]
         xl = _call(self.model.ln_f, x_last)
         # the chunk head is native, matching prefill_batch (q8 covers
@@ -950,7 +1095,8 @@ class _DecodeEngine:
         C = toks.shape[1]
         G = H // KV
         maxp = T // page
-        npages = kp.shape[1]
+        quant = isinstance(kp, tuple)      # int8 (codes, scales) pools
+        npages = (kp[0] if quant else kp).shape[1]
         iB = jnp.arange(B)
         cpos = pos[:, None] + jnp.arange(C, dtype=jnp.int32)   # (B, C)
         # dense-view write positions: a column past the cache horizon
@@ -974,13 +1120,22 @@ class _DecodeEngine:
         def body(x, xs):
             w, kpl, vpl = xs
             # per-slot dense (B, KV, T, D) views through the page
-            # table; sentinel rows (retired slots) gather zeros
-            kc = jnp.moveaxis(
-                kpl.at[pt].get(mode="fill", fill_value=0),
-                2, 1).reshape(B, KV, T, D)
-            vc = jnp.moveaxis(
-                vpl.at[pt].get(mode="fill", fill_value=0),
-                2, 1).reshape(B, KV, T, D)
+            # table; sentinel rows (retired slots) gather zeros (int8
+            # pools dequantize in the same gather)
+            if quant:
+                kpl = _kv_dequant(
+                    kpl[0].at[pt].get(mode="fill", fill_value=0),
+                    kpl[1].at[pt].get(mode="fill", fill_value=0),
+                    cdtype)
+                vpl = _kv_dequant(
+                    vpl[0].at[pt].get(mode="fill", fill_value=0),
+                    vpl[1].at[pt].get(mode="fill", fill_value=0),
+                    cdtype)
+            else:
+                kpl = kpl.at[pt].get(mode="fill", fill_value=0)
+                vpl = vpl.at[pt].get(mode="fill", fill_value=0)
+            kc = jnp.moveaxis(kpl, 2, 1).reshape(B, KV, T, D)
+            vc = jnp.moveaxis(vpl, 2, 1).reshape(B, KV, T, D)
             if llama:
                 h = _rms(x, w["rms1_g"], eps=eps1)
                 if int8:
@@ -1068,17 +1223,37 @@ class _DecodeEngine:
         # every slot through its page-table row.  Out-of-range columns
         # (zombie lanes past T) resolve to the sentinel and DROP; the
         # cpos < T guard keeps them from CLIPPING onto a live page.
-        pgs = jnp.where(cpos < T,
-                        pt[iB[:, None], jnp.minimum(cpos // page,
-                                                    maxp - 1)],
-                        npages)                            # (B, C)
-        offs = cpos % page
-        # result dims of the non-adjacent advanced indices go FIRST:
-        # value shape (B, C, NL, KV, D)
-        kp = kp.at[:, pgs, :, offs, :].set(
-            jnp.transpose(knew, (1, 3, 0, 2, 4)), mode="drop")
-        vp = vp.at[:, pgs, :, offs, :].set(
-            jnp.transpose(vnew, (1, 3, 0, 2, 4)), mode="drop")
+        if quant:
+            # per-slot requantizing page-window RMW (the chunk write
+            # batched over slots): slot b's C columns touch at most ntp
+            # consecutive pages from its frontier page pos[b] // page
+            ntp = (C + page - 2) // page + 1
+            p0 = pos // page                               # (B,)
+            widx = p0[:, None] + jnp.arange(ntp, dtype=jnp.int32)
+            wpgs = jnp.where(widx < maxp,
+                             pt[iB[:, None],
+                                jnp.minimum(widx, maxp - 1)],
+                             npages)                       # (B, NTP)
+            loc = jnp.where(cpos < T, cpos - p0[:, None] * page,
+                            ntp * page)                    # (B, C)
+            kp = _kv_verify_rmw(kp, wpgs, iB, loc,
+                                jnp.transpose(knew, (1, 3, 0, 2, 4)),
+                                page, ntp)
+            vp = _kv_verify_rmw(vp, wpgs, iB, loc,
+                                jnp.transpose(vnew, (1, 3, 0, 2, 4)),
+                                page, ntp)
+        else:
+            pgs = jnp.where(cpos < T,
+                            pt[iB[:, None], jnp.minimum(cpos // page,
+                                                        maxp - 1)],
+                            npages)                        # (B, C)
+            offs = cpos % page
+            # result dims of the non-adjacent advanced indices go
+            # FIRST: value shape (B, C, NL, KV, D)
+            kp = kp.at[:, pgs, :, offs, :].set(
+                jnp.transpose(knew, (1, 3, 0, 2, 4)), mode="drop")
+            vp = vp.at[:, pgs, :, offs, :].set(
+                jnp.transpose(vnew, (1, 3, 0, 2, 4)), mode="drop")
         xl = _call(self.model.ln_f, x)
         # same head as the plain step (q8 when int8) — the greedy
         # parity contract: out[b, 0]'s logits == the step path's

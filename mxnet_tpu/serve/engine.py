@@ -61,10 +61,11 @@ import jax.numpy as jnp
 
 from .. import telemetry
 from ..base import MXNetError
-from ..models.decoding import _DecodeEngine, _TRACE_LOCK
+from ..models.decoding import _DecodeEngine, _TRACE_LOCK, _kv_requant
 
 __all__ = ["PoolPrograms", "PagePool", "pool_state_init",
-           "pool_state_grow", "pool_state_bytes"]
+           "pool_state_grow", "pool_state_bytes",
+           "admit_scratch_bytes"]
 
 
 # per-slot scalar state bytes: pos/tok/stop/spec int32 (16) + active
@@ -134,13 +135,32 @@ def pool_state_bytes(progs, num_slots=None, num_pages=None):
     ``num_pages`` pages (defaults: the programs' own geometry; the
     default page count is ``num_slots * MAXP`` — the dense-equivalent
     allotment, so the figure stays LINEAR in the slot count and the
-    budget thresholds keep their PR-10 meaning).  Pure arithmetic, so
-    ``DecodeServer`` can price a growth (or the initial pool) BEFORE
-    allocating it; ``tests/test_memory.py`` pins this equal to the
-    allocator-reported ``nbytes_of`` of the live state."""
+    budget thresholds keep their PR-10 meaning).  Priced at the
+    programs' OWN ``kv_dtype`` via ``page_bytes()`` — an int8 pool's
+    pages cost codes + per-page scales, not the f32 itemsize.  Pure
+    arithmetic, so ``DecodeServer`` can price a growth (or the initial
+    pool) BEFORE allocating it; ``tests/test_memory.py`` pins this
+    equal to the allocator-reported ``nbytes_of`` of the live state
+    for BOTH dtypes."""
     S = progs.S if num_slots is None else int(num_slots)
     npages = S * progs.maxp if num_pages is None else int(num_pages)
     return npages * progs.page_bytes() + S * _SLOT_STATE_BYTES
+
+
+def admit_scratch_bytes(progs, a_bucket):
+    """Transient device bytes of an ``a_bucket``-row admission wave:
+    the dense ``(A, Tp)`` prefill scratch cache pair at the model's
+    NATIVE cache dtype plus the wave's slot-state rows.  The admit
+    program always prefills into a dense float scratch and quantizes
+    on the page scatter, so this figure is dtype-INDEPENDENT — under
+    ``kv_dtype="int8"`` it deliberately does NOT shrink with
+    ``pool_state_bytes`` (which it equals for a native-dtype pool at
+    the dense-equivalent page count), keeping the budget clamp honest
+    about the admission spike."""
+    e = progs.eng
+    A = int(a_bucket)
+    return 2 * e.NL * A * e.KV * progs.Tp * e.D \
+        * jnp.dtype(e.cdtype).itemsize + A * _SLOT_STATE_BYTES
 
 
 def pool_state_init(progs, device=None):
@@ -167,8 +187,20 @@ def pool_state_init(progs, device=None):
     if device is None:
         device = jax.devices()[0]
     shape = (eng.NL, progs.num_pages, eng.KV, progs.page, eng.D)
-    state = (jnp.zeros(shape, eng.cdtype),   # K page pool
-             jnp.zeros(shape, eng.cdtype),   # V page pool
+    if progs.quant_kv:
+        # int8 pool: each of K and V is a (codes, scales) PAIR riding
+        # ONE state slot as a pytree — every executable threads, donates
+        # and scans it exactly like the single f32 array it replaces
+        sshape = (eng.NL, progs.num_pages, eng.KV)
+        kpool = (jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32))
+        vpool = (jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(sshape, jnp.float32))
+    else:
+        kpool = jnp.zeros(shape, eng.cdtype)
+        vpool = jnp.zeros(shape, eng.cdtype)
+    state = (kpool,                          # K page pool
+             vpool,                          # V page pool
              jnp.zeros((S,), jnp.int32),     # pos: next write index
              jnp.zeros((S,), jnp.int32),     # tok: last sampled
              jnp.zeros((S,), jnp.bool_),     # active
@@ -189,24 +221,28 @@ def pool_state_grow(state, new_s, new_pages=None):
     the grown pool before the next dispatch (the server regenerates
     them from its allocator every dispatch, so this is automatic)."""
     kp, vp, pos, tok, active, stop, keys, dl, spec = state
+    kp0 = kp[0] if isinstance(kp, tuple) else kp
     grow = new_s - pos.shape[0]
     if grow <= 0:
         raise MXNetError(f"pool can only grow: {pos.shape[0]} -> "
                          f"{new_s}")
-    pgrow = 0 if new_pages is None else int(new_pages) - kp.shape[1]
+    pgrow = 0 if new_pages is None else int(new_pages) - kp0.shape[1]
     if pgrow < 0:
-        raise MXNetError(f"page pool can only grow: {kp.shape[1]} -> "
+        raise MXNetError(f"page pool can only grow: {kp0.shape[1]} -> "
                          f"{new_pages}")
     pad = lambda a, axis, n: jnp.pad(
         a, [(0, n) if i == axis else (0, 0) for i in range(a.ndim)])
-    grown = (pad(kp, 1, pgrow), pad(vp, 1, pgrow), pad(pos, 0, grow),
+    # int8 pools pad codes AND scales along the shared page axis
+    padp = lambda p, n: (pad(p[0], 1, n), pad(p[1], 1, n)) \
+        if isinstance(p, tuple) else pad(p, 1, n)
+    grown = (padp(kp, pgrow), padp(vp, pgrow), pad(pos, 0, grow),
              pad(tok, 0, grow), pad(active, 0, grow), pad(stop, 0, grow),
              pad(keys, 0, grow),
              # idle-lane deadlines pad as +inf, matching pool_state_init
              jnp.pad(dl, (0, grow), constant_values=jnp.inf),
              pad(spec, 0, grow))
     # committed placement, same contract as pool_state_init
-    return jax.device_put(grown, list(kp.devices())[0])
+    return jax.device_put(grown, list(kp0.devices())[0])
 
 
 class PoolPrograms:
@@ -220,9 +256,20 @@ class PoolPrograms:
 
     def __init__(self, model, num_slots, max_total, temperature=0.0,
                  top_k=0, eos_id=None, weights="native",
-                 telemetry_label=None, page_size=16, num_pages=None):
+                 telemetry_label=None, page_size=16, num_pages=None,
+                 kv_dtype="native"):
         self.model = model
         self.telemetry_label = telemetry_label
+        # "native" stores pages at the engine cache dtype (the exact
+        # pre-PR behavior); "int8" stores codes + per-page-per-head f32
+        # scales, quantized inside the SAME write executables and
+        # dequantized inside the scan body on read (lossy — PARITY.md
+        # pins the tolerance)
+        if kv_dtype not in ("native", "int8"):
+            raise MXNetError(f"kv_dtype must be 'native' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        self.quant_kv = kv_dtype == "int8"
         self.S, self.T = int(num_slots), int(max_total)
         self.page = int(page_size)
         if self.page < 1:
@@ -263,8 +310,14 @@ class PoolPrograms:
 
     def page_bytes(self):
         """Device bytes of ONE page across all layers, K and V pools
-        together — the pricing unit ``pool_state_bytes`` scales."""
+        together — the pricing unit ``pool_state_bytes`` scales.  An
+        int8 page costs its codes (1 byte/element) plus one f32 scale
+        per (layer, KV head) for each of K and V — the ~4x shrink vs a
+        float32 pool is what converts an HBM budget into ~2x resident
+        sequences at equal bytes."""
         e = self.eng
+        if self.quant_kv:
+            return 2 * e.NL * e.KV * (self.page * e.D + 4)
         return 2 * e.NL * e.KV * self.page * e.D \
             * jnp.dtype(e.cdtype).itemsize
 
@@ -407,14 +460,36 @@ class PoolPrograms:
             # page-shaped rows that land at their reserved pool pages in
             # one masked scatter per array (sentinel rows DROP)
             tgt_pg = pages.reshape(A * npb)
+            if self.quant_kv:
+                # the padded tail's garbage columns are unreachable in
+                # the f32 pool but would poison the per-page SCALES
+                # here — zero them before the per-page quantization
+                colmask = jnp.arange(ppad, dtype=jnp.int32)[None] \
+                    < true_len[:, None]                     # (A, ppad)
+                ck1 = jnp.where(colmask[None, :, None, :, None],
+                                ck1, 0)
+                cv1 = jnp.where(colmask[None, :, None, :, None],
+                                cv1, 0)
             c1 = ck1.reshape(NL, A, KV, npb, page, D) \
                     .transpose(0, 1, 3, 2, 4, 5) \
                     .reshape(NL, A * npb, KV, page, D)
             v1 = cv1.reshape(NL, A, KV, npb, page, D) \
                     .transpose(0, 1, 3, 2, 4, 5) \
                     .reshape(NL, A * npb, KV, page, D)
-            kp = kp.at[:, tgt_pg].set(c1, mode="drop")
-            vp = vp.at[:, tgt_pg].set(v1, mode="drop")
+            if self.quant_kv:
+                # fresh whole pages: plain per-page quantization (no
+                # floor — nothing lived in these pages), then ONE
+                # masked scatter each for codes and scales
+                qc1, sc1 = _kv_requant(c1, 0.0)
+                qv1, sv1 = _kv_requant(v1, 0.0)
+                (kpc, kps), (vpc, vps) = kp, vp
+                kp = (kpc.at[:, tgt_pg].set(qc1, mode="drop"),
+                      kps.at[:, tgt_pg].set(sc1, mode="drop"))
+                vp = (vpc.at[:, tgt_pg].set(qv1, mode="drop"),
+                      vps.at[:, tgt_pg].set(sv1, mode="drop"))
+            else:
+                kp = kp.at[:, tgt_pg].set(c1, mode="drop")
+                vp = vp.at[:, tgt_pg].set(v1, mode="drop")
             # masked slot-state scatter: invalid rows target slot S
             # (out of bounds) and drop; valid rows carry distinct
             # host-assigned slots
@@ -478,11 +553,28 @@ class PoolPrograms:
             spec_d = meta[:, 6]
             keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
             # copy-on-write boundary pages: one gather + one masked
-            # scatter covers the whole wave's copies
-            kblk = kp.at[:, src].get(mode="fill", fill_value=0)
-            vblk = vp.at[:, src].get(mode="fill", fill_value=0)
-            kp = kp.at[:, dst].set(kblk, mode="drop")
-            vp = vp.at[:, dst].set(vblk, mode="drop")
+            # scatter covers the whole wave's copies.  An int8 pool
+            # copies codes AND scales together — a page's quantization
+            # grid is part of its identity, refcounted as one unit.
+            if self.quant_kv:
+                (kpc, kps), (vpc, vps) = kp, vp
+                kp = (kpc.at[:, dst].set(
+                          kpc.at[:, src].get(mode="fill", fill_value=0),
+                          mode="drop"),
+                      kps.at[:, dst].set(
+                          kps.at[:, src].get(mode="fill", fill_value=0),
+                          mode="drop"))
+                vp = (vpc.at[:, dst].set(
+                          vpc.at[:, src].get(mode="fill", fill_value=0),
+                          mode="drop"),
+                      vps.at[:, dst].set(
+                          vps.at[:, src].get(mode="fill", fill_value=0),
+                          mode="drop"))
+            else:
+                kblk = kp.at[:, src].get(mode="fill", fill_value=0)
+                vblk = vp.at[:, src].get(mode="fill", fill_value=0)
+                kp = kp.at[:, dst].set(kblk, mode="drop")
+                vp = vp.at[:, dst].set(vblk, mode="drop")
             tgt = jnp.where(valid, slot, self.S)
             pos = pos.at[tgt].set(true_len - 1, mode="drop")
             tok = tok.at[tgt].set(last_tok, mode="drop")

@@ -217,6 +217,22 @@ def _page_size_from_env():
     return page
 
 
+def _kv_dtype_from_env():
+    """``MXNET_SERVE_KV_DTYPE``: storage dtype of the paged KV pool —
+    ``int8`` stores pages as int8 codes with per-page-per-head float32
+    scales (~4x smaller pages, lossy: PARITY.md pins the tolerance);
+    default ``native`` keeps the model's cache dtype (lossless, the
+    pre-int8 behavior).  ``DecodeServer(kv_dtype=)`` wins over the
+    env."""
+    raw = os.environ.get("MXNET_SERVE_KV_DTYPE", "native").lower()
+    if raw in ("native", "f32", "float32", "bf16", "bfloat16", ""):
+        return "native"
+    if raw == "int8":
+        return "int8"
+    raise MXNetError(f"MXNET_SERVE_KV_DTYPE={raw!r}: expected 'native' "
+                     "(model cache dtype) or 'int8'")
+
+
 def _prefix_cache_from_env():
     """``MXNET_SERVE_PREFIX_CACHE``: 0 disables copy-on-write shared-
     prefix caching (default on)."""
@@ -618,7 +634,8 @@ class DecodeServer:
                  hbm_budget=None, default_deadline=None,
                  step_timeout=None, page_size=None, num_pages=None,
                  prefix_cache=None, spec=None, spec_depth=None,
-                 spec_sizes=None, drafter=None, autostart=True):
+                 spec_sizes=None, drafter=None, kv_dtype=None,
+                 autostart=True):
         from ..telemetry.memory import parse_bytes
         from .draft import NGramDrafter
         from .engine import PagePool, PoolPrograms, pool_state_init
@@ -705,6 +722,17 @@ class DecodeServer:
             raise MXNetError(f"page_size must be >= 1, "
                              f"got {self.page_size}")
         self._num_pages_fixed = num_pages is not None
+        # paged-pool storage dtype (ISSUE 18): "int8" quantizes pages
+        # at write time inside the same executables and halves-again
+        # the per-page bytes vs bf16 (4x vs f32) — the equal-HBM
+        # residency lever; "native" is the lossless default
+        self.kv_dtype = str(kv_dtype).lower() if kv_dtype is not None \
+            else _kv_dtype_from_env()
+        if self.kv_dtype in ("f32", "float32", "bf16", "bfloat16"):
+            self.kv_dtype = "native"
+        if self.kv_dtype not in ("native", "int8"):
+            raise MXNetError(f"kv_dtype must be 'native' or 'int8', "
+                             f"got {kv_dtype!r}")
         self.prefix_cache_enabled = bool(prefix_cache) \
             if prefix_cache is not None else _prefix_cache_from_env()
         # speculative decoding knobs (ISSUE 17): draft-and-verify is
@@ -761,7 +789,8 @@ class DecodeServer:
                     model, self.pool_sizes[0], self.T, temperature,
                     top_k, eos_id, weights,
                     telemetry_label=self.telemetry_label,
-                    page_size=self.page_size, num_pages=num_pages)
+                    page_size=self.page_size, num_pages=num_pages,
+                    kv_dtype=self.kv_dtype)
             except MXNetError as e:
                 # models the slot-pool gate rejects still serve, one
                 # request at a time, through the kv_generate fallback
@@ -788,12 +817,12 @@ class DecodeServer:
             # construct a server that fails every submit) — a budget
             # the config can never fit is a constructor error, not a
             # first-request teardown
-            from .engine import pool_state_bytes
+            from .engine import admit_scratch_bytes
 
             self._check_budget(
                 self.pool_sizes[0],
-                scratch=pool_state_bytes(self._progs,
-                                         self.admit_sizes[0]),
+                scratch=admit_scratch_bytes(self._progs,
+                                            self.admit_sizes[0]),
                 what=f"initial pool ({self.pool_sizes[0]} slots) plus "
                      f"the smallest admission wave's "
                      f"(A={self.admit_sizes[0]}) prefill scratch")
@@ -847,6 +876,12 @@ class DecodeServer:
             page_size=self.page_size,
             num_pages=None if self.sync_mode
             else self._progs.num_pages,
+            kv_dtype=self.kv_dtype,
+            # the priced per-page byte cost at kv_dtype — what
+            # --check-serve's dtype-aware capacity check re-derives
+            # pool_bytes from (None in sync mode: no resident pool)
+            page_bytes=None if self.sync_mode
+            else self._progs.page_bytes(),
             prefix_cache=self.prefix_cache_enabled,
             spec=self.spec_enabled, spec_depth=self.spec_depth,
             spec_sizes=list(self.spec_sizes))
@@ -1033,6 +1068,12 @@ class DecodeServer:
             # to an in-flight dispatch on the scheduler thread
             "pool_bytes": self._pool_bytes,
             "hbm_budget": self.hbm_budget,
+            # pool storage dtype + the priced per-page cost: together
+            # with pages_total they re-derive pool_bytes, the
+            # --check-serve dtype-aware capacity identity
+            "kv_dtype": self.kv_dtype,
+            "page_bytes": None if self.sync_mode
+            else self._progs.page_bytes(),
             # page-pool occupancy (0/None in sync mode: no pool)
             "page_size": None if self.sync_mode else self._progs.page,
             "pages_total": 0 if self._pages is None
@@ -1452,7 +1493,8 @@ class DecodeServer:
                              self.weights,
                              telemetry_label=self.telemetry_label,
                              page_size=self.page_size,
-                             num_pages=new_pages)
+                             num_pages=new_pages,
+                             kv_dtype=self.kv_dtype)
         # the old pool's in-flight readbacks refer to old slot indices
         # and page ids; they stay valid — slots and pages only ever grow
         self._progs = progs
@@ -1499,7 +1541,8 @@ class DecodeServer:
                 # even the smallest bucket (reachable after growth)
                 # raises.  The pop below is capped at the clamped size,
                 # so a submit racing in can't inflate the priced A.
-                from .engine import pool_state_bytes
+                from .engine import admit_scratch_bytes, \
+                    pool_state_bytes
 
                 with self._lock:
                     limit = min(limit, len(self._pending))
@@ -1509,14 +1552,18 @@ class DecodeServer:
                 resident = pool_state_bytes(
                     progs, len(self._slots),
                     num_pages=self._pages.num_pages)
+                # the admit scratch is a DENSE native-dtype prefill
+                # cache regardless of the pool's kv_dtype — priced as
+                # such, so an int8 pool's smaller resident footprint
+                # can't hide the full-size admission spike
                 usable = [a for a in self.admit_sizes
-                          if resident + pool_state_bytes(progs, a)
+                          if resident + admit_scratch_bytes(progs, a)
                           <= self.hbm_budget]
                 if not usable:
                     A = self.admit_sizes[0]
                     self._check_budget(
                         len(self._slots),
-                        scratch=pool_state_bytes(progs, A),
+                        scratch=admit_scratch_bytes(progs, A),
                         num_pages=self._pages.num_pages,
                         what=f"admission wave of {limit} "
                              f"(A={A} prefill scratch)")

@@ -323,38 +323,66 @@ class TestPagedPricing:
     """ISSUE 16 satellite: ``pool_state_bytes`` pages pricing equals
     the allocator-reported device bytes of the paged state at init and
     after growth, and ``stats()['pool_bytes']`` stays truthful while
-    pages are recycled."""
+    pages are recycled.  ISSUE 18 re-pins every identity for BOTH pool
+    dtypes — an int8 pool's (codes, scales) pages must price exactly
+    like they allocate."""
 
-    def test_pool_state_bytes_matches_device_state(self, tiny_gpt):
+    @pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+    def test_pool_state_bytes_matches_device_state(self, tiny_gpt,
+                                                   kv_dtype):
         from mxnet_tpu.serve import engine as seng
 
-        progs = seng.PoolPrograms(tiny_gpt, num_slots=2, max_total=24)
+        progs = seng.PoolPrograms(tiny_gpt, num_slots=2, max_total=24,
+                                  kv_dtype=kv_dtype)
         state = seng.pool_state_init(progs)
         assert sum(tmem.nbytes_of(x) for x in state) == \
             seng.pool_state_bytes(progs)
 
-    def test_pool_state_grow_matches_pricing(self, tiny_gpt):
+    @pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+    def test_pool_state_grow_matches_pricing(self, tiny_gpt, kv_dtype):
         """Growth adds slots AND pages; the priced bytes track the
         grown state exactly (no drift between pricer and allocator)."""
         from mxnet_tpu.serve import engine as seng
 
-        progs = seng.PoolPrograms(tiny_gpt, num_slots=1, max_total=24)
+        progs = seng.PoolPrograms(tiny_gpt, num_slots=1, max_total=24,
+                                  kv_dtype=kv_dtype)
         state = seng.pool_state_init(progs)
         new_pages = 3 * progs.maxp
         grown = seng.pool_state_grow(state, 3, new_pages=new_pages)
         assert sum(tmem.nbytes_of(x) for x in grown) == \
             seng.pool_state_bytes(progs, 3, num_pages=new_pages)
 
-    def test_pool_bytes_truthful_under_page_reuse(self, tiny_gpt):
+    def test_int8_pool_shrinks_pages_about_4x(self, tiny_gpt):
+        """The capacity claim at the pricing layer: an int8 page costs
+        codes + per-page scales, ~4x under the f32 page (>= 2x is the
+        budget-doubling bar; the exact ratio depends on page geometry
+        via the scale overhead)."""
+        from mxnet_tpu.serve import engine as seng
+
+        f32 = seng.PoolPrograms(tiny_gpt, num_slots=2, max_total=24)
+        i8 = seng.PoolPrograms(tiny_gpt, num_slots=2, max_total=24,
+                               kv_dtype="int8")
+        assert i8.page_bytes() * 2 < f32.page_bytes()
+        assert seng.pool_state_bytes(i8) * 2 < \
+            seng.pool_state_bytes(f32)
+
+    @pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+    def test_pool_bytes_truthful_under_page_reuse(self, tiny_gpt,
+                                                  kv_dtype):
         """Admit/retire churn recycles pages in place: the resident
-        pool's reported and accountant-metered bytes never move."""
+        pool's reported and accountant-metered bytes never move (and
+        under int8 they agree with the allocator's view of the
+        (codes, scales) state)."""
         from mxnet_tpu.serve import DecodeServer
+        from mxnet_tpu.serve.engine import pool_state_bytes
 
         srv = DecodeServer(tiny_gpt, max_total_len=24, pool_sizes=(1,),
-                           prefix_cache=False, autostart=False)
+                           prefix_cache=False, autostart=False,
+                           kv_dtype=kv_dtype)
         try:
             b0 = srv.stats()["pool_bytes"]
             assert b0 > 0
+            assert b0 == pool_state_bytes(srv._progs)
             for seed in range(3):
                 rng = onp.random.RandomState(seed)
                 s = srv.submit(rng.randint(0, 64, (5,)),
@@ -858,3 +886,30 @@ class TestCheckServeBudget:
         stats["pages_in_use"] = 9
         fails = telemetry_report.check_serve(events)
         assert any("pool capacity" in f for f in fails), fails
+
+    @pytest.mark.parametrize("kv_dtype,page_bytes",
+                             [("native", 512), ("int8", 132)])
+    def test_pool_bytes_vs_priced_pages(self, kv_dtype, page_bytes):
+        """ISSUE 18: serve_stats carrying the dtype-priced page fields
+        must satisfy ``pages_total * page_bytes <= pool_bytes`` within
+        the slot-state slack — the identity that catches a pricer that
+        forgot an int8 pool's scales (or priced codes at f32).
+        Recordings from before the fields existed skip the check."""
+        from tools import telemetry_report
+
+        total = 8
+        events = _mem_stream(pool_bytes=total * page_bytes + 58)
+        stats = next(e for e in events if e["kind"] == "serve_stats")
+        stats.update(pages_total=total, pages_in_use=0, num_slots=2,
+                     kv_dtype=kv_dtype, page_bytes=page_bytes)
+        assert telemetry_report.check_serve(events) == []
+        # a pool priced at the WRONG dtype (4x codes) is flagged
+        stats["pool_bytes"] = total * page_bytes * 4
+        events[0]["pool_bytes"] = stats["pool_bytes"]
+        fails = telemetry_report.check_serve(events)
+        assert any("priced page bytes" in f and kv_dtype in f
+                   for f in fails), fails
+        # a torn-down pool (pool_bytes 0) has nothing resident: skip
+        stats["pool_bytes"] = 0
+        events[0]["pool_bytes"] = 0
+        assert telemetry_report.check_serve(events) == []

@@ -817,6 +817,98 @@ class TestPagedKV:
         srv.close()
 
 
+class TestKVQuantPages:
+    """ISSUE 18: int8 page storage — representation-error pins (the
+    PARITY.md tolerance), the env knob, and the one-executable
+    steady-state discipline on a quantized pool."""
+
+    def test_requant_roundtrip_bound_and_drift_free(self):
+        """The PARITY.md representation pins: dequantized values sit
+        within page_absmax/254 (half a code step) of the written
+        values, and floor-scale requantization is drift-free — codes
+        re-quantized at their own scale round-trip EXACTLY, so a
+        frontier page's RMW never re-rounds already-written columns."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.models.decoding import _kv_dequant, _kv_requant
+
+        rng = onp.random.RandomState(0)
+        vals = jnp.asarray(rng.randn(2, 4, 16, 8).astype("float32"))
+        codes, scales = _kv_requant(vals, 0.0)
+        assert codes.dtype == jnp.int8 and scales.dtype == jnp.float32
+        deq = _kv_dequant(codes, scales, jnp.float32)
+        amax = onp.max(onp.abs(onp.asarray(vals)), axis=(-2, -1))
+        err = onp.max(onp.abs(onp.asarray(deq - vals)), axis=(-2, -1))
+        assert onp.all(err <= amax / 254.0 * (1 + 1e-5))
+        # drift-free: requantizing the dequantized page at its own
+        # floor scale reproduces codes and scales bit-for-bit
+        codes2, scales2 = _kv_requant(deq, scales)
+        assert onp.array_equal(onp.asarray(codes), onp.asarray(codes2))
+        assert onp.array_equal(onp.asarray(scales),
+                               onp.asarray(scales2))
+        # scales only ratchet: a larger floor wins, a smaller one is
+        # ignored
+        _, s_up = _kv_requant(deq, scales * 2)
+        assert onp.allclose(onp.asarray(s_up),
+                            onp.asarray(scales) * 2)
+
+    def test_kv_dtype_env_knob_and_validation(self, net, monkeypatch):
+        """MXNET_SERVE_KV_DTYPE selects the pool storage dtype; the
+        explicit constructor argument wins; malformed values are a
+        constructor error naming the variable."""
+        from mxnet_tpu.serve import DecodeServer
+        monkeypatch.setenv("MXNET_SERVE_KV_DTYPE", "int8")
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           autostart=False)
+        assert srv.kv_dtype == "int8"
+        assert srv.stats()["kv_dtype"] == "int8"
+        pb_i8 = srv.stats()["page_bytes"]
+        p = _prompt(220, 6)
+        s = srv.submit(p, max_new_tokens=8)
+        _drain(srv)
+        ref = _ref(net, p, 8)
+        agree = sum(int(a == b)
+                    for a, b in zip(s.tokens(5), ref)) / len(ref)
+        assert agree >= 0.9, (s.tokens(5), ref)
+        srv.close()
+        # explicit argument beats the env
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           kv_dtype="f32", autostart=False)
+        assert srv.kv_dtype == "native"
+        assert srv.stats()["page_bytes"] > 2 * pb_i8
+        srv.close()
+        monkeypatch.setenv("MXNET_SERVE_KV_DTYPE", "int4")
+        with pytest.raises(MXNetError, match="KV_DTYPE"):
+            DecodeServer(net, max_total_len=64, autostart=False)
+
+    def test_int8_churn_never_retraces(self, net):
+        """The tentpole's compile discipline on the QUANTIZED pool:
+        admit / hit / chunk / retire churn against int8 pages keeps
+        every executable at one signature — quantization lives inside
+        the same programs, not beside them."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           prefill_buckets=(8, 16), kv_dtype="int8",
+                           autostart=False)
+        p_long = _prompt(221, 24)        # chunks (24 > bucket 16)
+        p_short = _prompt(222, 6)
+        for p, n in ((p_short, 4), (p_long, 4), (p_short, 3),
+                     (p_long, 3)):
+            s = srv.submit(p, max_new_tokens=n)
+            _drain(srv)
+            got, ref = s.tokens(5), _ref(net, p, n)
+            agree = sum(int(a == b) for a, b in zip(got, ref)) / n
+            assert agree >= 0.9, (got, ref)
+        assert srv.counters["prefix_hits"] >= 1
+        assert srv.counters["chunk_dispatches"] >= 1
+        assert srv._progs.step_fn()._cache_size() == 1
+        for fns in (srv._progs._admits, srv._progs._chunks,
+                    srv._progs._hits):
+            for fn in fns.values():
+                assert fn._cache_size() == 1
+        srv.close()
+
+
 class TestSyncFallback:
     def test_env_hatch_serves_synchronously(self, net, monkeypatch):
         from mxnet_tpu.serve import DecodeServer

@@ -337,6 +337,56 @@ class TestSpecPrefixCache:
 
 
 # --------------------------------------------------------------------- #
+# int8 quantized pool composition (ISSUE 18)
+# --------------------------------------------------------------------- #
+
+class TestInt8SpecComposition:
+    def test_cow_chunk_and_spec_coresident_on_int8_pool(self, net):
+        """ISSUE 18 satellite: every serving feature on ONE int8 pool —
+        a COW prefix hit (zero admit dispatches), a chunked long-prompt
+        prefill, and speculative verify, co-resident.  The draft ledger
+        stays exact, tokens/dispatch clears the speculation bar, and
+        both streams hold the pinned greedy agreement vs the f32
+        reference (int8 is the repo's first lossy serving mode — the
+        bar is PARITY.md's agreement tolerance, not bit-identity)."""
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(2,),
+                           prefill_buckets=(8,), spec=True,
+                           kv_dtype="int8", autostart=False)
+        assert srv.stats()["kv_dtype"] == "int8"
+        p_hit = _prompt(360, 32)         # two full pages -> cacheable
+        p_long = _prompt(361, 21)        # > bucket 8 -> 3 chunk passes
+        warm = srv.submit(p_hit, max_new_tokens=4)
+        _drain(srv)
+        assert len(warm.tokens(5)) == 4
+        srv.reset_counters()
+        s_hit = srv.submit(p_hit, max_new_tokens=16)
+        s_long = srv.submit(p_long, max_new_tokens=20)
+        _drain(srv)
+        c = dict(srv.counters)
+        # the hit admitted through the cache, the long prompt through
+        # chunked prefill: no batched-admit dispatch ran at all
+        assert c["prefix_hits"] == 1
+        assert c["admit_dispatches"] == 0
+        assert c["chunk_dispatches"] == 3
+        # draft ledger exact, speculation live on the quantized pool
+        assert c["verify_dispatches"] > 0
+        assert c["draft_accepted"] > 0
+        assert c["draft_accepted"] + c["draft_rejected"] \
+            == c["draft_proposed"]
+        total = len(s_hit.tokens(5)) + len(s_long.tokens(5))
+        assert total == 36
+        tpd = total / max(total - c["draft_accepted"], 1)
+        assert tpd > 1.5, (tpd, c)
+        # pinned greedy agreement vs the f32 offline decode (PARITY.md)
+        for s, p, n in ((s_hit, p_hit, 16), (s_long, p_long, 20)):
+            got, ref = s.tokens(5), _ref(net, p, n)
+            agree = sum(int(a == b) for a, b in zip(got, ref)) / n
+            assert agree >= 0.9, (agree, got, ref)
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
 # chaos: the serve.verify fault site
 # --------------------------------------------------------------------- #
 

@@ -300,6 +300,34 @@ def smoke():
     finally:
         telemetry.remove_sink(sink)
 
+    # -- ISSUE 18: the same pool geometry recorded twice, f32 pages vs
+    #    int8 (codes + scales) pages — at a budget between the two
+    #    totals the verdict flips "does not fit" -> "fits", which is
+    #    the capacity claim of quantized KV pages stated by the same
+    #    accountant bytes the report prices
+    kv_events = {}
+    for kv_dtype in ("native", "int8"):
+        j2 = os.path.join(tempfile.mkdtemp(prefix="mxtpu_memrep_"),
+                          f"kv_{kv_dtype}.jsonl")
+        sink2 = telemetry.add_jsonl_sink(j2)
+        try:
+            srv = DecodeServer(gpt, max_total_len=24, pool_sizes=(2,),
+                               kv_dtype=kv_dtype, autostart=False)
+            s = srv.submit(rng.randint(0, 64, (4,)), max_new_tokens=4)
+            while srv.pump():
+                pass
+            s.tokens(30)
+            srv.close()
+        finally:
+            telemetry.remove_sink(sink2)
+        kv_events[kv_dtype] = load(j2)
+    t_f32 = fit_verdict(kv_events["native"], 1)["total_bytes"]
+    t_i8 = fit_verdict(kv_events["int8"], 1)["total_bytes"]
+    assert t_i8 < t_f32, (t_i8, t_f32)
+    mid = (t_i8 + t_f32) // 2
+    assert not fit_verdict(kv_events["native"], mid)["fits"]
+    assert fit_verdict(kv_events["int8"], mid)["fits"]
+
     events = load(jsonl)
     comp = compile_memory(events)
     sites = {r["site"] for r in comp}
@@ -321,7 +349,9 @@ def smoke():
           f"{len(subs)} resident subsystems, "
           f"total {fmt_bytes(verdict['total_bytes'])} "
           f"fits 16G with {fmt_bytes(verdict['headroom_bytes'])} "
-          "headroom")
+          "headroom; int8 KV pages flip the verdict at "
+          f"{fmt_bytes(mid)} ({fmt_bytes(t_f32)} f32 does not fit, "
+          f"{fmt_bytes(t_i8)} int8 fits)")
     return 0
 
 

@@ -380,6 +380,31 @@ def check_serve(events):
             failures.append(
                 f"{st.get('server', '?')}: {used} pages in use exceed "
                 f"the pool capacity {total}")
+
+    # dtype-aware page pricing (ISSUE 18): the reported pool bytes must
+    # equal pages_total * the PRICED page size (codes + scales under
+    # kv_dtype=int8) plus the per-slot scalar state — an int8 pool
+    # billed at f32 page bytes (or vice versa) fails here.  Pre-int8
+    # recordings lack page_bytes and skip the check; the retrace key
+    # above is deliberately dtype-free (kv_dtype never shapes a trace
+    # signature beyond the operand dtypes it already keys).
+    for st in stats:
+        pb = st.get("pool_bytes")
+        page_bytes = st.get("page_bytes")
+        total = st.get("pages_total")
+        slots = st.get("num_slots")
+        if None in (pb, page_bytes, total, slots) or pb == 0:
+            continue   # sync mode / torn-down pool: nothing resident
+        priced = total * page_bytes
+        # slot scalar state is small but exact: pool_bytes - pages
+        # must land in [0, slots * 64) (the per-slot scalars are a few
+        # dozen bytes; 64 bounds them without re-pinning the layout)
+        if not 0 <= pb - priced < slots * 64:
+            failures.append(
+                f"{st.get('server', '?')}: serve_stats pool_bytes {pb} "
+                f"inconsistent with {total} pages * {page_bytes} "
+                f"priced page bytes (kv_dtype="
+                f"{st.get('kv_dtype', 'native')})")
     if not configs and not stats:
         failures.append("no serve_config/serve_stats events in the "
                         "stream — nothing to check")
