@@ -434,7 +434,7 @@ def run_kv_quant_residency(net, cfg, n_requests):
             "greedy_agreement": float(agree),
             "tokens_per_sec_int8": out["int8"]["tokens_per_sec"],
             "chunk_dispatches_int8":
-                out["int8"]["counters"]["chunk_dispatches"]}
+                out["int8"]["counters"].get("chunk_dispatches", 0)}
 
 
 def run_prefix_hits(net, cfg, S, P, N, n_hits):

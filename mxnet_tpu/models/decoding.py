@@ -131,7 +131,19 @@ def _kv_requant(vals, floor_scales):
     drift over the up-to-``page`` step rewrites a frontier page sees.
     When the range DOES grow, the whole page re-rounds at the coarser
     scale, exactly what a one-shot quantization of the final page
-    contents would have produced."""
+    contents would have produced.  Two preconditions keep the ratchet
+    honest (both documented in PARITY.md):
+
+    - a page must enter a slot's reservation with a ZERO scale — the
+      serving admission/chunk executables scale-reset every freshly
+      allocated page (a zero scale dequantizes a recycled page's stale
+      codes to exact zeros), so the floor can never inherit a previous
+      tenant's dynamic range;
+    - speculative verify quantizes drafted columns BEFORE acceptance
+      is known, so a rejected draft's magnitude can ratchet its page's
+      scale (see ``_kv_verify_rmw``) — the one case where the final
+      scale may be coarser than one-shot quantization of the surviving
+      contents."""
     v32 = vals.astype(jnp.float32)
     amax = jnp.max(jnp.abs(v32), axis=(-2, -1))
     s = jnp.maximum(jnp.maximum(amax / 127.0, floor_scales), 1e-8)
@@ -190,7 +202,18 @@ def _kv_verify_rmw(pool, wpgs, iB, loc, new_bd, page, ntp):
     window-local column offsets ``loc[b]``.  Slots' write windows are
     disjoint (every window page belongs to its slot's reserved,
     exclusively-owned range), so the batched whole-page scatter never
-    collides.  ``new_bd`` is ``(B, C, NL, KV, D)``."""
+    collides.  ``new_bd`` is ``(B, C, NL, KV, D)``.
+
+    Known deviation (documented in PARITY.md): all ``C`` drafted
+    columns quantize here BEFORE acceptance is known.  Rejection rolls
+    ``pos`` back — the garbage columns become unreachable and are
+    overwritten by later writes at the same positions — but a rejected
+    draft's magnitude has already ratcheted the page scale via the
+    monotone floor, so subsequently accepted tokens on that page can
+    quantize coarser than a one-shot quantization of the surviving
+    contents.  Accepted-column error still respects the per-write
+    ``scale/2`` code-step bound; the end-to-end effect is covered by
+    the pinned greedy-agreement tolerance."""
     codes, scales = pool
     old_s = scales.at[:, wpgs].get(mode="fill", fill_value=0)
     win = _kv_dequant(codes.at[:, wpgs].get(mode="fill", fill_value=0),

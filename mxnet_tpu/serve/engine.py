@@ -403,8 +403,10 @@ class PoolPrograms:
         per ``(A, P)`` bucket pair): ``admit(param_vals, prompts
         (A, P) int32, meta (A, 6) int32 rows = [valid, true_len, slot,
         stop_pos, seed, spec_depth], dls (A,) float32 per-row deadlines,
-        pages (A, NPB) int32 reserved-page rows, kp, vp, pos, tok,
-        active, stop, keys, dl, spec)`` → new state + ``(first_tok
+        pages (A, NPB) int32 reserved-page rows, zpages (A, MAXP) int32
+        full reserved rows (sentinel-padded; int8 pools zero these
+        pages' SCALES before anything writes — see below), kp, vp, pos,
+        tok, active, stop, keys, dl, spec)`` → new state + ``(first_tok
         (A,), done (A,))``.
 
         ONE causal prefill over the whole block fills a dense ``(A,
@@ -440,8 +442,8 @@ class PoolPrograms:
         peng.take_operands()    # server-held operands are the only refs
         NL, KV, D = peng.NL, peng.KV, peng.D
 
-        def admit(param_vals, prompts, meta, dls, pages, kp, vp, pos,
-                  tok, active, stop, keys, dl, spec):
+        def admit(param_vals, prompts, meta, dls, pages, zpages, kp, vp,
+                  pos, tok, active, stop, keys, dl, spec):
             valid = meta[:, 0] != 0
             true_len, slot, stop_pos, seed = (meta[:, 1], meta[:, 2],
                                               meta[:, 3], meta[:, 4])
@@ -483,6 +485,20 @@ class PoolPrograms:
                 qc1, sc1 = _kv_requant(c1, 0.0)
                 qv1, sv1 = _kv_requant(v1, 0.0)
                 (kpc, kps), (vpc, vps) = kp, vp
+                # recycled-page reset: the pool free list is host-only
+                # bookkeeping, so a reallocated page still carries its
+                # previous tenant's codes AND scale.  A zero SCALE is a
+                # full reset — stale codes dequantize to exact zeros
+                # and the first RMW requantizes from floor 0.0, so the
+                # old tenant's dynamic range can never ratchet the new
+                # tenant's scale.  ``zpages`` holds every page the wave
+                # reserved (decode-frontier pages included — those are
+                # first WRITTEN by the step/verify RMWs); the prompt
+                # pages' scales are immediately overwritten by the
+                # scatter below.  Sentinel entries DROP.
+                zf = zpages.reshape(A * zpages.shape[1])
+                kps = kps.at[:, zf].set(0.0, mode="drop")
+                vps = vps.at[:, zf].set(0.0, mode="drop")
                 kp = (kpc.at[:, tgt_pg].set(qc1, mode="drop"),
                       kps.at[:, tgt_pg].set(sc1, mode="drop"))
                 vp = (vpc.at[:, tgt_pg].set(qv1, mode="drop"),
@@ -505,7 +521,7 @@ class PoolPrograms:
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(admit, donate_argnums=(5, 6)), "serve.admit",
+            jax.jit(admit, donate_argnums=(6, 7)), "serve.admit",
             key=(self.telemetry_label, self.S, A, P),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "a_bucket": A, "p_bucket": P,
@@ -520,9 +536,11 @@ class PoolPrograms:
         """The jitted PREFIX-CACHE-HIT admission program for up to
         ``a_bucket`` rows (cached per bucket): ``hit(meta (A, 7) int32
         rows = [valid, true_len, slot, stop_pos, seed, last_tok,
-        spec_depth], dls (A,), src (A,), dst (A,), kp, vp, pos, tok,
-        active, stop, keys, dl, spec)`` → new state (no readback: a hit
-        emits nothing at admission).
+        spec_depth], dls (A,), src (A,), dst (A,), zpages (A, MAXP)
+        int32 fresh-owned-page rows (sentinel-padded; int8 pools zero
+        these pages' SCALES), kp, vp, pos, tok, active, stop, keys, dl,
+        spec)`` → new state (no readback: a hit emits nothing at
+        admission).
 
         NO model forward runs: the host has already mapped the shared
         prefix pages into the slot's table row, so admission is a
@@ -544,8 +562,8 @@ class PoolPrograms:
         if A < 1:
             raise MXNetError(f"admission bucket {A} must be >= 1")
 
-        def hit(meta, dls, src, dst, kp, vp, pos, tok, active, stop,
-                keys, dl, spec):
+        def hit(meta, dls, src, dst, zpages, kp, vp, pos, tok, active,
+                stop, keys, dl, spec):
             valid = meta[:, 0] != 0
             true_len, slot, stop_pos, seed, last_tok = (
                 meta[:, 1], meta[:, 2], meta[:, 3], meta[:, 4],
@@ -558,18 +576,24 @@ class PoolPrograms:
             # grid is part of its identity, refcounted as one unit.
             if self.quant_kv:
                 (kpc, kps), (vpc, vps) = kp, vp
-                kp = (kpc.at[:, dst].set(
-                          kpc.at[:, src].get(mode="fill", fill_value=0),
-                          mode="drop"),
-                      kps.at[:, dst].set(
-                          kps.at[:, src].get(mode="fill", fill_value=0),
-                          mode="drop"))
-                vp = (vpc.at[:, dst].set(
-                          vpc.at[:, src].get(mode="fill", fill_value=0),
-                          mode="drop"),
-                      vps.at[:, dst].set(
-                          vps.at[:, src].get(mode="fill", fill_value=0),
-                          mode="drop"))
+                kcb = kpc.at[:, src].get(mode="fill", fill_value=0)
+                ksb = kps.at[:, src].get(mode="fill", fill_value=0)
+                vcb = vpc.at[:, src].get(mode="fill", fill_value=0)
+                vsb = vps.at[:, src].get(mode="fill", fill_value=0)
+                # recycled-page reset (see admit_fn): zero the SCALES
+                # of every freshly-owned page in the wave — including
+                # each row's decode-frontier pages and the COW dst —
+                # AFTER the src gathers above (a src page can double as
+                # another row's fresh page when an eviction inside this
+                # same wave recycled it) and BEFORE the dst scatter
+                # below re-lands the copied scale.
+                zf = zpages.reshape(-1)
+                kps = kps.at[:, zf].set(0.0, mode="drop")
+                vps = vps.at[:, zf].set(0.0, mode="drop")
+                kp = (kpc.at[:, dst].set(kcb, mode="drop"),
+                      kps.at[:, dst].set(ksb, mode="drop"))
+                vp = (vpc.at[:, dst].set(vcb, mode="drop"),
+                      vps.at[:, dst].set(vsb, mode="drop"))
             else:
                 kblk = kp.at[:, src].get(mode="fill", fill_value=0)
                 vblk = vp.at[:, src].get(mode="fill", fill_value=0)
@@ -586,7 +610,7 @@ class PoolPrograms:
             return (kp, vp, pos, tok, active, stop, keys, dl, spec)
 
         fn = telemetry.instrument_jit(
-            jax.jit(hit, donate_argnums=(4, 5)), "serve.admit_hit",
+            jax.jit(hit, donate_argnums=(5, 6)), "serve.admit_hit",
             key=(self.telemetry_label, self.S, A),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "a_bucket": A})
@@ -598,8 +622,10 @@ class PoolPrograms:
         of a single prompt (cached per chunk bucket): ``chunk(
         param_vals, q8, sw, toks (C,) int32, meta (8,) int32 =
         [final, slot, true_len, stop_pos, seed, nlast, off,
-        spec_depth], dls scalar f32, ptrow (MAXP,) int32, kp, vp, pos,
-        tok, active, stop, keys, dl, spec)`` → new state +
+        spec_depth], dls scalar f32, ptrow (MAXP,) int32, zrow (MAXP,)
+        int32 pages to scale-reset before the RMW (the slot's freshly
+        allocated pages on its FIRST chunk, sentinel afterward), kp,
+        vp, pos, tok, active, stop, keys, dl, spec)`` → new state +
         ``(first_tok, done)`` scalars.
 
         The slice occupies absolute positions ``off .. off+C-1`` of the
@@ -627,13 +653,24 @@ class PoolPrograms:
         deng = self.eng
         page = self.page
 
-        def chunk(param_vals, q8, sw, toks, meta, dls, ptrow, kp, vp,
-                  pos, tok, active, stop, keys, dl, spec):
+        def chunk(param_vals, q8, sw, toks, meta, dls, ptrow, zrow, kp,
+                  vp, pos, tok, active, stop, keys, dl, spec):
             final, slot, true_len, stop_pos, seed, nlast, off = (
                 meta[0], meta[1], meta[2], meta[3], meta[4], meta[5],
                 meta[6])
             spec_d = meta[7]
             key1 = jax.random.PRNGKey(seed)                   # (2,)
+            if self.quant_kv:
+                # recycled-page reset (see admit_fn): the chunk RMW
+                # gathers each window page's scale as its requant
+                # FLOOR, so stale scales must be zeroed before the
+                # first chunk touches the slot's pages.  The host sends
+                # the freshly-allocated rows in ``zrow`` on the first
+                # chunk only (all-sentinel afterward — later chunks
+                # must keep the ratchet of earlier ones).
+                (kpc, kps), (vpc, vps) = kp, vp
+                kp = (kpc, kps.at[:, zrow].set(0.0, mode="drop"))
+                vp = (vpc, vps.at[:, zrow].set(0.0, mode="drop"))
             with _TRACE_LOCK, params_swapped(deng.params, param_vals):
                 logits, kp, vp = deng.chunk_tokens(
                     toks, off, nlast, ptrow, page, kp, vp, sw, q8)
@@ -657,7 +694,7 @@ class PoolPrograms:
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(chunk, donate_argnums=(7, 8)), "serve.chunk",
+            jax.jit(chunk, donate_argnums=(8, 9)), "serve.chunk",
             key=(self.telemetry_label, self.S, C),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "c_bucket": C,

@@ -1623,7 +1623,7 @@ class DecodeServer:
                     self._chunk_slots.add(p["slot"])
                     self._chunking.append(
                         {"req": p["req"], "slot": p["slot"],
-                         "off": p["off"]})
+                         "off": p["off"], "zero": p["zero"]})
             # hits dispatch FIRST: a COW source page another plan's
             # eviction freed and re-allocated this wave must be copied
             # before any admit/chunk dispatch can overwrite it (the
@@ -1680,6 +1680,13 @@ class DecodeServer:
         # reservation carry the sentinel, so their scatter drops
         npb = -(-P // self._progs.page)
         pages = onp.full((A, npb), self._progs.num_pages, onp.int32)
+        # int8 recycled-page reset operand: EVERY page the wave
+        # reserved (decode-frontier pages included — those are first
+        # written by the step/verify RMWs, which floor at the page's
+        # resident scale).  The executable zeroes their scales before
+        # its own page writes; f32 pools ignore the operand.
+        zpages = onp.full((A, self._progs.maxp), self._progs.num_pages,
+                          onp.int32)
         for i, (slot, req) in enumerate(wave):
             n = req.prompt.size
             prompts[i, :n] = req.prompt
@@ -1690,6 +1697,7 @@ class DecodeServer:
             row = self._slot_pages[slot]
             k = min(npb, len(row))
             pages[i, :k] = row[:k]
+            zpages[i, :len(row)] = row
         # request-span admission fields + one serve_admit event per
         # dispatch (waves are step-boundary-rare, not per-token)
         now = time.perf_counter()
@@ -1708,7 +1716,8 @@ class DecodeServer:
         param_vals, q8, sw = self._progs.operands
         with telemetry.annotation("mx:serve:admit"):
             new_state, (first, done) = fn(param_vals, prompts, meta,
-                                          dls, pages, *self._state)
+                                          dls, pages, zpages,
+                                          *self._state)
         self._state = new_state
         if self._torn:
             # the watchdog tore the server down while this dispatch was
@@ -1820,7 +1829,7 @@ class DecodeServer:
                            shared_pages=m, cow_copy=False,
                            partial=True)
         return {"mode": "chunk", "req": req, "slot": slot,
-                "off": m * PG}
+                "off": m * PG, "zero": owned}
 
     def _page_table(self):
         """The step's ``(S, MAXP)`` int32 page-table operand, sentinel-
@@ -1852,6 +1861,11 @@ class DecodeServer:
         dls = onp.full((A,), onp.inf, onp.float32)
         srcs = onp.full((A,), sentinel, onp.int32)
         dsts = onp.full((A,), sentinel, onp.int32)
+        # int8 recycled-page reset operand: each hit row's freshly
+        # OWNED pages (decode frontier + the COW dst) — the shared
+        # prefix pages keep their resident scales.  The executable
+        # zeroes these AFTER its src gathers, BEFORE its dst scatter.
+        zpages = onp.full((A, self._progs.maxp), sentinel, onp.int32)
         now = time.perf_counter()
         S = len(self._slots)
         busy = sum(r is not None for r in self._slots)
@@ -1868,6 +1882,8 @@ class DecodeServer:
                 srcs[i] = plan["src"]
                 dsts[i] = plan["dst"]
                 self._count("cow_copies")
+            fresh = self._slot_pages[slot][plan["shared"]:]
+            zpages[i, :len(fresh)] = fresh
             self._count("prefix_hits")
             wait = now - req.stream.submit_time
             req.span.update(queue_wait_s=wait, wave=len(hits),
@@ -1880,7 +1896,8 @@ class DecodeServer:
                            shared_pages=plan["shared"],
                            cow_copy=plan["src"] >= 0, partial=False)
         with telemetry.annotation("mx:serve:admit_hit"):
-            new_state = fn(meta, dls, srcs, dsts, *self._state)
+            new_state = fn(meta, dls, srcs, dsts, zpages,
+                           *self._state)
         self._state = new_state
         if self._torn:
             self._state = None
@@ -1945,10 +1962,20 @@ class DecodeServer:
                          onp.int32)
         row = self._slot_pages[slot]
         ptrow[:len(row)] = row
+        # int8 recycled-page reset operand: the slot's freshly
+        # allocated pages ride the FIRST chunk dispatch only (their
+        # stale scales must be zeroed before the first RMW floors on
+        # them); later chunks send all-sentinel — they must keep the
+        # scale ratchet of earlier chunks.  f32 pools ignore it.
+        zrow = onp.full((self._progs.maxp,), self._progs.num_pages,
+                        onp.int32)
+        zero = rec.pop("zero", None)
+        if zero:
+            zrow[:len(zero)] = zero
         param_vals, q8, sw = self._progs.operands
         with telemetry.annotation("mx:serve:chunk"):
             new_state, (first, done) = fn(param_vals, q8, sw, toks,
-                                          meta, dl, ptrow,
+                                          meta, dl, ptrow, zrow,
                                           *self._state)
         self._state = new_state
         if self._torn:

@@ -908,6 +908,121 @@ class TestKVQuantPages:
                 assert fn._cache_size() == 1
         srv.close()
 
+    # recycled-page scale reset (post-review regression): the pool
+    # free list is host-only bookkeeping, so a reallocated page still
+    # holds its previous tenant's codes AND per-page scale on device.
+    # The requantizing RMWs floor each write at the page's resident
+    # scale (monotone ratchet), so WITHOUT a reset the first touch of
+    # a recycled page pins its scale to the OLD tenant's dynamic range
+    # — breaking the PARITY.md absmax/254 bound exactly under churn.
+    # Each admission path (admit / prefix-hit / chunk) must zero the
+    # scales of every freshly allocated page inside its own dispatch.
+
+    @staticmethod
+    def _scales(srv):
+        (_, ks), (_, vs) = srv._state[0], srv._state[1]
+        return onp.asarray(ks), onp.asarray(vs)
+
+    def test_recycled_pages_reset_on_admit(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           prefill_buckets=(8, 16), kv_dtype="int8",
+                           spec=False, autostart=False)
+        # tenant A dirties pages with real (nonzero) scales, then
+        # retires — its pages return to the free list un-zeroed
+        pa = _prompt(230, 14)
+        sa = srv.submit(pa, max_new_tokens=18)     # 2 pages, both hit
+        _drain(srv)
+        assert sa.tokens(5) is not None
+        ks0, _ = self._scales(srv)
+        dirty = {p for p in range(4) if onp.any(ks0[:, p] != 0)}
+        assert dirty and dirty <= set(srv._pages._free)
+        # tenant B reserves the WHOLE pool: prompt page + 3 decode-
+        # frontier pages, at least one of which A dirtied
+        pb = _prompt(231, 6)
+        sb = srv.submit(pb, max_new_tokens=58)
+        assert srv.pump()
+        row = srv._slot_pages[0]
+        assert len(row) == 4
+        assert set(row[1:]) & dirty, (row, dirty)  # churn precondition
+        ks, vs = self._scales(srv)
+        # admit wrote the prompt page; the reserved-but-unwritten
+        # frontier pages must carry ZERO scales (reset happened) so
+        # their first RMW floors at 0, not at A's range
+        assert onp.all(ks[:, row[1:]] == 0), ks[:, row[1:]]
+        assert onp.all(vs[:, row[1:]] == 0), vs[:, row[1:]]
+        assert onp.all(ks[:, row[0]] > 0)          # prompt page landed
+        _drain(srv)
+        ref = _ref(net, pb, 58)
+        got = sb.tokens(5)
+        agree = sum(int(a == b) for a, b in zip(got, ref)) / len(ref)
+        assert agree >= 0.9, (got, ref)
+        srv.close()
+
+    def test_recycled_pages_reset_on_prefix_hit(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           prefill_buckets=(8, 16), kv_dtype="int8",
+                           spec=False, autostart=False)
+        p = _prompt(232, 16)                       # one full page
+        sa = srv.submit(p, max_new_tokens=32)      # 3 pages dirtied
+        _drain(srv)
+        assert sa.tokens(5) is not None
+        ks0, _ = self._scales(srv)
+        dirty = {p for p in range(4) if onp.any(ks0[:, p] != 0)}
+        # resubmit: full prefix hit with one COW copy (prompt ends on
+        # the shared page boundary); the fresh pages are recycled
+        sb = srv.submit(p, max_new_tokens=16)
+        assert srv.pump()
+        assert srv.counters["prefix_hits"] >= 1
+        assert srv.counters["cow_copies"] >= 1
+        row = srv._slot_pages[0]
+        assert len(row) == 2
+        assert set(row[1:]) & dirty, (row, dirty)  # churn precondition
+        ks, vs = self._scales(srv)
+        # row[0] is the COW dst: zeroed, then the copied scale landed
+        assert onp.all(ks[:, row[0]] > 0)
+        # row[1] is a recycled decode-frontier page: must be reset
+        assert onp.all(ks[:, row[1]] == 0), ks[:, row[1]]
+        assert onp.all(vs[:, row[1]] == 0), vs[:, row[1]]
+        _drain(srv)
+        ref = _ref(net, p, 16)
+        got = sb.tokens(5)
+        agree = sum(int(a == b) for a, b in zip(got, ref)) / len(ref)
+        assert agree >= 0.9, (got, ref)
+        srv.close()
+
+    def test_recycled_pages_reset_on_chunked_prefill(self, net):
+        from mxnet_tpu.serve import DecodeServer
+        srv = DecodeServer(net, max_total_len=64, pool_sizes=(1,),
+                           prefill_buckets=(8, 16), kv_dtype="int8",
+                           spec=False, autostart=False)
+        pa = _prompt(233, 30)                      # > bucket: chunks
+        srv.submit(pa, max_new_tokens=18)          # 3 pages dirtied
+        _drain(srv)
+        ks0, _ = self._scales(srv)
+        dirty = {p for p in range(4) if onp.any(ks0[:, p] != 0)}
+        pb = _prompt(234, 24)
+        pb[0] = (pa[0] + 1) % 97                   # no prefix match
+        sb = srv.submit(pb, max_new_tokens=40)     # needs all 4 pages
+        assert srv.pump()                          # FIRST chunk only
+        row = srv._slot_pages[0]
+        assert len(row) == 4
+        # chunk 1 (16 tokens) writes window pages row[0:2]; the pages
+        # beyond it were only scale-reset by the dispatch's zrow
+        assert set(row[2:]) & dirty, (row, dirty)  # churn precondition
+        ks, vs = self._scales(srv)
+        assert onp.all(ks[:, row[2:]] == 0), ks[:, row[2:]]
+        assert onp.all(vs[:, row[2:]] == 0), vs[:, row[2:]]
+        assert onp.all(ks[:, row[0]] > 0)          # chunk 1 landed
+        _drain(srv)
+        assert srv.counters["chunk_dispatches"] >= 2
+        ref = _ref(net, pb, 40)
+        got = sb.tokens(5)
+        agree = sum(int(a == b) for a, b in zip(got, ref)) / len(ref)
+        assert agree >= 0.9, (got, ref)
+        srv.close()
+
 
 class TestSyncFallback:
     def test_env_hatch_serves_synchronously(self, net, monkeypatch):
