@@ -1,0 +1,246 @@
+#!/usr/bin/env python3
+"""Multi-process pod fused-step bench: N launched CPU processes
+forming one global ``jax.distributed`` mesh (gloo collectives) vs the
+single-process virtual-device mesh at the SAME dp extent.
+
+Two headline arms, printed as BENCH-format JSON rows (and mirrored to
+the telemetry stream as ``bench`` events, like serve_bench):
+
+  * ``single`` — one process, ``--xla_force_host_platform_device_count``
+    giving it N virtual CPU devices: the pre-ISSUE-19 CI shape, every
+    collective stays in-process.
+  * ``pod`` — ``tools/launch.py -n N``: N real processes, one device
+    each, the grad all-reduce compiled across process boundaries.  Per
+    rank we report samples/sec and executable dispatches per step (the
+    one-dispatch-per-step discipline is an assertion, not a hope).
+
+The gap between the arms is the cost of real cross-process collectives
+at equal mesh geometry — on CPU/gloo it bounds the dispatch-discipline
+overhead, on a real pod it becomes the DCN/ICI number the paper's
+scaling section cares about.
+
+    python benchmark/dist_bench.py --smoke     # tier-1 geometry
+    python benchmark/dist_bench.py             # bigger model, more steps
+
+``--worker`` is the internal per-rank entry (spawned via launch.py or
+directly for the single arm); it prints a ``worker`` row the
+orchestrator aggregates.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def emit_row(row):
+    """Stdout JSON line + telemetry ``bench`` event (serve_bench's
+    dual-sink row contract, so sweep recordings carry the rows)."""
+    print(json.dumps(row))
+    sys.stdout.flush()
+    from mxnet_tpu import telemetry
+    telemetry.emit("bench", **row)
+
+
+# ---------------------------------------------------------------- worker
+
+def run_worker(args):
+    """One rank of either arm: join the pod (no-op when launched solo),
+    train ``--steps`` fused steps over the global mesh, report
+    steady-state samples/sec and dispatches/step."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.fused_step import (reset_step_counters,
+                                            step_counters)
+
+    rank = int(os.environ.get("MXNET_WORKER_ID", "0"))
+    parallel.init_distributed()
+    import jax
+
+    world = jax.process_count()
+    ndev = len(jax.devices())
+    local_bs = args.global_bs // world
+    mesh = parallel.make_mesh({"dp": ndev})
+    data_sh = parallel.data_sharding(mesh)
+
+    mx.random.seed(11)
+    onp.random.seed(11)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(args.units, use_bias=False,
+                         in_units=args.units))
+        net.add(nn.Dense(args.units, use_bias=False,
+                         in_units=args.units))
+        net.add(nn.Dense(1, use_bias=False, in_units=args.units))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3}, kvstore=None)
+    loss_l = gluon.loss.L2Loss()
+
+    def loss_fn(bx, by):
+        return loss_l(net(bx), by).mean()
+
+    rng = onp.random.RandomState(5)
+    X = rng.rand(args.global_bs, args.units).astype(onp.float32)
+    Y = rng.rand(args.global_bs, 1).astype(onp.float32)
+    lo, hi = rank * local_bs, (rank + 1) * local_bs
+    bx = mx.nd.array(X[lo:hi])
+    by = mx.nd.array(Y[lo:hi])
+
+    # warmup = the compile; everything after is the steady state
+    float(trainer.fused_step(loss_fn, bx, by, batch_size=1,
+                             data_sharding=data_sh).asnumpy())
+    reset_step_counters()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = trainer.fused_step(loss_fn, bx, by, batch_size=1,
+                                  data_sharding=data_sh)
+    loss.asnumpy()                      # drain the dispatch chain
+    wall = time.perf_counter() - t0
+
+    row = {"worker": rank, "world": world, "devices": ndev,
+           "steps": args.steps, "wall_s": round(wall, 4),
+           "samples_per_sec": round(args.global_bs * args.steps / wall,
+                                    1),
+           "dispatches_per_step":
+               step_counters["dispatches"] / args.steps,
+           "compiles_steady": step_counters["compiles"]}
+    print("WORKER_ROW " + json.dumps(row), flush=True)
+    return 0
+
+
+# ----------------------------------------------------------- orchestrator
+
+def _worker_cmd(args):
+    return [sys.executable, os.path.abspath(__file__), "--worker",
+            "--steps", str(args.steps), "--units", str(args.units),
+            "--global-bs", str(args.global_bs)]
+
+
+def _parse_worker_rows(out):
+    return [json.loads(line[len("WORKER_ROW "):])
+            for line in out.splitlines()
+            if line.startswith("WORKER_ROW ")]
+
+
+def run_single_arm(args):
+    """One process, N VIRTUAL devices: the in-process mesh baseline."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                        f"{args.procs}")
+    env.pop("MXNET_TELEMETRY_JSONL", None)
+    proc = subprocess.run(_worker_cmd(args), env=env, text=True,
+                          capture_output=True, timeout=args.timeout)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        raise SystemExit("dist_bench: single arm failed "
+                         f"(exit {proc.returncode})")
+    (row,) = _parse_worker_rows(proc.stdout)
+    return row
+
+
+def run_pod_arm(args):
+    """N real processes via tools/launch.py, one device each."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)          # 1 device per rank
+    env.pop("MXNET_TELEMETRY_JSONL", None)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", str(args.procs), "--launcher", "local"] \
+        + _worker_cmd(args)
+    proc = subprocess.run(cmd, env=env, text=True, capture_output=True,
+                          timeout=args.timeout)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        sys.stderr.write(proc.stdout[-2000:])
+        raise SystemExit("dist_bench: pod arm failed "
+                         f"(exit {proc.returncode})")
+    rows = _parse_worker_rows(proc.stdout)
+    if len(rows) != args.procs:
+        raise SystemExit(f"dist_bench: expected {args.procs} worker "
+                         f"rows, got {len(rows)}")
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="N-process pod fused-step bench vs the "
+                    "single-process virtual-mesh baseline")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 geometry (small model, few steps)")
+    ap.add_argument("--procs", type=int, default=2,
+                    help="pod size N (and the baseline's virtual "
+                         "device count)")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--units", type=int, default=None)
+    ap.add_argument("--global-bs", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    args.steps = args.steps if args.steps is not None else \
+        (8 if args.smoke else 30)
+    args.units = args.units if args.units is not None else \
+        (64 if args.smoke else 512)
+    args.global_bs = args.global_bs if args.global_bs is not None \
+        else (32 if args.smoke else 256)
+
+    if args.worker:
+        return run_worker(args)
+
+    if args.global_bs % args.procs:
+        raise SystemExit("--global-bs must divide by --procs")
+
+    single = run_single_arm(args)
+    emit_row({"bench": "dist", "mode": "single", "procs": 1,
+              "devices": args.procs,
+              "tokens_per_sec": single["samples_per_sec"],
+              "dispatches_per_step": single["dispatches_per_step"],
+              "compiles_steady": single["compiles_steady"],
+              "wall_s": single["wall_s"]})
+
+    rows = run_pod_arm(args)
+    worst = max(r["wall_s"] for r in rows)
+    pod = {"bench": "dist", "mode": "pod", "procs": args.procs,
+           "devices": args.procs,
+           # the pod moves in lockstep: its throughput is the slowest
+           # rank's wall clock over the same global batches
+           "tokens_per_sec": round(
+               args.global_bs * args.steps / worst, 1),
+           "dispatches_per_step": max(r["dispatches_per_step"]
+                                      for r in rows),
+           "compiles_steady": max(r["compiles_steady"] for r in rows),
+           "wall_s": worst}
+    emit_row(pod)
+    for r in rows:
+        emit_row({"bench": "dist", "mode": f"pod_rank{r['worker']}",
+                  **{k: v for k, v in r.items() if k != "worker"}})
+
+    if pod["dispatches_per_step"] != 1.0 or \
+            pod["compiles_steady"] != 0:
+        raise SystemExit(
+            "dist_bench: the pod arm broke the one-executable-per-step "
+            f"discipline: {pod['dispatches_per_step']} dispatches/step, "
+            f"{pod['compiles_steady']} steady-state compiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,10 +43,12 @@ WANTED = {
     "serve": ("saturated", "ragged_occ=0.25", "ragged_occ=0.5",
               "ragged_occ=1.0", "ragged_spec", "kv_quant_residency",
               "prefix_hit"),
+    "dist": ("single", "pod"),
 }
 # columns worth a BASELINE.md reader's attention, in print order
 COLUMNS = ("tokens_per_sec", "new_tokens_per_sec", "tokens_per_dispatch",
            "accept_rate", "ops_per_step", "ms_per_token",
+           "dispatches_per_step", "procs",
            "continuous_vs_static", "resident_x", "greedy_agreement",
            "p50_ttft_ms", "p99_ttft_ms",
            "p50_hit_ttft_ms", "occupancy", "platform")
@@ -57,7 +59,7 @@ def plan(args, out_dir):
     py = sys.executable
     here = os.path.dirname(os.path.abspath(__file__))
     jobs = []
-    for name in ("decode", "serve"):
+    for name in ("decode", "serve", "dist"):
         argv = [py, os.path.join(here, f"{name}_bench.py")]
         if args.smoke:
             argv.append("--smoke")
@@ -154,7 +156,11 @@ def main(argv=None):
     try:
         for name, cmd, rec in jobs:
             rows = run_job(name, cmd, rec, args.timeout)
-            check_recording(name, rec)
+            if name != "dist":
+                # the dist bench has no serving contract to re-check;
+                # its discipline gate (1 dispatch/step, 0 steady
+                # compiles) is enforced inside dist_bench itself
+                check_recording(name, rec)
             tables.append((name, baseline_table(name, rows)))
     finally:
         if args.out is None and not args.keep:

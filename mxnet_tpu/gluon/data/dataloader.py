@@ -547,6 +547,55 @@ class DataLoader:
                 "resume needs last_batch='keep' or 'discard'")
         return self._make_iter(self._sampler_batches(int(batches_done)))
 
+    def iter_shard(self, batches_done, num_shards=1, shard_id=0):
+        """Elastic pod re-bucketing of ONE shared batch stream: resume
+        the epoch at global batch ``batches_done`` and serve only the
+        batches owned by ``shard_id`` — global batch ``g`` belongs to
+        rank ``(g - batches_done) % num_shards``, so a pod of W ranks
+        stepping in lockstep consumes W consecutive global batches per
+        step.  Foreign shards' indices are DRAWN (the seeded sampler
+        position advances identically on every rank) but never loaded,
+        batchified, or placed.  Because ownership is a pure function of
+        ``(g, batches_done, num_shards)``, a pod that checkpoints its
+        global-batch cursor and resumes on a DIFFERENT rank count
+        re-buckets deterministically: the union of all ranks' streams
+        is exactly the remaining batches, in order, each served once —
+        no sample re-served, none skipped.  Same ``last_batch``
+        restrictions as :meth:`iter_from`."""
+        num_shards = int(num_shards)
+        shard_id = int(shard_id)
+        if num_shards < 1 or not (0 <= shard_id < num_shards):
+            raise MXNetError(
+                f"iter_shard: shard_id {shard_id} out of range for "
+                f"{num_shards} shard(s)")
+        if num_shards == 1:
+            return self.iter_from(batches_done)
+        if getattr(self._batch_sampler, "_last_batch", None) == \
+                "rollover":
+            raise MXNetError(
+                "iter_shard: last_batch='rollover' carries leftover "
+                "indices across epochs in process memory, which a "
+                "resume cannot reconstruct — bit-exact mid-epoch "
+                "resume needs last_batch='keep' or 'discard'")
+        batches_done = int(batches_done)
+        it = iter(self._batch_sampler)
+        for k in range(batches_done):
+            try:
+                next(it)
+            except StopIteration:
+                raise MXNetError(
+                    f"iter_shard({batches_done}): the sampler yields "
+                    f"only {k} batches this epoch — the resume cursor "
+                    "is past the end of the data") from None
+
+        def _gen():
+            for g, batch in enumerate(it, start=batches_done):
+                if (g - batches_done) % num_shards != shard_id:
+                    continue
+                telemetry.fault_point("data.next", batch=g)
+                yield batch
+        return self._make_iter(_gen())
+
     def set_epoch(self, epoch):
         """Forward the epoch position to samplers that support it
         (seeded :class:`RandomSampler` — the resume path)."""

@@ -187,15 +187,16 @@ class FusedStep:
         if sh is None or not hasattr(sh, "mesh"):
             return
         from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.mesh import global_put
 
         tr = self._trainer
         repl = NamedSharding(sh.mesh, PartitionSpec())
         for p in self._train_params + self._frozen_params:
             tgt = p._sharding if p._sharding is not None else repl
-            p._data._data = jax.device_put(p._data._data, tgt)
+            p._data._data = global_put(p._data._data, tgt)
         for i in self._train_idx:
             tr._states[i] = jax.tree.map(
-                lambda a: jax.device_put(a, repl)
+                lambda a: global_put(a, repl)
                 if hasattr(a, "shape") else a, tr._states[i])
 
     # ------------------------------------------------------------------ #
@@ -267,19 +268,44 @@ class FusedStep:
             self._build(nd_batch)
 
         args = []
-        for b in nd_batch:
-            a = b._data
-            if self._data_sharding is not None:
-                a = jax.device_put(a, self._data_sharding)
-            args.append(a)
+        if self._data_sharding is not None:
+            # on a multi-process mesh each rank passes ITS batch slice
+            # and global_put assembles the pod-global batch; the jitted
+            # step then spans process boundaries (grad allreduce over
+            # DCN) while staying one executable dispatch per rank
+            from ..parallel.mesh import global_put
+
+            for b in nd_batch:
+                args.append(global_put(b._data, self._data_sharding))
+        else:
+            args = [b._data for b in nd_batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
         N = tr._update_interval
         train_vals = [p._data._data for p in self._train_params]
         frozen_vals = [p._data._data for p in self._frozen_params]
-        key = mxrandom.next_key()
+        if self._data_sharding is not None \
+                and hasattr(self._data_sharding, "mesh") \
+                and jax.process_count() > 1:
+            # pod discipline: EVERY operand of the global-mesh jit must
+            # be a global array (keys, hypers, the accumulator ring) —
+            # a process-local leftover turns the one-executable step
+            # into a placement error
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.mesh import global_put
+
+            _repl = NamedSharding(self._data_sharding.mesh,
+                                  PartitionSpec())
+
+            def _g(a):
+                return global_put(a, _repl)
+        else:
+            def _g(a):
+                return a
+        key = _g(mxrandom.next_key())
         if N > 1 and self._accum is None:
-            self._accum = self._adopt_pending_accum(tr, train_vals) or [
-                jnp.zeros(v.shape, _grad_dtype(v.dtype))
+            adopted = self._adopt_pending_accum(tr, train_vals)
+            self._accum = [_g(a) for a in adopted] if adopted else [
+                _g(jnp.zeros(v.shape, _grad_dtype(v.dtype)))
                 for v in train_vals]
             # the accumulator ring is a real device-resident cost of
             # update_interval>1 — one ledger entry PER FusedStep (a
@@ -327,9 +353,9 @@ class FusedStep:
             outs, new_ws, new_ss, new_frozen, new_accum = fn(
                 train_vals, states, frozen_vals,
                 self._accum if N > 1 else [], key,
-                jnp.asarray(lrs, jnp.float32),
-                jnp.asarray(wds, jnp.float32),
-                jnp.asarray(ts, jnp.int32), rescale, *args)
+                _g(jnp.asarray(lrs, jnp.float32)),
+                _g(jnp.asarray(wds, jnp.float32)),
+                _g(jnp.asarray(ts, jnp.int32)), _g(rescale), *args)
         tele["lat_apply"].observe(time.perf_counter() - t0)
         tele["d_apply"].inc()
         tele["window"].set(tr._window_pos)

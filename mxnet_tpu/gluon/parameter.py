@@ -333,7 +333,9 @@ class Parameter:
                 c = dctx[0] if isinstance(dctx, (list, tuple)) else dctx
         self._deferred_init = None
         if self._sharding is not None:
-            data = jax.device_put(data, self._sharding)
+            from ..parallel.mesh import global_put
+
+            data = global_put(data, self._sharding)
         elif isinstance(c, Context):
             data = jax.device_put(data, c.jax_device())
         if self._data is None:
@@ -373,7 +375,9 @@ class Parameter:
         reference's per-device replica lists (SURVEY.md §3.3 TP row)."""
         self._sharding = sharding
         if self._data is not None and sharding is not None:
-            self._data._rebind(jax.device_put(self._data._data, sharding))
+            from ..parallel.mesh import global_put
+
+            self._data._rebind(global_put(self._data._data, sharding))
 
     # -- symbol-compat ---------------------------------------------------- #
     def var(self):

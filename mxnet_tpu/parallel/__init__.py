@@ -22,7 +22,7 @@ collectives over a ``jax.sharding.Mesh``:
 from .mesh import (Mesh, P, make_mesh, current_mesh, default_mesh,
                    use_mesh, named_sharding, data_sharding,
                    replicated_sharding, init_distributed, local_mesh_axes,
-                   barrier)
+                   barrier, global_put)
 from .heartbeat import start_heartbeat, stop_heartbeat
 from .collectives import (all_reduce, all_gather, reduce_scatter,
                           broadcast, ring_pass)
@@ -32,7 +32,7 @@ from .pipeline import gpipe_apply, stack_stage_params
 __all__ = [
     "Mesh", "P", "make_mesh", "current_mesh", "default_mesh", "use_mesh",
     "named_sharding", "data_sharding", "replicated_sharding",
-    "init_distributed", "local_mesh_axes", "barrier",
+    "init_distributed", "local_mesh_axes", "barrier", "global_put",
     "start_heartbeat", "stop_heartbeat",
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ring_pass",
     "ShardingRules", "shard_block", "SPMDTrainer",

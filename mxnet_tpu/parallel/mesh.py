@@ -26,7 +26,7 @@ from ..base import MXNetError
 __all__ = ["Mesh", "P", "make_mesh", "current_mesh", "default_mesh",
            "use_mesh", "named_sharding", "data_sharding",
            "replicated_sharding", "init_distributed", "local_mesh_axes",
-           "barrier"]
+           "barrier", "global_put"]
 
 _state = threading.local()
 
@@ -125,6 +125,50 @@ def local_mesh_axes(mesh: Mesh) -> dict:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
+def global_put(x, sharding):
+    """``jax.device_put`` that also works when ``sharding`` spans
+    processes.  Single-process (the virtual-mesh CI shape) this IS
+    ``device_put``; on a multi-process mesh ``device_put`` cannot
+    target non-addressable devices, so the global array is assembled
+    from each process's local data instead
+    (``jax.make_array_from_process_local_data``): a batch-sharded spec
+    treats ``x`` as this rank's batch slice, a replicated spec expects
+    every rank to pass the same full value."""
+    if jax.process_count() == 1 or not hasattr(sharding, "mesh"):
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # already pod-global: device_put reshards globals fine — it is
+        # only HOST data it cannot scatter to non-addressable devices
+        return jax.device_put(x, sharding)
+    local = onp.asarray(x)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def _configure_cpu_collectives():
+    """Point the CPU client at a real cross-process collectives backend
+    BEFORE the backend initializes.  Without this the CPU platform has
+    no multi-process collectives at all — every psum across ranks
+    hangs/fails — which is exactly the backend limit the pre-gloo
+    ``test_kvstore_dist`` multi-process tests died on.  Only applied
+    when the job is explicitly pinned to CPU (``JAX_PLATFORMS=cpu``,
+    the CI stand-in for a pod); TPU pods bring their own ICI/DCN
+    transport.  ``MXNET_CPU_COLLECTIVES`` overrides the implementation
+    name (default ``gloo``; ``none`` disables)."""
+    plats = (os.environ.get("JAX_PLATFORMS") or "").lower()
+    if "cpu" not in [p.strip() for p in plats.split(",")]:
+        return
+    impl = os.environ.get("MXNET_CPU_COLLECTIVES", "gloo")
+    if impl.lower() in ("", "0", "none"):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except Exception:
+        # older jaxlib without pluggable CPU collectives: leave the
+        # default in place; the rendezvous still works, collectives
+        # surface their own (loud) backend error
+        pass
+
+
 def _init_timeout_from_env():
     from ..base import parse_seconds
 
@@ -191,6 +235,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
         initialization_timeout = _init_timeout_from_env()
     if retries is None:
         retries = _init_retries_from_env()
+    _configure_cpu_collectives()
     kwargs = dict(coordinator_address=coordinator_address,
                   num_processes=num_processes,
                   process_id=process_id,
@@ -203,10 +248,24 @@ def init_distributed(coordinator_address: Optional[str] = None,
         # becomes 1s, never a truncated 0 (= immediate deadline)
         kwargs["initialization_timeout"] = max(
             math.ceil(float(initialization_timeout)), 1)
+    from ..telemetry.faults import fault_point
+
     backoff, last = 1.0, None
     for attempt in range(retries + 1):
         try:
+            # chaos hook: a `raise` fault here exercises the bounded
+            # retry/backoff path deterministically on CPU; a `kill`
+            # fault exercises the supervisor's dead-rank handling
+            # mid-rendezvous
+            fault_point("dist.init", coordinator=coordinator_address,
+                        rank=process_id, attempt=attempt)
             jax.distributed.initialize(**kwargs)
+            from ..telemetry.events import emit
+
+            emit("dist_init", rank=process_id,
+                 processes=num_processes, attempts=attempt + 1,
+                 coordinator=coordinator_address,
+                 devices=len(jax.devices()))
             return
         except Exception as e:  # rendezvous/transport failure
             # genuine double-init is a programming error to surface

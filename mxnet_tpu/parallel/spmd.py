@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from .mesh import Mesh, P, default_mesh
+from .mesh import Mesh, P, default_mesh, global_put
 from jax.sharding import NamedSharding
 
 __all__ = ["ShardingRules", "shard_block", "SPMDTrainer"]
@@ -183,6 +183,22 @@ class SPMDTrainer:
         self._opt_states = [
             self._opt.create_state_multi_precision(i, p.data())
             for i, p in enumerate(self._train_params)]
+        if jax.process_count() > 1:
+            # on a pod the jitted step's in_shardings span processes:
+            # host/local-committed values cannot be auto-placed by jit,
+            # so assemble the global params/states up front
+            repl, shard_of, state_shardings = self._shardings()
+            self._train_vals = [global_put(v, shard_of(p)) for v, p in
+                                zip(self._train_vals,
+                                    self._train_params)]
+            self._frozen_vals = [global_put(v, shard_of(p)) for v, p in
+                                 zip(self._frozen_vals,
+                                     self._frozen_params)]
+            self._opt_states = [
+                jax.tree.map(lambda a, sh: global_put(a, sh)
+                             if hasattr(a, "shape") else a, s,
+                             state_shardings(s, p))
+                for s, p in zip(self._opt_states, self._train_params)]
         self._step_fn = self._compile()
         self._built = True
 
@@ -370,8 +386,12 @@ class SPMDTrainer:
             self._rescale / (batch_size if batch_size else 1.0), jnp.float32)
         t0 = jnp.asarray(self._t + 1, jnp.int32)
         sh = NamedSharding(self._mesh, P(None, self._dp_axis))
-        d = jax.device_put(d, sh)
-        l = jax.device_put(l, sh)
+        if jax.process_count() > 1:
+            repl = NamedSharding(self._mesh, P())
+            keys, lr, rescale, t0 = (global_put(a, repl) for a in
+                                     (keys, lr, rescale, t0))
+        d = global_put(d, sh)
+        l = global_put(l, sh)
         losses, self._train_vals, self._opt_states, self._frozen_vals = \
             self._multi_step_fn(self._train_vals, self._opt_states,
                                 self._frozen_vals, keys, lr, rescale, t0,
@@ -427,11 +447,16 @@ class SPMDTrainer:
         rescale = jnp.asarray(
             self._rescale / (batch_size if batch_size else 1.0), jnp.float32)
         t = jnp.asarray(self._t, jnp.int32)
-        d = jax.device_put(d, NamedSharding(self._mesh, P(self._dp_axis)))
-        l = jax.device_put(l, NamedSharding(self._mesh, P(self._dp_axis)))
+        key = mxrandom.next_key()
+        if jax.process_count() > 1:
+            repl = NamedSharding(self._mesh, P())
+            key, lr, rescale, t = (global_put(a, repl) for a in
+                                   (key, lr, rescale, t))
+        d = global_put(d, NamedSharding(self._mesh, P(self._dp_axis)))
+        l = global_put(l, NamedSharding(self._mesh, P(self._dp_axis)))
         loss, self._train_vals, self._opt_states, self._frozen_vals = \
             self._step_fn(self._train_vals, self._opt_states,
-                          self._frozen_vals, mxrandom.next_key(), lr,
+                          self._frozen_vals, key, lr,
                           rescale, t, d, l)
         # sync new values back into the block's Parameters (rebind is
         # async — no host transfer)

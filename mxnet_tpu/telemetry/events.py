@@ -78,13 +78,34 @@ def _attach_env_sink():
         lost_race.close()
 
 
+def _rank_tag():
+    """The pod rank this process was launched as (``MXNET_WORKER_ID``,
+    exported by ``tools/launch.py``), or None single-process.  Read
+    from the environment per emit — one dict lookup, same cost
+    discipline as :func:`telemetry_enabled` — so merged per-rank
+    recordings (``telemetry_report --pod``) can attribute every event
+    to its host without a jax import on the emit path."""
+    raw = os.environ.get("MXNET_WORKER_ID")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
 def emit(kind, **fields):
-    """Record one event; returns the event dict (None when disabled)."""
+    """Record one event; returns the event dict (None when disabled).
+    Pod runs add a ``rank`` field (see :func:`_rank_tag`); an explicit
+    ``rank=`` kwarg wins."""
     if not telemetry_enabled():
         return None
     if not _env_sink_checked:
         _attach_env_sink()
     ev = {"ts": round(time.time(), 6), "kind": str(kind)}
+    rank = _rank_tag()
+    if rank is not None:
+        ev["rank"] = rank
     ev.update(fields)
     with _lock:
         _ensure_ring_locked()

@@ -1,0 +1,187 @@
+"""Deterministic multi-process CPU-mesh pretrain worker — the rank
+program for the pod-runtime tests (ISSUE 19 acceptance).
+
+Every rank joins the pod via ``parallel.init_distributed`` (gloo CPU
+collectives under ``JAX_PLATFORMS=cpu``), forms ONE global mesh over
+``jax.devices()`` (which spans processes), and drives
+``Trainer.fused_step`` with a batch sharded over the pod's ``dp``
+axis: rank r feeds its slice of the GLOBAL batch and
+``parallel.global_put`` assembles the pod-global array, so the jitted
+step's grad reduction crosses process boundaries while staying one
+executable dispatch per step per process.
+
+Determinism/parity contract: the GLOBAL batch stream is identical for
+any world size W (one shared seeded dataset; global batch g is rows
+``[g*B, (g+1)*B)``; rank r of W serves slice ``[r*B/W, (r+1)*B/W)``),
+so a W-process run's loss curve matches the single-process virtual
+mesh numerically, and an ELASTIC resume on W' < W ranks re-buckets the
+same cursor — counted in GLOBAL batches, never per-rank — onto the new
+dp extent without re-serving or skipping a sample.
+
+Checkpoint extra records ``{"batch": global_batches_done, "workers":
+W}``.  Resuming with a DIFFERENT world size is refused unless
+``MXNET_ELASTIC=1`` (exported by ``tools/launch.py --elastic``) — a
+silently resized pod is a bug, an elastic one is a contract.
+
+Fault arming mirrors ``resume_train.py``: ``--fault GEN=SPEC`` arms
+``MXNET_FAULT_INJECT=SPEC`` only when ``restart_count()==GEN`` and
+rank ``== --fault-rank``.
+"""
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as onp  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--global-bs", type=int, default=8)
+    ap.add_argument("--units", type=int, default=8)
+    ap.add_argument("--dir", default=None,
+                    help="checkpoint root (default MXNET_CHECKPOINT_DIR;"
+                         " empty = no checkpointing)")
+    ap.add_argument("--out", default=None,
+                    help="final params/losses npz; literal 'RANK' is "
+                         "substituted with this process's rank")
+    ap.add_argument("--fault", action="append", default=[],
+                    metavar="GEN=SPEC",
+                    help="arm MXNET_FAULT_INJECT=SPEC when "
+                         "restart_count()==GEN and rank==--fault-rank")
+    ap.add_argument("--fault-rank", type=int, default=0)
+    args = ap.parse_args()
+
+    rank = int(os.environ.get("MXNET_WORKER_ID", "0"))
+    if args.out:
+        args.out = args.out.replace("RANK", str(rank))
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    gen = mx.checkpoint.restart_count()
+    for spec in args.fault:
+        g, _, rule = spec.partition("=")
+        if int(g) == gen and rank == args.fault_rank:
+            os.environ["MXNET_FAULT_INJECT"] = rule
+            print(f"[rank {rank} gen {gen}] armed fault {rule}",
+                  flush=True)
+
+    parallel.init_distributed()
+    import jax
+
+    world = jax.process_count()
+    assert jax.process_index() == rank or world == 1, \
+        (jax.process_index(), rank)
+    if args.global_bs % len(jax.devices()):
+        print(f"global batch {args.global_bs} does not divide over "
+              f"{len(jax.devices())} devices", file=sys.stderr)
+        return 2
+    local_bs = args.global_bs // world
+
+    mesh = parallel.make_mesh({"dp": len(jax.devices())})
+    data_sh = parallel.data_sharding(mesh)
+
+    mx.random.seed(7)
+    onp.random.seed(7)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(args.units, use_bias=False,
+                         in_units=args.units))
+        net.add(nn.Dense(1, use_bias=False, in_units=args.units))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2}, kvstore=None)
+    loss_l = gluon.loss.L2Loss()
+
+    def loss_fn(bx, by):
+        # mean INSIDE the traced step: the loss out is replicated over
+        # the pod, so every rank reads the identical scalar without a
+        # cross-process gather
+        return loss_l(net(bx), by).mean()
+
+    rng = onp.random.RandomState(11)
+    X = rng.rand(args.steps * args.global_bs,
+                 args.units).astype(onp.float32)
+    Y = rng.rand(args.steps * args.global_bs, 1).astype(onp.float32)
+
+    root = args.dir or os.environ.get("MXNET_CHECKPOINT_DIR")
+    mgr = None
+    start = 0
+    if root:
+        ckdir = os.path.join(root, f"rank{rank}")
+        mgr = mx.checkpoint.CheckpointManager(ckdir, max_to_keep=3,
+                                              async_save=True)
+        res = mgr.restore(net, trainer, return_extra=True)
+        if res is not None:
+            step, extra = res
+            extra = extra or {}
+            start = int(extra.get("batch", step))
+            saved_world = int(extra.get("workers", world))
+            if saved_world != world and \
+                    os.environ.get("MXNET_ELASTIC") != "1":
+                print(f"checkpoint was written by {saved_world} "
+                      f"rank(s); resuming on {world} requires "
+                      "MXNET_ELASTIC=1 (tools/launch.py --elastic)",
+                      file=sys.stderr)
+                return 3
+            print(f"[rank {rank} gen {gen}] resumed at global batch "
+                  f"{start} (saved by {saved_world} rank(s), now "
+                  f"{world})", flush=True)
+
+    from mxnet_tpu import telemetry
+
+    losses = []
+    lo, hi = rank * local_bs, (rank + 1) * local_bs
+    for g in range(start, args.steps):
+        # same chaos hook the DataLoader fires per owned batch — lets
+        # the elastic tests kill/raise on an exact global batch index
+        telemetry.fault_point("data.next", batch=g)
+        # the global batch + the per-step RNG noise are identical on
+        # every rank and for every world size; only the slice differs
+        bx = X[g * args.global_bs:(g + 1) * args.global_bs]
+        by = Y[g * args.global_bs:(g + 1) * args.global_bs]
+        noise = onp.asarray(mx.random.normal(
+            shape=(args.global_bs, args.units)).asnumpy()) * 0.01
+        loss = trainer.fused_step(
+            loss_fn, mx.nd.array(bx[lo:hi] + noise[lo:hi]),
+            mx.nd.array(by[lo:hi]), batch_size=1,
+            data_sharding=data_sh)
+        val = float(onp.asarray(loss.asnumpy()).reshape(()))
+        losses.append((g, val))
+        print(f"[rank {rank} gen {gen}] STEP {g} loss={val:.8f}",
+              flush=True)
+        if mgr is not None:
+            mgr.save(g + 1, net, trainer,
+                     extra={"batch": g + 1, "workers": world})
+    if mgr is not None:
+        mgr.wait_until_finished()
+        mgr.close()
+
+    from mxnet_tpu.gluon.fused_step import step_counters
+
+    print(f"[rank {rank} gen {gen}] DONE steps={args.steps - start} "
+          f"world={world} compiles={step_counters['compiles']} "
+          f"dispatches={step_counters['dispatches']}", flush=True)
+
+    if args.out:
+        out = {"losses": onp.asarray([v for _, v in losses],
+                                     onp.float64),
+               "loss_steps": onp.asarray([g for g, _ in losses],
+                                         onp.int64)}
+        for name, p in net._collect_params_with_prefix().items():
+            out[f"param:{name}"] = onp.asarray(p.data().asnumpy())
+        tmp = args.out + ".tmp"
+        with open(tmp, "wb") as fh:
+            onp.savez(fh, **out)
+        os.replace(tmp, args.out)
+    parallel.barrier("dist_pretrain_done", timeout=60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -539,6 +539,76 @@ class TestDataCursor:
         for a, b in zip(full[2:], tail):
             onp.testing.assert_array_equal(a, b)
 
+    # -- elastic re-bucketing (ISSUE 19): iter_shard ------------------- #
+
+    def test_iter_shard_union_partitions_remaining_batches(self):
+        """The pod cursor contract: global batch g (>= cursor) belongs
+        to exactly one rank — the union of every rank's stream is the
+        remaining epoch, each batch exactly once, in global order."""
+        ds = _CountingDataset(32)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        full = [b.asnumpy() for b in loader]
+        for world in (1, 2, 3):
+            got = {}
+            for rank in range(world):
+                for i, b in enumerate(
+                        loader.iter_shard(2, world, rank)):
+                    g = 2 + i * world + rank
+                    assert g not in got      # never re-served
+                    got[g] = b.asnumpy()
+            assert sorted(got) == list(range(2, len(full)))  # no skips
+            for g, b in got.items():
+                onp.testing.assert_array_equal(b, full[g])
+
+    def test_iter_shard_rebucket_on_shrunk_world(self):
+        """Elastic resume: 2 ranks consume 4 global batches, the pod
+        shrinks, 1 survivor resumes at cursor 4 — every sample of the
+        epoch is served exactly once across the two generations."""
+        ds = _CountingDataset(32)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        served = []
+        for rank in range(2):                      # generation 0: W=2
+            it = loader.iter_shard(0, 2, rank)
+            served += [next(it).asnumpy() for _ in range(2)]
+        for b in loader.iter_shard(4, 1, 0):       # generation 1: W=1
+            served.append(b.asnumpy())
+        assert len(served) == 8
+        # every dataset index loaded exactly once over both generations
+        assert sorted(ds.fetched) == list(range(32))
+
+    def test_iter_shard_never_loads_foreign_batches(self):
+        """A rank draws every index (the shared sampler must advance
+        in lockstep) but only LOADS its own shard's samples."""
+        ds = _CountingDataset(16)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        list(loader.iter_shard(0, 2, 1))
+        assert sorted(set(ds.fetched)) == [4, 5, 6, 7, 12, 13, 14, 15]
+
+    def test_iter_shard_validates(self):
+        ds = _CountingDataset(8)
+        loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+        with pytest.raises(MXNetError, match="shard"):
+            loader.iter_shard(0, 2, 2)
+        with pytest.raises(MXNetError, match="past the end"):
+            loader.iter_shard(3, 2, 0)
+        roll = gluon.data.DataLoader(ds, batch_size=4, shuffle=False,
+                                     last_batch="rollover")
+        with pytest.raises(MXNetError, match="rollover"):
+            roll.iter_shard(0, 2, 0)
+
+    def test_iter_shard_seeded_shuffle_matches_full_epoch(self):
+        from mxnet_tpu.gluon.data.sampler import RandomSampler
+
+        ds = _CountingDataset(24)
+        loader = gluon.data.DataLoader(
+            ds, batch_size=4, sampler=RandomSampler(24, seed=3))
+        full = [b.asnumpy() for b in loader]            # epoch 0
+        for rank in range(2):
+            loader.set_epoch(0)
+            for i, b in enumerate(loader.iter_shard(0, 2, rank)):
+                onp.testing.assert_array_equal(b.asnumpy(),
+                                               full[i * 2 + rank])
+
 
 class TestFaultSites:
     @pytest.fixture(autouse=True)
@@ -687,3 +757,61 @@ class TestReportSections:
         data = json.loads(r.stdout)
         assert data["checkpoints"][0]["saves"] == 1
         assert data["restarts"][0]["restarts"] == 1
+
+
+class TestPodCheckpoint:
+    """A checkpoint written by a 2-process pod restores onto ONE
+    process bit-exactly (ISSUE 19): the elastic supervisor's whole
+    recovery story rests on this — the survivor generation loads state
+    the bigger mesh wrote, re-placed on the smaller mesh by the
+    restore-time resharding path (``parallel.global_put``)."""
+
+    def test_two_process_checkpoint_restores_on_one_process(
+            self, tmp_path):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)   # 1 CPU device per launched rank
+        env.pop("MXNET_FAULT_INJECT", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo
+        ck = tmp_path / "ck"
+        r = subprocess.run(
+            [sys.executable, "tools/launch.py", "-n", "2",
+             "--launcher", "local", "--checkpoint-dir", str(ck),
+             sys.executable, "tests/fixtures/dist_pretrain.py",
+             "--steps", "3", "--out",
+             str(tmp_path / "pod_RANK.npz")],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=repo)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        saved = onp.load(tmp_path / "pod_0.npz")
+
+        # fresh single-process model (this process: 8 virtual devices,
+        # process_count == 1) built exactly like the fixture's, but
+        # seeded differently so the restore must do ALL the work
+        mx.random.seed(99)
+        onp.random.seed(99)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, use_bias=False, in_units=8))
+            net.add(nn.Dense(1, use_bias=False, in_units=8))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-2})
+        mgr = mx.checkpoint.CheckpointManager(str(ck / "rank0"))
+        res = mgr.restore(net, trainer, return_extra=True)
+        mgr.close()
+        assert res is not None
+        step, extra = res
+        assert step == 3
+        assert extra["batch"] == 3 and extra["workers"] == 2
+
+        for name, p in net._collect_params_with_prefix().items():
+            onp.testing.assert_array_equal(
+                p.data().asnumpy(), saved[f"param:{name}"],
+                err_msg=name)

@@ -548,5 +548,6 @@ class TestTpuSweep:
         assert r.returncode == 0, r.stderr[-2000:]
         assert "decode_bench.py" in r.stdout
         assert "serve_bench.py" in r.stdout
+        assert "dist_bench.py" in r.stdout
         assert "MXNET_TELEMETRY_JSONL=" in r.stdout
-        assert "dry run: 0 of 2 benches executed" in r.stdout
+        assert "dry run: 0 of 3 benches executed" in r.stdout

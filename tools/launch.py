@@ -281,13 +281,27 @@ def _run_pod(args, command, restart_count):
     try:
         for rank in range(args.num_workers):
             env = _rank_env(args, coordinator, rank)
-            hb_path = os.path.join(hb_dir, f"rank{rank}.hb")
+            # the beat filename carries the restart GENERATION: even if
+            # a beat directory were ever reused across generations, a
+            # stale file from generation g-1 can never satisfy
+            # generation g's liveness check — the supervisor only
+            # watches gen{restart_count} paths
+            hb_path = os.path.join(
+                hb_dir, f"rank{rank}.gen{restart_count}.hb")
             env["MXNET_HEARTBEAT_FILE"] = hb_path
             env["MXNET_HEARTBEAT_INTERVAL"] = str(
                 args.heartbeat_interval)
             env["MXNET_RESTART_COUNT"] = str(restart_count)
             if args.checkpoint_dir:
                 env["MXNET_CHECKPOINT_DIR"] = args.checkpoint_dir
+            if getattr(args, "elastic", False):
+                env["MXNET_ELASTIC"] = "1"
+            if getattr(args, "telemetry_dir", None):
+                # one recording PER RANK (append-mode across
+                # generations): `tools/telemetry_report.py --pod DIR`
+                # merges them by the events' rank tags
+                env["MXNET_TELEMETRY_JSONL"] = os.path.join(
+                    args.telemetry_dir, f"rank{rank}.jsonl")
             # piped stdout makes python ranks BLOCK-buffered: without
             # this, a hard-killed rank takes its last ~8KB of output
             # to the grave and the post-mortem tail prints stale lines
@@ -330,17 +344,28 @@ def launch_local(args, command):
         restarts_used[sig] = used + 1
         total_restarts += 1
         backoff = args.restart_backoff * (2 ** used)
+        shrink = ""
+        if getattr(args, "elastic", False) and args.num_workers > 1:
+            # elastic recovery: re-form the pod SMALLER instead of
+            # restart-at-same-size — the survivors respawn as a fresh
+            # contiguous rank set 0..N-2 on a fresh coordinator, and
+            # rank code re-buckets its data cursor / optimizer state
+            # across the changed dp extent on restore
+            args.num_workers -= 1
+            shrink = (f"; elastic: re-forming on {args.num_workers} "
+                      "rank(s)")
         print(f"[launch] rank {sig[0]} {sig[1]}: restarting the pod "
               f"(restart {total_restarts}; attempt {used + 1}/"
               f"{args.restarts} for this failure) after {backoff:.1f}s "
               "backoff; ranks resume from the newest complete "
               "checkpoint" +
               (f" in {args.checkpoint_dir}" if args.checkpoint_dir
-               else ""),
+               else "") + shrink,
               file=sys.stderr, flush=True)
         _emit("pod_restart", restart=total_restarts, rank=sig[0],
               why=sig[1], attempt=used + 1, budget=args.restarts,
-              backoff_s=backoff)
+              backoff_s=backoff, workers=args.num_workers,
+              elastic=bool(getattr(args, "elastic", False)))
         time.sleep(backoff)
 
 
@@ -438,6 +463,21 @@ def main(argv=None):
                              "MXNET_CHECKPOINT_DIR — where "
                              "mx.checkpoint auto-resume looks for the "
                              "newest complete checkpoint on restart")
+    parser.add_argument("--elastic", action="store_true",
+                        help="on a restartable failure re-form the pod "
+                             "on ONE FEWER rank instead of the same "
+                             "size (the survivor set respawns as ranks "
+                             "0..N-2 with a recomputed coordinator); "
+                             "ranks see MXNET_ELASTIC=1 and re-bucket "
+                             "their data cursor across the changed dp "
+                             "extent on restore. Requires --restarts; "
+                             "local mode only")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="directory for per-rank telemetry "
+                             "recordings: each rank gets "
+                             "MXNET_TELEMETRY_JSONL=DIR/rank<r>.jsonl "
+                             "(append mode across restarts); merge "
+                             "with tools/telemetry_report.py --pod DIR")
     parser.add_argument("--dry-run", action="store_true",
                         help="print the per-rank commands without running")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -458,6 +498,17 @@ def main(argv=None):
         parser.error("--restarts must be >= 0")
     if args.restart_backoff < 0:
         parser.error("--restart-backoff must be >= 0")
+    if args.elastic and args.restarts < 1:
+        parser.error("--elastic shrinks the pod on a supervised "
+                     "restart, so it requires --restarts >= 1")
+    if args.telemetry_dir:
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        # the supervisor's own events (worker_dead, pod_restart) join
+        # the per-rank recordings so `telemetry_report --pod` sees the
+        # whole story; per-rank files override this in the child env
+        os.environ.setdefault(
+            "MXNET_TELEMETRY_JSONL",
+            os.path.join(args.telemetry_dir, "launcher.jsonl"))
     if args.launcher == "ssh":
         if not args.hostfile:
             parser.error("--launcher ssh requires -H/--hostfile")

@@ -62,9 +62,31 @@ against the JSONL ``benchmark/serve_bench.py --smoke`` records).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from collections import defaultdict
+
+
+def load_pod(path):
+    """Merge a pod's telemetry: ``path`` is either one merged JSONL
+    (events already rank-tagged by ``mxnet_tpu.telemetry.emit``) or a
+    directory of per-rank recordings (``tools/launch.py
+    --telemetry-dir``: ``rank<r>.jsonl``).  Returns the union sorted
+    by timestamp — the rank field on each event, not the source file,
+    is the attribution."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+        if not files:
+            print(f"# {path}: no *.jsonl recordings in directory",
+                  file=sys.stderr)
+        events = []
+        for f in files:
+            events.extend(load(f))
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return events
+    return load(path)
 
 
 def load(path):
@@ -252,6 +274,104 @@ def restart_summary(events):
                                           for e in evs), 3),
              "max_attempt": max(e.get("attempt", 1) for e in evs),
              "detail": dict(sorted(detail.items()))}]
+
+
+def _parse_bytes(raw):
+    """``14G``-style byte sizes for ``--hbm-budget``."""
+    raw = str(raw).strip()
+    mult = 1
+    suffixes = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30, "T": 1 << 40}
+    if raw and raw[-1].upper() in suffixes:
+        mult = suffixes[raw[-1].upper()]
+        raw = raw[:-1]
+    return int(float(raw) * mult)
+
+
+def pod_summary(events, hbm_budget=None):
+    """Per-rank rollup of a merged pod recording — the two operator
+    questions first: WHICH HOST RETRACED (rank-tagged ``compile``
+    events with ``retrace``) and WHICH HOST IS OVER ITS HBM BUDGET
+    (peak concurrent total of the rank's ``device_memory`` /
+    ``device_bytes`` accountant gauges vs ``hbm_budget``).  Events
+    without a rank tag (the launch supervisor's own ``worker_dead`` /
+    ``pod_restart``) roll up under rank ``"pod"``."""
+    by_rank = defaultdict(lambda: {
+        "events": 0, "compiles": 0, "retraces": 0,
+        "retrace_sites": set(), "compile_wall_s": 0.0,
+        "peak_device_bytes": 0, "_gauges": {}, "faults": 0,
+        "dist_inits": 0, "last_step": None, "saves": 0})
+    for e in events:
+        rank = e.get("rank", "pod")
+        d = by_rank[rank]
+        d["events"] += 1
+        kind = e.get("kind")
+        if kind == "compile":
+            d["compiles"] += 1
+            d["compile_wall_s"] += e.get("wall_s", 0.0)
+            if e.get("retrace"):
+                d["retraces"] += 1
+                d["retrace_sites"].add(str(e.get("site", "?")))
+        elif kind == "device_memory":
+            # replay the accountant gauges in ts order: the rank's HBM
+            # truth is the peak CONCURRENT total, not the max sample
+            key = (e.get("subsystem", "?"), e.get("key", "?"))
+            d["_gauges"][key] = e.get("bytes", 0)
+            d["peak_device_bytes"] = max(
+                d["peak_device_bytes"], sum(d["_gauges"].values()))
+        elif kind == "fault_injected":
+            d["faults"] += 1
+        elif kind == "dist_init":
+            d["dist_inits"] += 1
+        elif kind == "checkpoint_saved":
+            d["saves"] += 1
+            d["last_step"] = e.get("step")
+    rows = []
+    for rank in sorted(by_rank, key=lambda r: (isinstance(r, str), r)):
+        d = by_rank[rank]
+        row = {"rank": rank, "events": d["events"],
+               "compiles": d["compiles"], "retraces": d["retraces"],
+               "retrace_sites": sorted(d["retrace_sites"]),
+               "compile_wall_s": round(d["compile_wall_s"], 3),
+               "peak_device_bytes": d["peak_device_bytes"],
+               "faults": d["faults"], "dist_inits": d["dist_inits"],
+               "saves": d["saves"], "last_step": d["last_step"]}
+        if hbm_budget is not None and rank != "pod":
+            row["over_hbm_budget"] = \
+                d["peak_device_bytes"] > hbm_budget
+        rows.append(row)
+    return rows
+
+
+def render_pod(events, hbm_budget=None):
+    rows = pod_summary(events, hbm_budget)
+    lines = ["pod (per rank)",
+             f"  {'rank':<6}{'events':>8}{'compiles':>9}"
+             f"{'retraces':>9}{'wall(s)':>9}{'peak bytes':>12}"
+             f"{'saves':>7}{'last step':>10}"]
+    for r in rows:
+        lines.append(
+            f"  {str(r['rank']):<6}{r['events']:>8}{r['compiles']:>9}"
+            f"{r['retraces']:>9}{r['compile_wall_s']:>9.2f}"
+            f"{r['peak_device_bytes']:>12}{r['saves']:>7}"
+            f"{str(r['last_step'] if r['last_step'] is not None else '-'):>10}")
+    retraced = [r for r in rows if r["retraces"]]
+    if retraced:
+        lines.append("  retraced hosts: " + ", ".join(
+            f"rank {r['rank']} ({', '.join(r['retrace_sites'])})"
+            for r in retraced))
+    else:
+        lines.append("  retraced hosts: none")
+    if hbm_budget is not None:
+        over = [r for r in rows if r.get("over_hbm_budget")]
+        if over:
+            lines.append(
+                f"  over hbm budget ({hbm_budget} bytes): " + ", ".join(
+                    f"rank {r['rank']} "
+                    f"(peak {r['peak_device_bytes']})" for r in over))
+        else:
+            lines.append(f"  over hbm budget ({hbm_budget} bytes): "
+                         "none")
+    return "\n".join(lines)
 
 
 def check_serve(events):
@@ -512,18 +632,32 @@ def main(argv=None):
                     "serving dispatch/retrace invariants from it.")
     ap.add_argument("path", help="JSONL file recorded via "
                                  "MXNET_TELEMETRY_JSONL or "
-                                 "mx.telemetry.add_jsonl_sink")
+                                 "mx.telemetry.add_jsonl_sink; with "
+                                 "--pod, alternatively a directory of "
+                                 "per-rank recordings "
+                                 "(tools/launch.py --telemetry-dir)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of tables")
+    ap.add_argument("--pod", action="store_true",
+                    help="merge per-rank recordings and add the "
+                         "per-rank rollup: which host retraced, which "
+                         "host is over its HBM budget, per-rank "
+                         "compile/memory/checkpoint truth")
+    ap.add_argument("--hbm-budget", default=None,
+                    help="per-rank device-memory budget for the --pod "
+                         "over-budget verdict (bytes; K/M/G/T "
+                         "suffixes accepted)")
     ap.add_argument("--check-serve", action="store_true",
                     help="verify serving invariants (ladder-bounded "
                          "compiles, zero retraces, 1 dispatch/step); "
                          "exit 1 on violation")
     args = ap.parse_args(argv)
 
-    events = load(args.path)
+    budget = _parse_bytes(args.hbm_budget) \
+        if args.hbm_budget is not None else None
+    events = load_pod(args.path) if args.pod else load(args.path)
     if args.json:
-        print(json.dumps({
+        out = {
             "events": len(events),
             "compile": compile_summary(events),
             "serve": serve_summary(events),
@@ -531,9 +665,15 @@ def main(argv=None):
             "checkpoints": checkpoint_summary(events),
             "restarts": restart_summary(events),
             "bench": [e for e in events if e.get("kind") == "bench"],
-        }, indent=2, sort_keys=True))
+        }
+        if args.pod:
+            out["pod"] = pod_summary(events, budget)
+        print(json.dumps(out, indent=2, sort_keys=True))
     else:
         print(f"# {args.path}: {len(events)} events")
+        if args.pod:
+            print(render_pod(events, budget))
+            print()
         print(render(events))
 
     if args.check_serve:
