@@ -195,8 +195,12 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
 
     def attach(block):
         if isinstance(block, HybridBlock):
-            hybrid_state.append((block, block._active))
+            # _auto_jit too: a block whose pre-calibration forward
+            # auto-jitted would re-trace here with the collector hooks
+            # attached, and the hooks would materialize tracers
+            hybrid_state.append((block, block._active, block._auto_jit))
             block._active = False
+            block._auto_jit = False
             block._cached_op = None
         for child in block._children.values():
             if isinstance(child, (nn.Dense, nn.Conv2D)):
@@ -214,8 +218,9 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     finally:
         for h in hooks:
             h.detach()
-        for block, was_active in hybrid_state:
+        for block, was_active, was_auto in hybrid_state:
             block._active = was_active
+            block._auto_jit = was_auto
             block._cached_op = None  # stale fp32 trace must not survive
     _walk_replace(network, collector, exclude)
     logger.info("quantize_net: %d layers calibrated (%s mode)",

@@ -110,13 +110,23 @@ def _quantize_head(w, bias=None):
     return wq, s, bias
 
 
+# the int8 KV page pair's dtypes — ONE page is (codes, scale-per-
+# (layer, head)).  These must agree with the serve operand schema's
+# KV_PAGE_INT8 declaration (``mxnet_tpu/serve/schema.py``), which the
+# page-pool pricing and ``telemetry_report --check-serve`` consume;
+# tests/test_serve_schema.py pins the two equal (decoding cannot
+# import serve without a cycle, so the contract is test-held).
+_KV_CODE_DTYPE = jnp.int8
+_KV_SCALE_DTYPE = jnp.float32
+
+
 def _kv_dequant(codes, scales, dtype):
     """Int8 KV page codes -> ``dtype`` values: ``codes * scale`` with
     the per-page-per-head f32 scale broadcast over the trailing
     ``(page, D)`` axes.  A sentinel gather fills codes AND scales with
     zeros, so unmapped pages dequantize to the exact zeros the f32
     pool's fill would have produced."""
-    return (codes.astype(jnp.float32)
+    return (codes.astype(_KV_SCALE_DTYPE)
             * scales[..., None, None]).astype(dtype)
 
 
@@ -144,10 +154,10 @@ def _kv_requant(vals, floor_scales):
       scale (see ``_kv_verify_rmw``) — the one case where the final
       scale may be coarser than one-shot quantization of the surviving
       contents."""
-    v32 = vals.astype(jnp.float32)
+    v32 = vals.astype(_KV_SCALE_DTYPE)
     amax = jnp.max(jnp.abs(v32), axis=(-2, -1))
     s = jnp.maximum(jnp.maximum(amax / 127.0, floor_scales), 1e-8)
-    codes = jnp.round(v32 / s[..., None, None]).astype(jnp.int8)
+    codes = jnp.round(v32 / s[..., None, None]).astype(_KV_CODE_DTYPE)
     return codes, s
 
 

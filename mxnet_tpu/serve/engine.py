@@ -61,17 +61,29 @@ import jax.numpy as jnp
 
 from .. import telemetry
 from ..base import MXNetError
-from ..models.decoding import _DecodeEngine, _TRACE_LOCK, _kv_requant
+from ..models.decoding import (_DecodeEngine, _TRACE_LOCK, _kv_requant,
+                               _KV_CODE_DTYPE, _KV_SCALE_DTYPE)
+from . import schema
 
 __all__ = ["PoolPrograms", "PagePool", "pool_state_init",
            "pool_state_grow", "pool_state_bytes",
            "admit_scratch_bytes"]
 
 
-# per-slot scalar state bytes: pos/tok/stop/spec int32 (16) + active
-# bool (1) + PRNG key 2x uint32 (8) + deadline float32 (4) — see
-# pool_state_init
-_SLOT_STATE_BYTES = 29
+# per-slot scalar state bytes, derived from the operand schema's
+# SLOT_STATE layout (pos/tok/stop/spec int32 + active bool + PRNG key
+# 2x uint32 + deadline float32 = 29) — see pool_state_init, which
+# builds the columns in the same declared order
+_SLOT_STATE_BYTES = schema.slot_state_bytes()
+
+# meta-row column maps, derived from the same declarations the jitted
+# bodies below unpack through (tracelint TL017 holds these bodies to
+# the accessors — a hand-written column index is exactly the drift
+# that threaded PR-13's deadline and PR-17's spec-depth through four
+# scatter sites by eye)
+_AM = schema.meta_cols("admit")
+_HM = schema.meta_cols("hit")
+_CM = schema.meta_cols("chunk")
 
 
 class PagePool:
@@ -192,10 +204,10 @@ def pool_state_init(progs, device=None):
         # ONE state slot as a pytree — every executable threads, donates
         # and scans it exactly like the single f32 array it replaces
         sshape = (eng.NL, progs.num_pages, eng.KV)
-        kpool = (jnp.zeros(shape, jnp.int8),
-                 jnp.zeros(sshape, jnp.float32))
-        vpool = (jnp.zeros(shape, jnp.int8),
-                 jnp.zeros(sshape, jnp.float32))
+        kpool = (jnp.zeros(shape, _KV_CODE_DTYPE),
+                 jnp.zeros(sshape, _KV_SCALE_DTYPE))
+        vpool = (jnp.zeros(shape, _KV_CODE_DTYPE),
+                 jnp.zeros(sshape, _KV_SCALE_DTYPE))
     else:
         kpool = jnp.zeros(shape, eng.cdtype)
         vpool = jnp.zeros(shape, eng.cdtype)
@@ -317,7 +329,8 @@ class PoolPrograms:
         sequences at equal bytes."""
         e = self.eng
         if self.quant_kv:
-            return 2 * e.NL * e.KV * (self.page * e.D + 4)
+            return schema.kv_page_int8_bytes(e.NL, e.KV, self.page,
+                                             e.D)
         return 2 * e.NL * e.KV * self.page * e.D \
             * jnp.dtype(e.cdtype).itemsize
 
@@ -389,7 +402,8 @@ class PoolPrograms:
             return new_state, (nxt, emitted, done)
 
         self._step = telemetry.instrument_jit(
-            jax.jit(step, donate_argnums=(5, 6)), "serve.step",
+            jax.jit(step, donate_argnums=schema.jit_donate("step", step)),
+            "serve.step",
             key=(self.telemetry_label, self.S),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "num_pages": self.num_pages,
@@ -444,10 +458,12 @@ class PoolPrograms:
 
         def admit(param_vals, prompts, meta, dls, pages, zpages, kp, vp,
                   pos, tok, active, stop, keys, dl, spec):
-            valid = meta[:, 0] != 0
-            true_len, slot, stop_pos, seed = (meta[:, 1], meta[:, 2],
-                                              meta[:, 3], meta[:, 4])
-            spec_d = meta[:, 5]
+            valid = meta[:, _AM["valid"]] != 0
+            true_len = meta[:, _AM["true_len"]]
+            slot = meta[:, _AM["slot"]]
+            stop_pos = meta[:, _AM["stop_pos"]]
+            seed = meta[:, _AM["seed"]]
+            spec_d = meta[:, _AM["spec_depth"]]
             keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
             with _TRACE_LOCK, params_swapped(peng.params, param_vals):
                 ck1, cv1 = peng.zero_caches()
@@ -521,7 +537,9 @@ class PoolPrograms:
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(admit, donate_argnums=(6, 7)), "serve.admit",
+            jax.jit(admit,
+                    donate_argnums=schema.jit_donate("admit", admit)),
+            "serve.admit",
             key=(self.telemetry_label, self.S, A, P),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "a_bucket": A, "p_bucket": P,
@@ -564,11 +582,13 @@ class PoolPrograms:
 
         def hit(meta, dls, src, dst, zpages, kp, vp, pos, tok, active,
                 stop, keys, dl, spec):
-            valid = meta[:, 0] != 0
-            true_len, slot, stop_pos, seed, last_tok = (
-                meta[:, 1], meta[:, 2], meta[:, 3], meta[:, 4],
-                meta[:, 5])
-            spec_d = meta[:, 6]
+            valid = meta[:, _HM["valid"]] != 0
+            true_len = meta[:, _HM["true_len"]]
+            slot = meta[:, _HM["slot"]]
+            stop_pos = meta[:, _HM["stop_pos"]]
+            seed = meta[:, _HM["seed"]]
+            last_tok = meta[:, _HM["last_tok"]]
+            spec_d = meta[:, _HM["spec_depth"]]
             keys_a = jax.vmap(jax.random.PRNGKey)(seed)       # (A, 2)
             # copy-on-write boundary pages: one gather + one masked
             # scatter covers the whole wave's copies.  An int8 pool
@@ -610,7 +630,8 @@ class PoolPrograms:
             return (kp, vp, pos, tok, active, stop, keys, dl, spec)
 
         fn = telemetry.instrument_jit(
-            jax.jit(hit, donate_argnums=(5, 6)), "serve.admit_hit",
+            jax.jit(hit, donate_argnums=schema.jit_donate("hit", hit)),
+            "serve.admit_hit",
             key=(self.telemetry_label, self.S, A),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "a_bucket": A})
@@ -655,10 +676,14 @@ class PoolPrograms:
 
         def chunk(param_vals, q8, sw, toks, meta, dls, ptrow, zrow, kp,
                   vp, pos, tok, active, stop, keys, dl, spec):
-            final, slot, true_len, stop_pos, seed, nlast, off = (
-                meta[0], meta[1], meta[2], meta[3], meta[4], meta[5],
-                meta[6])
-            spec_d = meta[7]
+            final = meta[_CM["final"]]
+            slot = meta[_CM["slot"]]
+            true_len = meta[_CM["true_len"]]
+            stop_pos = meta[_CM["stop_pos"]]
+            seed = meta[_CM["seed"]]
+            nlast = meta[_CM["nlast"]]
+            off = meta[_CM["off"]]
+            spec_d = meta[_CM["spec_depth"]]
             key1 = jax.random.PRNGKey(seed)                   # (2,)
             if self.quant_kv:
                 # recycled-page reset (see admit_fn): the chunk RMW
@@ -694,7 +719,9 @@ class PoolPrograms:
             return new_state, (first, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(chunk, donate_argnums=(8, 9)), "serve.chunk",
+            jax.jit(chunk,
+                    donate_argnums=schema.jit_donate("chunk", chunk)),
+            "serve.chunk",
             key=(self.telemetry_label, self.S, C),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "c_bucket": C,
@@ -789,7 +816,9 @@ class PoolPrograms:
             return new_state, (out, adv, done)
 
         fn = telemetry.instrument_jit(
-            jax.jit(verify, donate_argnums=(7, 8)), "serve.verify",
+            jax.jit(verify,
+                    donate_argnums=schema.jit_donate("verify", verify)),
+            "serve.verify",
             key=(self.telemetry_label, self.S, K),
             fields={"server": self.telemetry_label, "pool": self.S,
                     "k_bucket": k,

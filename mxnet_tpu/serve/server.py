@@ -100,6 +100,7 @@ import numpy as onp
 from .. import telemetry
 from ..base import MXNetError
 from ..telemetry.faults import fault_point
+from . import schema
 
 __all__ = ["DecodeServer", "TokenStream", "serve_counters",
            "reset_serve_counters"]
@@ -1670,8 +1671,8 @@ class DecodeServer:
         prompts = onp.zeros((A, P), onp.int32)
         # idle rows: valid=0 (their scatter drops on device); true_len
         # stays 1 so the per-row last-index gather reads a real column
-        meta = onp.zeros((A, 6), onp.int32)
-        meta[:, 1] = 1
+        meta = onp.zeros((A, schema.meta_width("admit")), onp.int32)
+        meta[:, schema.meta_col("admit", "true_len")] = 1
         # per-row wall-clock deadlines (server-epoch seconds; +inf =
         # none), scattered into the slot-state deadline vector the
         # step checks device-side
@@ -1690,8 +1691,10 @@ class DecodeServer:
         for i, (slot, req) in enumerate(wave):
             n = req.prompt.size
             prompts[i, :n] = req.prompt
-            meta[i] = (1, n, slot, n + req.max_new - 1, req.seed,
-                       self._slot_spec_depth(req))
+            meta[i] = schema.meta_row(
+                "admit", valid=1, true_len=n, slot=slot,
+                stop_pos=n + req.max_new - 1, seed=req.seed,
+                spec_depth=self._slot_spec_depth(req))
             if req.deadline is not None:
                 dls[i] = req.deadline - self._epoch
             row = self._slot_pages[slot]
@@ -1856,8 +1859,8 @@ class DecodeServer:
         fn = self._progs.admit_hit_fn(A)
         self._watch_dispatch(fn)
         sentinel = self._progs.num_pages
-        meta = onp.zeros((A, 7), onp.int32)
-        meta[:, 1] = 1
+        meta = onp.zeros((A, schema.meta_width("hit")), onp.int32)
+        meta[:, schema.meta_col("hit", "true_len")] = 1
         dls = onp.full((A,), onp.inf, onp.float32)
         srcs = onp.full((A,), sentinel, onp.int32)
         dsts = onp.full((A,), sentinel, onp.int32)
@@ -1873,9 +1876,11 @@ class DecodeServer:
         for i, plan in enumerate(hits):
             slot, req = plan["slot"], plan["req"]
             L = req.prompt.size
-            meta[i] = (1, L, slot, L + req.max_new - 1, req.seed,
-                       int(req.prompt[-1]),
-                       self._slot_spec_depth(req))
+            meta[i] = schema.meta_row(
+                "hit", valid=1, true_len=L, slot=slot,
+                stop_pos=L + req.max_new - 1, seed=req.seed,
+                last_tok=int(req.prompt[-1]),
+                spec_depth=self._slot_spec_depth(req))
             if req.deadline is not None:
                 dls[i] = req.deadline - self._epoch
             if plan["src"] >= 0:
@@ -1951,11 +1956,11 @@ class DecodeServer:
         self._watch_dispatch(fn)
         toks = onp.zeros((C,), onp.int32)
         toks[:ntok] = req.prompt[off:off + ntok]
-        meta = onp.asarray(
-            [1 if final else 0, slot, L, L + req.max_new - 1,
-             req.seed, (L - 1 - off) if final else C - 1, off,
-             self._slot_spec_depth(req)],
-            onp.int32)
+        meta = onp.asarray(schema.meta_row(
+            "chunk", final=1 if final else 0, slot=slot, true_len=L,
+            stop_pos=L + req.max_new - 1, seed=req.seed,
+            nlast=(L - 1 - off) if final else C - 1, off=off,
+            spec_depth=self._slot_spec_depth(req)), onp.int32)
         dl = onp.float32(onp.inf if req.deadline is None
                          else req.deadline - self._epoch)
         ptrow = onp.full((self._progs.maxp,), self._progs.num_pages,

@@ -52,6 +52,10 @@ class TestQuantizeOps:
 
 class TestQuantizeNet:
     def test_mlp_accuracy_preserved(self):
+        # pin the init stream: the 0.9 argmax-agreement bound on 64
+        # samples is draw-sensitive, and an unseeded root key makes the
+        # test's pass/fail depend on suite composition
+        mx.random.seed(0)
         rng = onp.random.RandomState(0)
         net = gluon.nn.HybridSequential()
         net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))

@@ -468,11 +468,12 @@ class TestTL005EnvRegistry:
 class TestGate:
     def test_self_run_is_clean(self):
         """THE CI gate: tracelint over the library AND the tooling and
-        benchmark layers must stay clean at merge — a regression in
-        trace/sharding discipline fails tier-1.  Runs with --jobs to
-        exercise the parallel path in CI."""
-        r = cli(["mxnet_tpu/", "tools/", "benchmark/", "--jobs", "2",
-                 "--format=json"])
+        benchmark layers — and the runnable example fixtures — must
+        stay clean at merge: a regression in trace/sharding discipline
+        fails tier-1.  Runs with --jobs to exercise the parallel path
+        in CI."""
+        r = cli(["mxnet_tpu/", "tools/", "benchmark/",
+                 "tests/fixtures/", "--jobs", "2", "--format=json"])
         assert r.returncode == 0, f"tracelint found:\n{r.stdout}\n{r.stderr}"
         payload = json.loads(r.stdout)
         assert payload["findings"] == []
@@ -2031,6 +2032,380 @@ class TestTL015TelemetryContract:
 
 
 # ------------------------------------------------------------------ #
+# TL016–TL019 — the executable-contract family (tracelint v4) over a
+# miniature operand-schema registry mirroring serve/schema.py's shape
+# ------------------------------------------------------------------ #
+
+_SCHEMA_FIXTURE = """
+    EXECUTABLES = {
+        "admit": {
+            "module": "engine",
+            "getter": "admit_fn",
+            "operands": ("params", "prompts", "meta", "pages",
+                         "kp", "vp", "pos", "tok", "active"),
+            "donated": ("kp", "vp"),
+        },
+    }
+    SLOT_STATE = (
+        ("pos", "int32", 1),
+        ("tok", "int32", 1),
+        ("active", "bool", 1),
+    )
+"""
+
+
+class TestTL016DonationDrift:
+    def test_stale_literal_positions(self, tmp_path):
+        """Literal donate indices that disagree with the registry's
+        donated positions — the producer half of the PR-18 class."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                import jax
+
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    return (kp, vp, pos, tok, active)
+
+                fn = jax.jit(admit, donate_argnums=(5, 6))
+            """}, select=["TL016"])
+        assert rules_of(fs) == ["TL016"]
+        assert "disagree with the operand schema" in fs[0].message
+        assert fs[0].severity == "error"
+
+    def test_inserted_operand_without_donate_shift(self, tmp_path):
+        """The exact PR-18 recycled-page shape: a new operand lands in
+        the signature, the literal donation pair does not move, and the
+        'right' indices now donate the wrong buffers."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                import jax
+
+                def admit(params, prompts, extra, meta, pages,
+                          kp, vp, pos, tok, active):
+                    return (kp, vp, pos, tok, active)
+
+                fn = jax.jit(admit, donate_argnums=(4, 5))
+            """}, select=["TL016"])
+        assert rules_of(fs) == ["TL016"]
+        assert "PR-18" in fs[0].message
+        assert "'pages'" in fs[0].message
+
+    def test_jit_donate_derivation_is_clean(self, tmp_path):
+        """Deriving the indices from the registry is the sanctioned
+        pattern — the runtime validates the signature at build time."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                import jax
+                import schema
+
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    return (kp, vp, pos, tok, active)
+
+                fn = jax.jit(admit,
+                             donate_argnums=schema.jit_donate(
+                                 "admit", admit))
+            """}, select=["TL016"])
+        assert fs == []
+
+    def test_matching_literal_is_clean(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                import jax
+
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    return (kp, vp, pos, tok, active)
+
+                fn = jax.jit(admit, donate_argnums=(4, 5))
+            """}, select=["TL016"])
+        assert fs == []
+
+    def test_non_registry_index_past_arity(self, tmp_path):
+        """Outside the registry the producer-side TL002 generalization:
+        a donation index past the wrapped function's positional arity
+        donates a buffer that does not exist."""
+        fs = lint(tmp_path, """
+            import jax
+
+            def step(w, g):
+                return w - g
+
+            fn = jax.jit(step, donate_argnums=(2,))
+        """, select=["TL016"])
+        assert rules_of(fs) == ["TL016"]
+        assert "exceed" in fs[0].message
+
+    def test_suppressed(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                import jax
+
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    return (kp, vp, pos, tok, active)
+
+                # tracelint: disable=TL016 -- fixture: transitional donation map
+                fn = jax.jit(admit, donate_argnums=(5, 6))
+            """}, select=["TL016"])
+        assert fs == []
+
+
+class TestTL017SlotStateLayout:
+    def test_hard_coded_meta_column(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    valid = meta[:, 0]
+                    return (kp, vp, pos, tok, active)
+            """}, select=["TL017"])
+        assert rules_of(fs) == ["TL017"]
+        assert "meta column index 0" in fs[0].message
+
+    def test_dispatch_side_meta_builder_flagged(self, tmp_path):
+        """A module that fetches executables through registry getters
+        builds the rows those bodies unpack — its hand-numbered writes
+        drift the same way."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "server.py": """
+                class Srv:
+                    def push(self, meta):
+                        fn = self.progs.admit_fn(4)
+                        meta[:, 1] = 0
+                        return fn
+            """}, select=["TL017"])
+        assert rules_of(fs) == ["TL017"]
+
+    def test_state_tuple_arity_drift(self, tmp_path):
+        """A column threaded through some scatter sites but not the
+        schema: the tuple's arity disagrees with kp, vp + SLOT_STATE."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    ttl = pos
+                    return (kp, vp, pos, tok, active, ttl)
+            """}, select=["TL017"])
+        assert rules_of(fs) == ["TL017"]
+        assert "6 elements" in fs[0].message
+        assert "declares 5" in fs[0].message
+
+    def test_literal_byte_total(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                _SLOT_STATE_BYTES = 9
+            """}, select=["TL017"])
+        assert rules_of(fs) == ["TL017"]
+        assert "slot_state_bytes()" in fs[0].message
+
+    def test_schema_indexing_is_clean(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                import schema
+
+                _SLOT_STATE_BYTES = schema.slot_state_bytes()
+                _AM = schema.meta_cols("admit")
+
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    valid = meta[:, _AM["valid"]]
+                    return (kp, vp, pos, tok, active)
+            """}, select=["TL017"])
+        assert fs == []
+
+    def test_meta_outside_contract_scope_is_clean(self, tmp_path):
+        """A module that neither defines executables nor dispatches
+        them can call its locals whatever it likes."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "report.py": """
+                def summarize(meta):
+                    return meta[:, 0].sum()
+            """}, select=["TL017"])
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "engine.py": """
+                def admit(params, prompts, meta, pages,
+                          kp, vp, pos, tok, active):
+                    # tracelint: disable=TL017 -- fixture: migration shim, schema lands next PR
+                    valid = meta[:, 0]
+                    return (kp, vp, pos, tok, active)
+            """}, select=["TL017"])
+        assert fs == []
+
+
+class TestTL018DispatchArity:
+    def test_missing_operand_in_dispatch(self, tmp_path):
+        """The 'zpages lands in 2 of 3 admission paths' class: one
+        dispatch site passes one operand fewer than declared."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "server.py": """
+                class Srv:
+                    def pump(self):
+                        fn = self.progs.admit_fn(4)
+                        return fn(self.params, self.prompts, self.meta,
+                                  *self._state)
+            """}, select=["TL018"])
+        assert rules_of(fs) == ["TL018"]
+        assert "passes 8" in fs[0].message
+        assert "declares 9" in fs[0].message
+        assert "params, prompts, meta" in fs[0].message  # operand list
+
+    def test_exact_arity_is_clean(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "server.py": """
+                class Srv:
+                    def pump(self):
+                        fn = self.progs.admit_fn(4)
+                        return fn(self.params, self.prompts, self.meta,
+                                  self.pages, *self._state)
+            """}, select=["TL018"])
+        assert fs == []
+
+    def test_immediate_getter_call_counted(self, tmp_path):
+        """fn-less dispatch — getter(...)(operands...) — is the same
+        call-site."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "server.py": """
+                class Srv:
+                    def pump(self):
+                        return self.progs.admit_fn(4)(
+                            self.params, self.meta, self.pages,
+                            *self._state)
+            """}, select=["TL018"])
+        assert rules_of(fs) == ["TL018"]
+
+    def test_uncountable_splat_is_skipped(self, tmp_path):
+        """A non-state splat hides the operand count — not this rule's
+        call to make."""
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "server.py": """
+                class Srv:
+                    def pump(self, argpack):
+                        fn = self.progs.admit_fn(4)
+                        return fn(*argpack)
+            """}, select=["TL018"])
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "schema.py": _SCHEMA_FIXTURE,
+            "server.py": """
+                class Srv:
+                    def pump(self):
+                        fn = self.progs.admit_fn(4)
+                        # tracelint: disable=TL018 -- fixture: legacy replay path, operand added downstream
+                        return fn(self.params, self.prompts, self.meta,
+                                  *self._state)
+            """}, select=["TL018"])
+        assert fs == []
+
+
+class TestTL019PlacementDiscipline:
+    def test_local_devices_chain_into_sharding(self, tmp_path):
+        """The elastic-resume hazard: a host-local device list flows
+        through mesh and sharding construction into device_put — every
+        link in the chain is flagged."""
+        fs = lint(tmp_path, """
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def build(x):
+                devs = jax.local_devices()
+                mesh = Mesh(devs, ("dp",))
+                sh = NamedSharding(mesh, P("dp"))
+                return jax.device_put(x, sh)
+        """, select=["TL019"])
+        assert rules_of(fs) == ["TL019", "TL019", "TL019"]
+        assert all("jax.local_devices()" in f.message for f in fs)
+        assert len({f.line for f in fs}) == 3
+
+    def test_env_read_into_partition_spec(self, tmp_path):
+        fs = lint(tmp_path, """
+            import os
+            from jax.sharding import PartitionSpec
+
+            def spec():
+                axis = os.environ["RANK_AXIS"]
+                return PartitionSpec(axis)
+        """, select=["TL019"])
+        assert rules_of(fs) == ["TL019"]
+        assert "os.environ" in fs[0].message
+
+    def test_pod_global_devices_are_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            def build(x):
+                devs = jax.devices()
+                mesh = Mesh(devs, ("dp",))
+                sh = NamedSharding(mesh, P("dp"))
+                return jax.device_put(x, sh)
+        """, select=["TL019"])
+        assert fs == []
+
+    def test_mesh_helper_definitions_exempt(self, tmp_path):
+        """The parallel.mesh helpers ARE the sanctioned boundary —
+        their internals legitimately touch process locality."""
+        fs = lint(tmp_path, """
+            import jax
+            from jax.sharding import Mesh
+
+            def make_mesh(axes):
+                devs = jax.local_devices()
+                return Mesh(devs, tuple(axes))
+
+            def global_put(x, sharding):
+                rank = jax.process_index()
+                return jax.make_array_from_process_local_data(
+                    sharding, x)
+        """, select=["TL019"])
+        assert fs == []
+
+    def test_helper_output_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mxnet_tpu.parallel.mesh import data_sharding
+
+            def put(x):
+                sh = data_sharding()
+                return jax.device_put(x, sh)
+        """, select=["TL019"])
+        assert fs == []
+
+    def test_suppressed(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax.sharding import Mesh
+
+            def build():
+                devs = jax.local_devices()
+                # tracelint: disable=TL019 -- fixture: single-host tool, never runs on a pod
+                return Mesh(devs, ("dp",))
+        """, select=["TL019"])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
 # seeded historical bugs (ISSUE 14 acceptance): each of the three
 # hand-caught PR-7/10/13 bug classes must fail on a mutation of the
 # REAL runtime code and stay clean on HEAD
@@ -2123,6 +2498,107 @@ class TestSeededHistoricalBugs:
 
 
 # ------------------------------------------------------------------ #
+# seeded contract drift (ISSUE 20 acceptance): mutations reproducing
+# the PR-18 recycled-page drift shape against the REAL serve engine/
+# server must fail at error level while the HEAD copies lint clean
+# ------------------------------------------------------------------ #
+
+class TestSeededContractDrift:
+    def _mirror(self, tmp_path, name, src):
+        """The registry module plus one consumer, side by side — the
+        linter reads EXECUTABLES/SLOT_STATE straight out of the AST,
+        so no package scaffolding is needed."""
+        (tmp_path / "schema.py").write_text(open(os.path.join(
+            REPO, "mxnet_tpu", "serve", "schema.py")).read())
+        (tmp_path / name).write_text(src)
+
+    def test_head_engine_and_server_are_clean(self, tmp_path):
+        for name in ("engine.py", "server.py"):
+            src = open(os.path.join(
+                REPO, "mxnet_tpu", "serve", name)).read()
+            self._mirror(tmp_path, name, src)
+        r = cli([str(tmp_path), "--select", "TL016,TL017,TL018",
+                 "--format=json"])
+        assert r.returncode == 0, r.stdout
+        assert json.loads(r.stdout)["findings"] == []
+
+    def test_seeded_admit_operand_without_donate_shift(self, tmp_path):
+        """THE PR-18 shape: an operand inserted into admit's signature
+        while a literal donation pair stays put — positions 6/7 now
+        name zpages/kp and the wrong buffer dies silently (TL016)."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "serve", "engine.py")).read()
+        sig = ("def admit(param_vals, prompts, meta, dls, pages, "
+               "zpages, kp, vp,")
+        don = 'donate_argnums=schema.jit_donate("admit", admit)),'
+        assert sig in src and don in src
+        seeded = src.replace(
+            sig, "def admit(param_vals, prompts, scratch_rows, meta, "
+                 "dls, pages, zpages, kp, vp,", 1
+        ).replace(don, "donate_argnums=(6, 7)),", 1)
+        self._mirror(tmp_path, "engine.py", seeded)
+        r = cli([str(tmp_path), "--select", "TL016", "--format=json"])
+        assert r.returncode == 1
+        hits = json.loads(r.stdout)["findings"]
+        assert any(f["rule"] == "TL016" and "PR-18" in f["message"]
+                   and f["severity"] == "error" for f in hits)
+
+    def test_seeded_state_column_through_three_sites(self, tmp_path):
+        """A tenth slot-state column threaded through the three
+        new-state construction sites but not the schema: every drifted
+        tuple is flagged (TL017)."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "serve", "engine.py")).read()
+        needle = "(kp, vp, pos, tok, active, stop, keys, dl, spec)"
+        assert src.count(needle) == 3
+        seeded = src.replace(
+            needle, "(kp, vp, pos, tok, active, stop, keys, dl, spec, "
+                    "ttl)")
+        self._mirror(tmp_path, "engine.py", seeded)
+        r = cli([str(tmp_path), "--select", "TL017", "--format=json"])
+        assert r.returncode == 1
+        hits = [f for f in json.loads(r.stdout)["findings"]
+                if f["rule"] == "TL017"]
+        assert len(hits) == 3
+        assert all("10 elements" in f["message"] and
+                   "declares 9" in f["message"] for f in hits)
+
+    def test_seeded_literal_byte_total(self, tmp_path):
+        """Hard-coding the 29 back in place of the schema-priced total
+        is flagged (TL017) — the ledger must not drift from the
+        layout."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "serve", "engine.py")).read()
+        needle = "_SLOT_STATE_BYTES = schema.slot_state_bytes()"
+        assert needle in src
+        seeded = src.replace(needle, "_SLOT_STATE_BYTES = 29", 1)
+        self._mirror(tmp_path, "engine.py", seeded)
+        r = cli([str(tmp_path), "--select", "TL017", "--format=json"])
+        assert r.returncode == 1
+        hits = json.loads(r.stdout)["findings"]
+        assert any(f["rule"] == "TL017" and
+                   "slot_state_bytes()" in f["message"] for f in hits)
+
+    def test_seeded_dispatch_drops_zpages(self, tmp_path):
+        """The 'zpages lands in 2 of 3 admission paths' class: the COW
+        admission dispatch loses an operand (TL018)."""
+        src = open(os.path.join(
+            REPO, "mxnet_tpu", "serve", "server.py")).read()
+        needle = ("fn(meta, dls, srcs, dsts, zpages,\n"
+                  "                           *self._state)")
+        assert needle in src
+        seeded = src.replace(
+            needle, "fn(meta, dls, srcs, dsts,\n"
+                    "                           *self._state)", 1)
+        self._mirror(tmp_path, "server.py", seeded)
+        r = cli([str(tmp_path), "--select", "TL018", "--format=json"])
+        assert r.returncode == 1
+        hits = json.loads(r.stdout)["findings"]
+        assert any(f["rule"] == "TL018" and "passes 13" in f["message"]
+                   and "declares 14" in f["message"] for f in hits)
+
+
+# ------------------------------------------------------------------ #
 # SARIF output
 # ------------------------------------------------------------------ #
 
@@ -2183,6 +2659,31 @@ class TestSarif:
         res = json.loads(r.stdout)["runs"][0]["results"]
         assert res and res[0]["level"] == "warning"
 
+    def test_v4_contract_rules_in_driver_and_results(self, tmp_path):
+        """The v4 rule table rides the same sorted(RULES) rendering:
+        TL016–TL019 appear in the driver and fire at error level."""
+        for name, source in {
+                "schema.py": _SCHEMA_FIXTURE,
+                "engine.py": """
+                    import jax
+
+                    def admit(params, prompts, meta, pages,
+                              kp, vp, pos, tok, active):
+                        return (kp, vp, pos, tok, active)
+
+                    fn = jax.jit(admit, donate_argnums=(5, 6))
+                """}.items():
+            (tmp_path / name).write_text(textwrap.dedent(source))
+        r = cli([str(tmp_path), "--select", "TL016", "--format",
+                 "sarif"])
+        assert r.returncode == 1
+        run = json.loads(r.stdout)["runs"][0]
+        rule_ids = {rl["id"] for rl in run["tool"]["driver"]["rules"]}
+        assert {"TL016", "TL017", "TL018", "TL019"} <= rule_ids
+        res = run["results"][0]
+        assert res["ruleId"] == "TL016"
+        assert res["level"] == "error"
+
 
 # ------------------------------------------------------------------ #
 # --jobs — parallel lint determinism (all three formats)
@@ -2214,6 +2715,82 @@ class TestJobs:
         (tmp_path / "ok.py").write_text("x = 1\n")
         r = cli([str(tmp_path), "--jobs", "2"])
         assert r.returncode == 0, r.stdout
+
+
+# ------------------------------------------------------------------ #
+# --changed-only — the pre-commit fast path: report scoped to the
+# git-changed set, byte-identical to a full run filtered to it
+# ------------------------------------------------------------------ #
+
+class TestChangedOnly:
+    BAD = """
+        import jax
+
+        def step{i}(w, g):
+            lr = float(g)
+            return w - lr * g
+
+        fn{i} = jax.jit(step{i})
+    """
+
+    def _git(self, cwd, *args):
+        subprocess.run(
+            ["git", "-c", "user.name=t", "-c", "user.email=t@t",
+             *args],
+            cwd=str(cwd), check=True, capture_output=True, env=_ENV)
+
+    def _seed_repo(self, tmp_path):
+        for i in range(2):
+            (tmp_path / f"mod{i}.py").write_text(
+                textwrap.dedent(self.BAD.format(i=i)))
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+
+    def _cli(self, cwd, args):
+        # run from inside the throwaway checkout; the package resolves
+        # off PYTHONPATH so --changed-only scopes to THAT repo's diff
+        return subprocess.run(
+            [sys.executable, "-m", "tools.tracelint"] + args,
+            capture_output=True, text=True, cwd=str(cwd),
+            env=dict(_ENV, PYTHONPATH=REPO))
+
+    def test_byte_identical_to_filtered_full_run(self, tmp_path):
+        self._seed_repo(tmp_path)
+        p = tmp_path / "mod1.py"
+        p.write_text(p.read_text() + "\n# touched\n")
+        full = self._cli(tmp_path, [".", "--format=json"])
+        changed = self._cli(tmp_path, [".", "--changed-only",
+                                       "--format=json"])
+        assert full.returncode == changed.returncode == 1
+        want = [f for f in json.loads(full.stdout)["findings"]
+                if f["path"].endswith("mod1.py")]
+        got = json.loads(changed.stdout)["findings"]
+        assert want and got == want
+
+    def test_clean_changed_file_passes_despite_dirty_neighbors(
+            self, tmp_path):
+        """Only the changed set is REPORTED — committed findings in
+        untouched modules don't block the pre-commit run."""
+        self._seed_repo(tmp_path)
+        (tmp_path / "newmod.py").write_text("x = 1\n")   # untracked
+        r = self._cli(tmp_path, [".", "--changed-only",
+                                 "--format=json"])
+        assert r.returncode == 0, r.stdout
+        assert json.loads(r.stdout)["findings"] == []
+
+    def test_no_changes_is_clean(self, tmp_path):
+        self._seed_repo(tmp_path)
+        r = self._cli(tmp_path, [".", "--changed-only",
+                                 "--format=json"])
+        assert r.returncode == 0, r.stdout
+        assert json.loads(r.stdout)["findings"] == []
+
+    def test_outside_git_checkout_is_usage_error(self, tmp_path):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        r = self._cli(tmp_path, [".", "--changed-only"])
+        assert r.returncode == 2
+        assert "git" in r.stderr
 
 
 # ------------------------------------------------------------------ #

@@ -374,6 +374,28 @@ def render_pod(events, hbm_budget=None):
     return "\n".join(lines)
 
 
+def _serve_schema():
+    """Load ``mxnet_tpu/serve/schema.py`` standalone, by file path —
+    the operand/slot-state declarations import nothing, so this tool
+    can price slot state EXACTLY without importing the package (which
+    would pull jax).  Returns None when the tree isn't alongside the
+    tool (e.g. the report script copied into a recording dir)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "serve", "schema.py")
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_serve_operand_schema", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
 def check_serve(events):
     """Re-derive the serving invariants from the stream; returns a list
     of failure strings (empty = all good)."""
@@ -508,6 +530,7 @@ def check_serve(events):
     # recordings lack page_bytes and skip the check; the retrace key
     # above is deliberately dtype-free (kv_dtype never shapes a trace
     # signature beyond the operand dtypes it already keys).
+    schema = _serve_schema()
     for st in stats:
         pb = st.get("pool_bytes")
         page_bytes = st.get("page_bytes")
@@ -516,10 +539,19 @@ def check_serve(events):
         if None in (pb, page_bytes, total, slots) or pb == 0:
             continue   # sync mode / torn-down pool: nothing resident
         priced = total * page_bytes
-        # slot scalar state is small but exact: pool_bytes - pages
-        # must land in [0, slots * 64) (the per-slot scalars are a few
-        # dozen bytes; 64 bounds them without re-pinning the layout)
-        if not 0 <= pb - priced < slots * 64:
+        if schema is not None:
+            # the slot-state layout declaration is on hand: the scalar
+            # state must price to EXACTLY slots * slot_state_bytes()
+            # (the same figure pool_state_bytes charges) — any gap is
+            # a column added to one side of the ledger only
+            expect = slots * schema.slot_state_bytes()
+            ok = pb - priced == expect
+        else:
+            # standalone fallback: the per-slot scalars are a few
+            # dozen bytes; 64 bounds them without re-pinning a layout
+            # this copy of the tool can't see
+            ok = 0 <= pb - priced < slots * 64
+        if not ok:
             failures.append(
                 f"{st.get('server', '?')}: serve_stats pool_bytes {pb} "
                 f"inconsistent with {total} pages * {page_bytes} "

@@ -55,6 +55,13 @@ TL014    thread without daemon/join lifecycle; blocking ``queue.get``
          with no poison-pill wakeup
 TL015    telemetry event/metric/fault-site out of sync with
          docs/TELEMETRY.md / docs/ENV_VARS.md
+TL016    ``donate_argnums`` drift against the serve operand schema
+         (or past the wrapped function's arity, producer-side TL002)
+TL017    slot-state / meta layout hard-coded past the operand schema
+TL018    serve executable call-site arity disagrees with its
+         declaration
+TL019    host-local value (process_index / local_devices / per-rank
+         env) flows into cross-process placement construction
 =======  ==========================================================
 
 Suppress a deliberate violation with a justified comment on the same

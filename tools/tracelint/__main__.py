@@ -6,10 +6,37 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from .core import (RULES, apply_baseline, load_baseline, render_sarif,
                    run_paths, write_baseline)
+
+
+def _git_changed_files():
+    """Absolute paths of .py files changed vs HEAD (worktree + index)
+    plus untracked ones — the ``--changed-only`` report scope.  Raises
+    ``RuntimeError`` outside a git checkout."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise RuntimeError(
+            f"--changed-only needs a git checkout: {e}") from e
+    out = set()
+    for line in (diff + untracked).splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            out.add(os.path.realpath(os.path.join(top, line)))
+    return out
 
 
 def main(argv=None):
@@ -39,6 +66,12 @@ def main(argv=None):
     ap.add_argument("--jobs", type=int, default=None, metavar="N",
                     help="distribute per-module rule passes over N "
                          "forked workers (identical output to serial)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only files changed vs git HEAD "
+                         "(worktree, index, untracked) — the project "
+                         "graph still spans all paths, so findings "
+                         "are byte-identical to a full run filtered "
+                         "to those files; the pre-commit fast path")
     args = ap.parse_args(argv)
 
     select = None
@@ -50,10 +83,19 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
 
+    only_paths = None
+    if args.changed_only:
+        try:
+            only_paths = _git_changed_files()
+        except RuntimeError as e:
+            print(f"tracelint: {e}", file=sys.stderr)
+            return 2
+
     try:
         findings = run_paths(args.paths, select=select,
                              env_docs=args.env_docs, jobs=args.jobs,
-                             telemetry_docs=args.telemetry_docs)
+                             telemetry_docs=args.telemetry_docs,
+                             only_paths=only_paths)
     except FileNotFoundError as e:
         print(f"tracelint: no such path: {e}", file=sys.stderr)
         return 2

@@ -33,6 +33,10 @@ RULES = {
     "TL014": "thread without daemon/join lifecycle, or blocking "
              "queue.get with no close wakeup",
     "TL015": "telemetry event/metric/fault-site out of sync with docs",
+    "TL016": "donate_argnums drift against the executable operand schema",
+    "TL017": "slot-state/meta layout hard-coded past the operand schema",
+    "TL018": "executable call-site arity disagrees with its declaration",
+    "TL019": "host-local value flows into cross-process placement",
 }
 
 # `# tracelint: disable=TL001[,TL004] -- justification`
@@ -214,14 +218,15 @@ def _validate_suppressions(module: Module):
 def _module_findings(project, shared, module):
     """Every per-module rule pass over one module (the unit of work
     ``--jobs`` distributes)."""
-    from . import (rules_runtime, rules_sharding, rules_threading,
-                   rules_trace)
+    from . import (rules_contract, rules_runtime, rules_sharding,
+                   rules_threading, rules_trace)
 
     out = list(_validate_suppressions(module))
     out.extend(rules_trace.check_module(project, module))
     out.extend(rules_threading.check_module(shared, module))
     out.extend(rules_sharding.check_module(project, shared, module))
     out.extend(rules_runtime.check_module(project, shared, module))
+    out.extend(rules_contract.check_module(project, shared, module))
     return out
 
 
@@ -289,7 +294,7 @@ def _unused_suppressions(modules, findings):
 
 
 def run_paths(paths, select=None, env_docs=None, jobs=None,
-              telemetry_docs=None):
+              telemetry_docs=None, only_paths=None):
     """Run every rule over ``paths``; returns the surviving findings.
 
     ``select`` restricts to an iterable of rule ids (and is the opt-in
@@ -297,9 +302,20 @@ def run_paths(paths, select=None, env_docs=None, jobs=None,
     over a fork pool — output is identical to the serial run.
     Suppressions with a justification remove matching findings;
     reasonless suppressions do not (and raise TL000 themselves).
+
+    ``only_paths`` (an iterable of file paths, e.g. the git-changed
+    set behind ``--changed-only``) restricts the REPORT to those
+    files while the project graph — imports, traced discovery,
+    mesh-axis vocabulary, the operand-schema registry — is still
+    built over all of ``paths``, so the surviving findings are
+    byte-identical to a full run filtered to the same files.  Only
+    the per-module rule passes are skipped for unreported modules;
+    project-level passes always run in full (they are the cheap
+    part, and their findings cross files).
     """
     from . import rules_env, rules_runtime
     from .project import Project
+    from .rules_contract import find_registry
     from .rules_sharding import build_state
 
     files = collect_py_files(paths)
@@ -308,7 +324,14 @@ def run_paths(paths, select=None, env_docs=None, jobs=None,
 
     project = Project(modules)
     shared = build_state(project)
-    findings.extend(_run_modules(project, shared, modules, jobs))
+    find_registry(project)  # memoize pre-fork: workers inherit it
+    if only_paths is None:
+        active = modules
+    else:
+        keep_paths = {os.path.realpath(p) for p in only_paths}
+        active = [m for m in modules
+                  if os.path.realpath(m.path) in keep_paths]
+    findings.extend(_run_modules(project, shared, active, jobs))
     docs = find_repo_docs(paths, env_docs)
     tele = find_repo_docs(paths, telemetry_docs, name="TELEMETRY.md")
     # one repo scan per distinct docs ROOT: the stale directions must
@@ -330,8 +353,12 @@ def run_paths(paths, select=None, env_docs=None, jobs=None,
     findings.extend(rules_runtime.check_contract(
         modules, tele, docs, _aux_for(tele), _aux_for(docs)))
     findings.extend(rules_runtime.check_project(project, shared))
-    findings.extend(_unused_suppressions(modules, findings))
+    findings.extend(_unused_suppressions(active, findings))
 
+    if only_paths is not None:
+        keep_paths = {os.path.realpath(p) for p in only_paths}
+        findings = [f for f in findings
+                    if os.path.realpath(f.path) in keep_paths]
     if select:
         keep = set(select)
         findings = [f for f in findings if f.rule in keep]

@@ -1,0 +1,579 @@
+"""TL016–TL019 — the executable-contract family (tracelint v4).
+
+The serve engine's compiled programs live by POSITIONAL facts the
+compiler trusts blindly: ``donate_argnums`` indices, the slot-state
+tuple layout, each dispatch call's operand order.  PR 18's recycled-page
+bug rode exactly that — a hand-shifted donation pair plus a slot-state
+column threaded through scatter sites by eye.  PR 20 moved those facts
+into a declarative registry (``mxnet_tpu/serve/schema.py``:
+``EXECUTABLES`` + ``SLOT_STATE``, pure literals), and these rules hold
+every producer and consumer in the lint target to it — the registry is
+read straight out of the AST (``ast.literal_eval``), no import, so the
+linter checks the same declaration the runtime derives its
+``donate_argnums`` from.
+
+* **TL016** — donation-index drift.  A ``jax.jit(fn,
+  donate_argnums=<literal>)`` whose wrapped function is a registry
+  executable must donate exactly the registry's positions, and the
+  parameters at those positions must be the declared donated operands
+  (deriving via ``schema.jit_donate`` is the sanctioned pattern and
+  passes).  Outside the registry the producer-side generalization of
+  TL002 applies: a literal donation index past the wrapped function's
+  positional arity donates a buffer that does not exist — XLA trusts
+  the index, so the wrong operand dies silently.
+* **TL017** — slot-state / meta layout drift.  Hard-coded ``meta``
+  column subscripts inside an executable body, state tuples whose
+  arity disagrees with the declared column count, and literal
+  ``*SLOT_STATE*BYTES*`` constants all bypass the registry accessors —
+  the PR-13 deadline and PR-17 spec-depth columns were each
+  hand-threaded through four scatter sites this way.
+* **TL018** — operand-arity drift.  A dispatch call-site reached
+  through a registry executable's getter must pass exactly the
+  declared operand count (a ``*state`` splat counts as the declared
+  state arity) — the "``zpages`` lands in 2 of 3 admission paths"
+  class.
+* **TL019** — multi-process placement discipline.  Host-local values
+  (``jax.process_index()``, ``jax.local_devices()``,
+  ``jax.local_device_count()``, per-rank env reads) flowing into mesh
+  or sharding CONSTRUCTION (``Mesh``/``make_mesh``/``NamedSharding``/
+  ``PartitionSpec``) or into the sharding position of
+  ``device_put``/``global_put``/``make_array_from_process_local_data``
+  give each pod process a different placement for the "same" global
+  array — the elastic-resume hazard PR 19 hand-reviewed for.  Route
+  placement through the ``parallel.mesh`` helpers (whose definitions
+  are the sanctioned boundary and are exempt) and pod-global facts
+  (``jax.devices()``, ``jax.device_count()``).
+"""
+from __future__ import annotations
+
+import ast
+
+from .callgraph import dotted, iter_own
+from .core import Finding
+from .rules_trace import _is_jit_call, _resolve_positions
+
+__all__ = ["check_module", "find_registry"]
+
+# mirror of the registry's dtype pricing table (the registry file is
+# read as data, not imported, so the linter prices slot-state bytes
+# with its own copy)
+_ITEMSIZE = {"bool": 1, "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+             "int32": 4, "uint32": 4, "float32": 4, "int64": 8,
+             "uint64": 8, "float64": 8}
+
+# host-local reads that differ per pod process (TL019 taint sources)
+_LOCAL_READS = {"process_index", "local_devices", "local_device_count"}
+# the sanctioned placement helpers (parallel/mesh.py): values produced
+# BY them are clean, and the functions DEFINING them are exempt sinks
+_MESH_HELPERS = {"make_mesh", "default_mesh", "current_mesh",
+                 "named_sharding", "data_sharding",
+                 "replicated_sharding", "local_mesh_axes", "global_put"}
+
+
+class Registry:
+    """The operand-schema declarations of one registry module, parsed
+    from its AST (``EXECUTABLES`` / ``SLOT_STATE`` literal assigns)."""
+
+    def __init__(self, module, execs, slots):
+        self.module = module
+        self.execs = execs
+        self.slots = tuple(slots)
+        self.state_arity = 2 + len(self.slots)
+        self.slot_state_bytes = sum(
+            _ITEMSIZE.get(dt, 0) * n for _, dt, n in self.slots)
+        self.by_getter = {}
+        for name, e in execs.items():
+            getter = e.get("getter")
+            if isinstance(getter, str):
+                self.by_getter[getter] = name
+
+    def operands(self, name):
+        return tuple(self.execs[name]["operands"])
+
+    def arity(self, name):
+        return len(self.operands(name))
+
+    def donated(self, name):
+        return tuple(self.execs[name].get("donated", ()))
+
+    def donate_argnums(self, name):
+        donated = set(self.donated(name))
+        return tuple(i for i, op in enumerate(self.operands(name))
+                     if op in donated)
+
+    def scope_match(self, mod_name, name):
+        """Is ``mod_name`` the module the executable declares itself
+        defined in (suffix-tolerant for bare fixture files)?"""
+        decl = self.execs[name].get("module")
+        if not isinstance(decl, str) or not mod_name:
+            return False
+        return (mod_name == decl or mod_name.endswith("." + decl)
+                or decl.endswith("." + mod_name))
+
+    def in_scope(self, mod_name):
+        return any(self.scope_match(mod_name, n) for n in self.execs)
+
+
+def _literal_assign(module, varname):
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == varname:
+            return stmt.value
+    return None
+
+
+def _valid_execs(execs):
+    if not isinstance(execs, dict) or not execs:
+        return False
+    for e in execs.values():
+        if not isinstance(e, dict) or \
+                not isinstance(e.get("operands"), (tuple, list)):
+            return False
+    return True
+
+
+def find_registry(project):
+    """The first scanned module declaring BOTH ``EXECUTABLES`` and
+    ``SLOT_STATE`` as pure literals, or None.  Memoized per project
+    (cheap: top-level assigns only)."""
+    cached = getattr(project, "_contract_registry", False)
+    if cached is not False:
+        return cached
+    reg = None
+    for m in project.modules:
+        ev = _literal_assign(m, "EXECUTABLES")
+        sv = _literal_assign(m, "SLOT_STATE")
+        if ev is None or sv is None:
+            continue
+        try:
+            execs = ast.literal_eval(ev)
+            slots = ast.literal_eval(sv)
+        except (ValueError, SyntaxError):
+            continue
+        if _valid_execs(execs) and isinstance(slots, (tuple, list)):
+            reg = Registry(m, execs, slots)
+            break
+    project._contract_registry = reg
+    return reg
+
+
+def check_module(project, shared, module):
+    reg = find_registry(project)
+    findings = []
+    findings.extend(_tl016(project, reg, module))
+    if reg is not None and module is not reg.module:
+        findings.extend(_tl017(project, reg, module))
+        findings.extend(_tl018(project, reg, module))
+    findings.extend(_tl019(project, module))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# TL016 — donation-index drift
+# --------------------------------------------------------------------- #
+
+def _positional_params(fn_node):
+    a = fn_node.args
+    return [p.arg for p in a.posonlyargs + a.args], a.vararg is not None
+
+
+def _wrapped_fn(project, module, idx, call, scopes):
+    """FuncInfo of ``jax.jit``'s wrapped function, when resolvable."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Name):
+        info = idx.resolve_name(target.id, scopes)
+        if info is not None:
+            return info
+        imp = project.imports[id(module)]
+        if target.id in imp.from_imports:
+            tgt, remote = imp.from_imports[target.id]
+            hit = project._module_func(project.by_name.get(tgt), remote)
+            if hit is not None:
+                return hit[1]
+    return None
+
+
+def _tl016(project, reg, module):
+    idx = project.index(module)
+    mod_name = project.names[id(module)] or ""
+    out = []
+    for call, scopes in idx.calls:
+        if not _is_jit_call(call, module):
+            continue
+        kw = next((k for k in call.keywords
+                   if k.arg == "donate_argnums"), None)
+        if kw is None:
+            continue
+        if isinstance(kw.value, ast.Call):
+            d = dotted(kw.value.func)
+            if d and d.split(".")[-1] == "jit_donate":
+                continue  # registry-derived: the sanctioned pattern
+        fn_node = scopes[-1] if isinstance(
+            scopes[-1], (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+        pos = _resolve_positions(kw.value, fn_node)
+        if not pos:
+            continue
+        winfo = _wrapped_fn(project, module, idx, call, scopes)
+        if winfo is None:
+            continue
+        params, has_var = _positional_params(winfo.node)
+        if reg is not None and winfo.name in reg.execs and \
+                reg.scope_match(mod_name, winfo.name):
+            name = winfo.name
+            expected = reg.donate_argnums(name)
+            donated = set(reg.donated(name))
+            if set(pos) != set(expected):
+                out.append(Finding(
+                    "TL016", module.path, kw.value.lineno,
+                    kw.value.col_offset,
+                    f"literal donate_argnums {tuple(sorted(pos))} on "
+                    f"serve executable {name!r} disagree with the "
+                    f"operand schema's donated positions {expected} "
+                    f"(donated operands: {sorted(donated)}) — derive "
+                    "them with schema.jit_donate() so an operand "
+                    "insertion can never donate the wrong buffer"))
+                continue
+            bad = [p for p in sorted(pos)
+                   if p >= len(params) or params[p] not in donated]
+            if bad:
+                at = ", ".join(
+                    f"{p} (param "
+                    f"{params[p]!r})" if p < len(params) else f"{p} "
+                    "(past the arity)" for p in bad)
+                out.append(Finding(
+                    "TL016", module.path, kw.value.lineno,
+                    kw.value.col_offset,
+                    f"serve executable {name!r} donates position(s) "
+                    f"{at}, but the operand schema donates "
+                    f"{sorted(donated)} — the function's parameter "
+                    "list drifted from the declaration (the PR-18 "
+                    "recycled-page shape); update the schema and the "
+                    "signature together and derive the indices with "
+                    "schema.jit_donate()"))
+        else:
+            over = [p for p in sorted(pos) if p >= len(params)]
+            if over and not has_var:
+                out.append(Finding(
+                    "TL016", module.path, kw.value.lineno,
+                    kw.value.col_offset,
+                    f"donate_argnums {tuple(sorted(pos))} exceed "
+                    f"`{winfo.name}`'s positional arity {len(params)} "
+                    f"({', '.join(params) or 'no parameters'}) — XLA "
+                    "trusts donation indices blindly, so a stale index "
+                    "silently donates the wrong operand; re-count "
+                    "against the signature"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL017 — slot-state / meta layout drift
+# --------------------------------------------------------------------- #
+
+def _int_subscript_consts(node):
+    """Constant-int index nodes inside one Subscript slice."""
+    sl = node.slice
+    elems = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+    return [e for e in elems
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+
+
+def _calls_getters(idx, reg):
+    for call, _scopes in idx.calls:
+        d = dotted(call.func)
+        if d and d.split(".")[-1] in reg.by_getter:
+            return True
+    return False
+
+
+def _tl017(project, reg, module):
+    idx = project.index(module)
+    mod_name = project.names[id(module)] or ""
+    exec_scope = reg.in_scope(mod_name)
+    dispatch_scope = exec_scope or _calls_getters(idx, reg)
+    out = []
+    # (a) hard-coded meta column subscripts — in executable bodies and
+    # in dispatch modules building the rows the bodies unpack
+    if dispatch_scope:
+        meta_fns = []
+        for info in idx.functions:
+            params, _ = _positional_params(info.node)
+            if "meta" in params or (exec_scope and info.name in reg.execs):
+                meta_fns.append(info)
+        if not exec_scope:
+            meta_fns = idx.functions  # dispatch side: any builder
+        for info in meta_fns:
+            for n in iter_own(info.node):
+                if isinstance(n, ast.Subscript) and \
+                        dotted(n.value) == "meta":
+                    for c in _int_subscript_consts(n):
+                        out.append(Finding(
+                            "TL017", module.path, c.lineno, c.col_offset,
+                            f"hard-coded meta column index {c.value} — "
+                            "the packed meta-row layout is declared in "
+                            "the operand schema; index through "
+                            "schema.meta_col()/meta_cols() (build rows "
+                            "with schema.meta_row()) so a new column "
+                            "renumbers every site at once"))
+    # (b) state tuples whose arity disagrees with the declared columns
+    if exec_scope:
+        for n in ast.walk(module.tree):
+            if not isinstance(n, ast.Tuple) or len(n.elts) < 3:
+                continue
+            e0, e1 = n.elts[0], n.elts[1]
+            if isinstance(e0, ast.Name) and isinstance(e1, ast.Name) \
+                    and e0.id == "kp" and e1.id == "vp" and \
+                    len(n.elts) != reg.state_arity:
+                out.append(Finding(
+                    "TL017", module.path, n.lineno, n.col_offset,
+                    f"pool state tuple has {len(n.elts)} elements where "
+                    f"the operand schema declares {reg.state_arity} "
+                    "(kp, vp + SLOT_STATE columns) — a column threaded "
+                    "through some scatter sites but not this one is "
+                    "exactly the PR-13/PR-17 drift; update the schema "
+                    "and every site together"))
+    # (c) literal slot-state byte totals bypassing the registry
+    for n in ast.walk(module.tree):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Constant) \
+                and isinstance(n.value.value, int):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and "SLOT_STATE" in t.id \
+                        and "BYTE" in t.id:
+                    out.append(Finding(
+                        "TL017", module.path, n.lineno, n.col_offset,
+                        f"`{t.id} = {n.value.value}` hard-codes the "
+                        "per-slot state byte total — price it from the "
+                        "declaration (schema.slot_state_bytes(), "
+                        f"currently {reg.slot_state_bytes}) so the "
+                        "ledger can never drift from the layout"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL018 — operand-arity drift at dispatch call-sites
+# --------------------------------------------------------------------- #
+
+def _dispatch_exec(n, bound, getters):
+    """Executable name when Call ``n`` dispatches one, else None."""
+    if isinstance(n.func, ast.Name) and n.func.id in bound:
+        return bound[n.func.id]
+    if isinstance(n.func, ast.Call):
+        d = dotted(n.func.func)
+        if d and d.split(".")[-1] in getters:
+            return getters[d.split(".")[-1]]
+    return None
+
+
+def _tl018(project, reg, module):
+    idx = project.index(module)
+    getters = reg.by_getter
+    out = []
+    for info in idx.functions:
+        bound = {}   # local name -> executable it was fetched as
+        for n in iter_own(info.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Call):
+                d = dotted(n.value.func)
+                if d and d.split(".")[-1] in getters:
+                    bound[n.targets[0].id] = getters[d.split(".")[-1]]
+        if not bound and not any(
+                isinstance(n, ast.Call) and isinstance(n.func, ast.Call)
+                for n in iter_own(info.node)):
+            continue
+        for n in iter_own(info.node):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _dispatch_exec(n, bound, getters)
+            if name is None or n.keywords:
+                continue
+            count, countable = 0, True
+            for a in n.args:
+                if isinstance(a, ast.Starred):
+                    d = dotted(a.value)
+                    if d and "state" in d.split(".")[-1].lower():
+                        count += reg.state_arity
+                    else:
+                        countable = False
+                        break
+                else:
+                    count += 1
+            if not countable:
+                continue
+            want = reg.arity(name)
+            if count != want:
+                out.append(Finding(
+                    "TL018", module.path, n.lineno, n.col_offset,
+                    f"dispatch of serve executable {name!r} passes "
+                    f"{count} operand(s) (a *state splat counts as "
+                    f"{reg.state_arity}) where the operand schema "
+                    f"declares {want}: "
+                    f"({', '.join(reg.operands(name))}) — an operand "
+                    "missing from one dispatch path is the "
+                    "'zpages lands in 2 of 3 admission paths' class"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# TL019 — multi-process placement discipline
+# --------------------------------------------------------------------- #
+
+def _jaxish(root, module):
+    return root == "jax" or root in module.jax_aliases
+
+
+def _local_read(call, module, imports):
+    """Label when ``call`` reads host-local pod state, else None."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    head = imports.from_imports.get(parts[0])
+    if head is not None:
+        parts = head[0].split(".") + [head[1]] + parts[1:]
+    else:
+        tgt = imports.mod_aliases.get(parts[0])
+        if tgt is not None:
+            parts = tgt.split(".") + parts[1:]
+    root, last = parts[0], parts[-1]
+    if last in _LOCAL_READS and _jaxish(root, module):
+        return f"jax.{last}()"
+    if root == "os" and (last == "getenv" or
+                         ("environ" in parts[:-1] and last == "get")):
+        return "a per-rank os.environ read"
+    return None
+
+
+def _environ_sub(node):
+    if isinstance(node, ast.Subscript):
+        d = dotted(node.value)
+        return bool(d) and d.endswith("environ")
+    return False
+
+
+def _placement_taint(module, imports, fn_node):
+    """origin(expr) -> (source node, label) for host-local values in one
+    scope, following local assignment chains.  Values produced by the
+    ``parallel.mesh`` helpers are clean — the helpers are the
+    sanctioned boundary."""
+    sources = {}
+    for n in iter_own(fn_node):
+        label = None
+        if isinstance(n, ast.Call):
+            label = _local_read(n, module, imports)
+        elif _environ_sub(n):
+            label = "a per-rank os.environ read"
+        if label:
+            sources[id(n)] = (n, label)
+    tainted = {}
+
+    def origin(expr):
+        for sub in ast.walk(expr):
+            if id(sub) in sources:
+                return sources[id(sub)]
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Load) and sub.id in tainted:
+                return tainted[sub.id]
+        return None
+
+    # to a fixed point: iter_own's walk order is not source order, so a
+    # k-link assignment chain can need k passes (capped — chains this
+    # deep in one scope are already suspect)
+    for _ in range(10):
+        changed = False
+        for n in iter_own(fn_node):
+            if not isinstance(n, (ast.Assign, ast.AugAssign)):
+                continue
+            if isinstance(n.value, ast.Call):
+                d = dotted(n.value.func)
+                if d and d.split(".")[-1] in _MESH_HELPERS:
+                    continue  # helper output is sanctioned-clean
+            hit = origin(n.value)
+            if hit is None:
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) \
+                else [n.target]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name) and \
+                            leaf.id not in tainted:
+                        tainted[leaf.id] = hit
+                        changed = True
+        if not changed:
+            break
+    return origin
+
+
+def _spec_ctor(call, imports):
+    d = dotted(call.func)
+    if d is None:
+        return False
+    last = d.split(".")[-1]
+    if last == "PartitionSpec":
+        return True
+    if last == "P":
+        tgt = imports.from_imports.get("P")
+        return bool(tgt) and tgt[1] in ("P", "PartitionSpec")
+    return False
+
+
+def _placement_sink_args(call, imports):
+    """(what, arg nodes to taint-check) when ``call`` constructs or
+    consumes cross-process placement, else None."""
+    if _spec_ctor(call, imports):
+        return ("PartitionSpec construction",
+                list(call.args) + [k.value for k in call.keywords])
+    d = dotted(call.func)
+    last = d.split(".")[-1] if d else None
+    if last in ("Mesh", "make_mesh", "NamedSharding"):
+        return (f"`{last}(...)` mesh/sharding construction",
+                list(call.args) + [k.value for k in call.keywords])
+    if last in ("device_put", "global_put") and len(call.args) >= 2:
+        return (f"the sharding argument of `{last}(...)`",
+                [call.args[1]])
+    if last == "make_array_from_process_local_data" and call.args:
+        return ("the sharding argument of "
+                "`make_array_from_process_local_data(...)`",
+                [call.args[0]])
+    return None
+
+
+def _tl019(project, module):
+    imports = project.imports[id(module)]
+    idx = project.index(module)
+    out = []
+    scopes = [module.tree] + [info.node for info in idx.functions]
+    for fn_node in scopes:
+        # the parallel.mesh helper DEFINITIONS are the sanctioned
+        # boundary — their internals legitimately branch on process
+        # locality (global_put assembles from process-local data)
+        if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and fn_node.name in (_MESH_HELPERS | {"init_distributed",
+                                                      "barrier"}):
+            continue
+        origin = _placement_taint(module, imports, fn_node)
+        for n in iter_own(fn_node):
+            if not isinstance(n, ast.Call):
+                continue
+            sink = _placement_sink_args(n, imports)
+            if sink is None:
+                continue
+            what, args = sink
+            for a in args:
+                hit = origin(a)
+                if hit is None:
+                    continue
+                node, label = hit
+                out.append(Finding(
+                    "TL019", module.path, a.lineno, a.col_offset,
+                    f"host-local {label} (line {node.lineno}) flows "
+                    f"into {what} — each pod process computes a "
+                    "different placement for the same global array "
+                    "(the elastic-resume hazard); build placement "
+                    "from pod-global facts (jax.devices(), "
+                    "jax.device_count()) or route it through the "
+                    "parallel.mesh helpers"))
+                break  # one finding per sink call
+    return out
